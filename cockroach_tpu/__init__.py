@@ -1,0 +1,29 @@
+"""cockroach_tpu — a TPU-native vectorized distributed SQL execution framework.
+
+Re-expresses the capability surface of CockroachDB's vectorized DistSQL engine
+(reference: /root/reference, pkg/sql/colexec*, pkg/col, pkg/sql/colflow) as an
+idiomatic JAX/XLA/Pallas design:
+
+- ``coldata``   — Arrow-compatible columnar batches with static tile shapes and
+                  validity masks (reference: pkg/col/coldata).
+- ``ops``       — dtype-polymorphic jitted kernels replacing the 500k lines of
+                  execgen-generated .eg.go operators (reference: pkg/sql/colexec).
+- ``flow``      — the pull-based Operator contract and flow runtime
+                  (reference: pkg/sql/colexecop/operator.go:21, pkg/sql/colflow).
+- ``plan``      — physical plan IR, the execinfrapb.ProcessorSpec analog
+                  (reference: pkg/sql/execinfrapb, colbuilder/execplan.go:736).
+- ``parallel``  — mesh shuffles: the HashRouter/Outbox/Inbox gRPC shuffle becomes
+                  an all-to-all over ICI (reference: pkg/sql/colflow/routers.go:420,
+                  colrpc/outbox.go:44).
+- ``storage``   — MVCC version-filter and LSM k-way merge kernels (reference:
+                  pkg/storage/pebble_mvcc_scanner.go:381, pebble compaction).
+
+Int64/float64 support is required for SQL semantics (DECIMAL as scaled int64,
+TIMESTAMP as int64 micros), so x64 mode is enabled at import.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

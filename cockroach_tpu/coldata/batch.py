@@ -1,0 +1,245 @@
+"""Columnar batch format — the coldata.Batch analog, TPU-first.
+
+Reference semantics (pkg/col/coldata/batch.go:24, vec.go:43, nulls.go:35):
+a Batch is a vector of typed columns + a selection vector + a length, with a
+default capacity of 1024 and max 4096. The TPU redesign keeps the same logical
+model but makes every shape static:
+
+- capacity is a *static* tile size (default 4096 == coldata.MaxBatchSize,
+  pkg/col/coldata/batch.go:102); jit specializes per capacity.
+- the selection vector becomes a boolean liveness ``mask`` over the tile;
+  logical length is ``mask.sum()`` (a traced scalar, never a Python int).
+- each column carries an Arrow-convention ``valid`` bitmap (True = non-NULL),
+  like Vec.Nulls but inverted to match Arrow (pkg/col/colserde ships Arrow on
+  the wire already — arrowbatchconverter.go:126).
+
+A Batch is a registered pytree whose leaves are device arrays, so it flows
+through jit / shard_map / collectives directly. All schema information
+(types, dictionaries) is static plan-side metadata and never enters the pytree.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .types import BYTES, Family, Schema, SQLType, zeros_like_type
+
+DEFAULT_CAPACITY = 4096  # coldata.MaxBatchSize (pkg/col/coldata/batch.go:102)
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class Column:
+    """One typed column over a static-capacity tile.
+
+    data  : [cap] canonical-dtype array ([cap, W] uint8 for BYTES)
+    valid : [cap] bool, True = non-NULL (Arrow convention)
+    """
+
+    data: jax.Array
+    valid: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class Batch:
+    """cols: one Column per schema field; mask: [cap] bool row liveness."""
+
+    cols: tuple[Column, ...]
+    mask: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.mask.shape[0]
+
+    def length(self) -> jax.Array:
+        """Logical row count — a traced int32 scalar."""
+        return jnp.sum(self.mask, dtype=jnp.int32)
+
+    def col(self, i: int) -> Column:
+        return self.cols[i]
+
+    def with_cols(self, cols: tuple[Column, ...]) -> "Batch":
+        return Batch(cols=cols, mask=self.mask)
+
+    def with_mask(self, mask: jax.Array) -> "Batch":
+        return Batch(cols=self.cols, mask=mask)
+
+    def select(self, idxs: tuple[int, ...]) -> "Batch":
+        return Batch(cols=tuple(self.cols[i] for i in idxs), mask=self.mask)
+
+
+class Dictionary:
+    """Host-side string dictionary for a STRING column (codes on device).
+
+    Cross-table string operations are pre-bridged on the host and become
+    gathers on device:
+      - ``hashes``: code -> 64-bit hash of the underlying bytes, so string
+        group-by/join keys hash identically across tables with different
+        dictionaries.
+      - ``ranks``: code -> rank in sorted byte order, so ORDER BY / range
+        predicates on strings become integer comparisons.
+    """
+
+    def __init__(self, values: np.ndarray):
+        self.values = np.asarray(values, dtype=object)
+        order = np.argsort(self.values.astype(str))
+        ranks = np.empty(len(self.values), dtype=np.int32)
+        ranks[order] = np.arange(len(self.values), dtype=np.int32)
+        self.ranks = ranks
+        self.hashes = np.array(
+            [_string_hash64(str(v)) for v in self.values], dtype=np.uint64
+        )
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def code_of(self, value: str) -> int:
+        """Code for a literal value, or -1 if absent (predicate is then false)."""
+        hits = np.nonzero(self.values.astype(str) == value)[0]
+        return int(hits[0]) if len(hits) else -1
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        out = np.empty(codes.shape, dtype=object)
+        in_range = (codes >= 0) & (codes < len(self.values))
+        out[in_range] = self.values[codes[in_range]]
+        out[~in_range] = None
+        return out
+
+
+def _string_hash64(s: str) -> int:
+    """FNV-1a 64-bit over utf-8 bytes; deterministic across processes."""
+    h = np.uint64(0xCBF29CE484222325)
+    prime = np.uint64(0x100000001B3)
+    with np.errstate(over="ignore"):
+        for b in s.encode("utf-8"):
+            h = (h ^ np.uint64(b)) * prime
+    return int(h)
+
+
+def empty_batch(schema: Schema, capacity: int = DEFAULT_CAPACITY) -> Batch:
+    cols = tuple(
+        Column(
+            data=zeros_like_type(t, capacity),
+            valid=jnp.zeros((capacity,), dtype=jnp.bool_),
+        )
+        for t in schema.types
+    )
+    return Batch(cols=cols, mask=jnp.zeros((capacity,), dtype=jnp.bool_))
+
+
+def from_host(
+    schema: Schema,
+    arrays: dict[str, np.ndarray],
+    valids: dict[str, np.ndarray] | None = None,
+    capacity: int | None = None,
+) -> Batch:
+    """Build a Batch from host numpy columns, padding to capacity.
+
+    STRING columns must already be dictionary codes (int32); encoding raw
+    string arrays happens at table-load time (see bench/tpch.py).
+    """
+    valids = valids or {}
+    n = len(next(iter(arrays.values())))
+    cap = capacity if capacity is not None else max(DEFAULT_CAPACITY, n)
+    cols = []
+    for name, t in zip(schema.names, schema.types):
+        a = np.asarray(arrays[name])
+        assert len(a) == n, f"column {name} length {len(a)} != {n}"
+        if t.family is Family.BYTES:
+            buf = np.zeros((cap, t.width), dtype=np.uint8)
+            buf[:n] = a
+            data = jnp.asarray(buf)
+        else:
+            buf = np.zeros((cap,), dtype=t.dtype)
+            buf[:n] = a.astype(t.dtype)
+            data = jnp.asarray(buf)
+        v = np.zeros((cap,), dtype=np.bool_)
+        v[:n] = valids.get(name, np.ones(n, dtype=np.bool_))
+        cols.append(Column(data=data, valid=jnp.asarray(v)))
+    mask = np.zeros((cap,), dtype=np.bool_)
+    mask[:n] = True
+    return Batch(cols=tuple(cols), mask=jnp.asarray(mask))
+
+
+def to_host(
+    batch: Batch, schema: Schema, dictionaries: dict[int, Dictionary] | None = None
+) -> dict[str, np.ndarray]:
+    """Materialize live rows to host numpy (the Materializer analog,
+    pkg/sql/colexec/materializer.go:30). Decodes STRING via dictionaries
+    (column index -> Dictionary); NULLs become None in object arrays."""
+    dictionaries = dictionaries or {}
+    mask = np.asarray(batch.mask)
+    out: dict[str, np.ndarray] = {}
+    for i, (name, t) in enumerate(zip(schema.names, schema.types)):
+        data = np.asarray(batch.cols[i].data)[mask]
+        valid = np.asarray(batch.cols[i].valid)[mask]
+        if t.family is Family.STRING and i in dictionaries:
+            vals = dictionaries[i].decode(data)
+            vals[~valid] = None
+            out[name] = vals
+        elif t.family is Family.DECIMAL:
+            res = data.astype(np.float64) / (10.0**t.scale)
+            obj = res.astype(object)
+            obj[~valid] = None
+            out[name] = obj if not valid.all() else res
+        else:
+            if valid.all():
+                out[name] = data
+            else:
+                obj = data.astype(object)
+                obj[~valid] = None
+                out[name] = obj
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def compact(batch: Batch, capacity: int | None = None) -> Batch:
+    """Pack live rows to the front of a (possibly smaller) tile.
+
+    The reference compacts via selection vectors; on TPU we compute each live
+    row's destination with a cumulative sum and scatter — O(cap) and fuses.
+    """
+    cap_out = capacity or batch.capacity
+    mask = batch.mask
+    dest = jnp.cumsum(mask.astype(jnp.int32)) - 1  # destination slot per live row
+    dest = jnp.where(mask, dest, cap_out)  # dead rows scatter off the end
+    n = jnp.sum(mask, dtype=jnp.int32)
+
+    def move(col: Column) -> Column:
+        if col.data.ndim == 2:
+            data = jnp.zeros((cap_out, col.data.shape[1]), col.data.dtype)
+            data = data.at[dest].set(col.data, mode="drop")
+        else:
+            data = jnp.zeros((cap_out,), col.data.dtype)
+            data = data.at[dest].set(col.data, mode="drop")
+        valid = jnp.zeros((cap_out,), jnp.bool_).at[dest].set(col.valid, mode="drop")
+        return Column(data=data, valid=valid)
+
+    new_mask = jnp.arange(cap_out, dtype=jnp.int32) < n
+    return Batch(cols=tuple(move(c) for c in batch.cols), mask=new_mask)
+
+
+def concat(batches: list[Batch], capacity: int) -> Batch:
+    """Concatenate batches into one tile of `capacity` (must fit; caller checks)."""
+    ncols = len(batches[0].cols)
+    big = Batch(
+        cols=tuple(
+            Column(
+                data=jnp.concatenate([b.cols[i].data for b in batches]),
+                valid=jnp.concatenate([b.cols[i].valid for b in batches]),
+            )
+            for i in range(ncols)
+        ),
+        mask=jnp.concatenate([b.mask for b in batches]),
+    )
+    return compact(big, capacity=capacity)

@@ -1,0 +1,257 @@
+"""Grouped aggregation kernels — the hashAggregator / orderedAggregator analog.
+
+Reference: pkg/sql/colexec/hash_aggregator.go:62 builds a vectorized hash table
+(colexechash.HashTable, hashtable.go:215) and accumulates per-bucket; ordered
+aggregation detects group boundaries in sorted input. The TPU redesign uses two
+strategies, both static-shape:
+
+1. ``sort_groupby`` — the general path. Sort the tile by the group key columns
+   (XLA sort), detect segment boundaries, reduce with jax.ops.segment_* into a
+   padded output tile. Replaces pointer-chasing hash tables, which TPUs cannot
+   do, with sorts, which they do well.
+
+2. ``smallgroup_groupby`` — the MXU/VPU path for planner-known small group
+   cardinality G (e.g. TPC-H Q1's returnflag x linestatus = 6): a one-hot
+   [tile, G] membership matrix and masked reductions; exact in int64, no sort.
+
+NULL semantics: NULLs form their own group (SQL GROUP BY); aggregates skip
+NULL inputs; SUM/MIN/MAX over an empty (all-NULL) group is NULL; COUNT is 0.
+
+Partial aggregation across devices/batches: every aggregate here has a
+well-defined merge (sum+sum, count+count, min of mins...), used by the
+distributed final-stage aggregator (reference analog: local+final aggregation
+stages in distsql_physical_planner.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..coldata.batch import Batch, Column
+from ..coldata.types import FLOAT64, INT64, Family, Schema, SQLType
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    func: str  # sum | count | count_rows | min | max | avg | any_not_null
+    col: int | None = None  # input column index (None for count_rows)
+    name: str = ""
+
+
+def agg_output_type(spec: AggSpec, schema: Schema) -> SQLType:
+    if spec.func in ("count", "count_rows"):
+        return INT64
+    if spec.func == "avg":
+        return FLOAT64
+    t = schema.types[spec.col]
+    if spec.func == "sum":
+        # CRDB promotes sum(int) to DECIMAL; we keep int64 and document the
+        # divergence (overflow policy: TPC-H fits; see SURVEY.md §7 hard parts).
+        # Float sums accumulate and return in float64.
+        if t.family is Family.FLOAT:
+            return FLOAT64
+        return t
+    return t  # min/max/any_not_null keep input type
+
+
+def _minmax_sentinel(dtype, is_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(np.inf if is_min else -np.inf, dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(is_min, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if is_min else info.min, dtype)
+
+
+def _segment_agg(spec: AggSpec, col: Column | None, live, seg, cap, t: SQLType | None):
+    """Per-segment reduction -> (data[cap], valid[cap]) given segment ids."""
+    if spec.func == "count_rows":
+        data = jax.ops.segment_sum(live.astype(jnp.int64), seg, num_segments=cap)
+        return data, jnp.ones((cap,), jnp.bool_)
+    contributes = live & col.valid
+    if spec.func == "count":
+        data = jax.ops.segment_sum(contributes.astype(jnp.int64), seg, num_segments=cap)
+        return data, jnp.ones((cap,), jnp.bool_)
+    cnt = jax.ops.segment_sum(contributes.astype(jnp.int32), seg, num_segments=cap)
+    nonempty = cnt > 0
+    if spec.func in ("sum", "avg"):
+        if t.family is Family.FLOAT or spec.func == "avg":
+            vals = jnp.where(contributes, col.data.astype(jnp.float64), 0.0)
+            s = jax.ops.segment_sum(vals, seg, num_segments=cap)
+            if spec.func == "avg":
+                denom = jnp.where(nonempty, cnt, 1).astype(jnp.float64)
+                avg = s / denom
+                if t.family is Family.DECIMAL:
+                    avg = avg / (10.0**t.scale)
+                return avg, nonempty
+            return s, nonempty
+        vals = jnp.where(contributes, col.data.astype(jnp.int64), 0)
+        return jax.ops.segment_sum(vals, seg, num_segments=cap), nonempty
+    if spec.func in ("min", "max"):
+        is_min = spec.func == "min"
+        sent = _minmax_sentinel(col.data.dtype, is_min)
+        vals = jnp.where(contributes, col.data, sent)
+        fn = jax.ops.segment_min if is_min else jax.ops.segment_max
+        return fn(vals, seg, num_segments=cap), nonempty
+    if spec.func == "any_not_null":
+        sent = _minmax_sentinel(col.data.dtype, False)
+        vals = jnp.where(contributes, col.data, sent)
+        return jax.ops.segment_max(vals, seg, num_segments=cap), nonempty
+    raise ValueError(f"unknown aggregate {spec.func}")
+
+
+def sort_groupby(
+    batch: Batch,
+    schema: Schema,
+    group_cols: tuple[int, ...],
+    aggs: tuple[AggSpec, ...],
+    out_capacity: int | None = None,
+) -> tuple[Batch, jax.Array]:
+    """General grouped aggregation over one tile. Output tile: one live row per
+    group (group key columns first, then aggregates), padded to capacity.
+
+    Returns (batch, num_groups). If num_groups > out_capacity the output is
+    truncated and the caller must retry with a larger tile (same capacity-
+    bucketing contract as hash_join_general)."""
+    cap = batch.capacity
+    cap_out = out_capacity or cap
+    live = batch.mask
+
+    # Sort live rows first, then by group keys (nulls are their own group).
+    operands = [~live]
+    for gi in group_cols:
+        c = batch.cols[gi]
+        operands.append(~c.valid)
+        operands.append(c.data)
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    num_keys = len(operands)
+    sorted_ops = jax.lax.sort(operands + [perm], num_keys=num_keys, is_stable=True)
+    perm = sorted_ops[-1]
+
+    live_s = live[perm]
+    keys_s = [
+        (batch.cols[gi].data[perm], batch.cols[gi].valid[perm]) for gi in group_cols
+    ]
+
+    #
+
+    idx = jnp.arange(cap)
+    changed = jnp.zeros((cap,), jnp.bool_)
+    for kd, kv in keys_s:
+        prev_d = jnp.roll(kd, 1, axis=0)
+        prev_v = jnp.roll(kv, 1, axis=0)
+        # two NULLs are the same group regardless of underlying data
+        neq = (kv != prev_v) | (kv & prev_v & (kd != prev_d))
+        changed = changed | neq
+    prev_live = jnp.roll(live_s, 1)
+    boundary = live_s & ((idx == 0) | changed | ~prev_live)
+    num_groups = jnp.sum(boundary, dtype=jnp.int32)
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg = jnp.maximum(seg, 0)
+
+    out_cols: list[Column] = []
+    out_mask = jnp.arange(cap_out, dtype=jnp.int32) < num_groups
+
+    # Group key columns: scatter the boundary row's key into its segment slot.
+    dest = jnp.where(boundary, seg, cap_out)
+    for kd, kv in keys_s:
+        data = jnp.zeros((cap_out,), kd.dtype).at[dest].set(kd, mode="drop")
+        valid = jnp.zeros((cap_out,), jnp.bool_).at[dest].set(kv, mode="drop")
+        out_cols.append(Column(data=data, valid=valid))
+
+    for spec in aggs:
+        col = None
+        t = None
+        if spec.col is not None:
+            t = schema.types[spec.col]
+            col = Column(
+                data=batch.cols[spec.col].data[perm],
+                valid=batch.cols[spec.col].valid[perm],
+            )
+        data, valid = _segment_agg(spec, col, live_s, seg, cap_out, t)
+        out_cols.append(Column(data=data, valid=valid & out_mask))
+
+    return Batch(cols=tuple(out_cols), mask=out_mask), num_groups
+
+
+def groupby_output_schema(
+    schema: Schema, group_cols: tuple[int, ...], aggs: tuple[AggSpec, ...]
+) -> Schema:
+    names = [schema.names[i] for i in group_cols]
+    types = [schema.types[i] for i in group_cols]
+    for spec in aggs:
+        names.append(spec.name or f"{spec.func}_{spec.col}")
+        types.append(agg_output_type(spec, schema))
+    return Schema(tuple(names), tuple(types))
+
+
+def smallgroup_groupby(
+    batch: Batch,
+    schema: Schema,
+    code_col: int,
+    num_groups: int,
+    aggs: tuple[AggSpec, ...],
+) -> Batch:
+    """Aggregation when the planner knows group ids are dense codes in
+    [0, num_groups) (from dictionary codes or packed key codes). One-hot
+    membership + masked reductions; exact for int64; no sort.
+
+    Output tile capacity == num_groups (static); group id g lands in row g.
+    The caller decodes row index -> key values via host-side tables."""
+    G = num_groups
+    live = batch.mask
+    codes = jnp.clip(batch.cols[code_col].data.astype(jnp.int32), 0, G - 1)
+    onehot = (codes[:, None] == jnp.arange(G, dtype=jnp.int32)[None, :]) & live[:, None]
+
+    group_rows = jnp.sum(onehot, axis=0, dtype=jnp.int64)  # [G]
+    out_mask = group_rows > 0
+
+    out_cols: list[Column] = []
+    # group id column (dense code) so callers can decode keys
+    out_cols.append(
+        Column(data=jnp.arange(G, dtype=jnp.int32), valid=jnp.ones((G,), jnp.bool_))
+    )
+
+    for spec in aggs:
+        if spec.func == "count_rows":
+            out_cols.append(Column(data=group_rows, valid=jnp.ones((G,), jnp.bool_)))
+            continue
+        col = batch.cols[spec.col]
+        t = schema.types[spec.col]
+        member = onehot & col.valid[:, None]  # [cap, G]
+        cnt = jnp.sum(member, axis=0, dtype=jnp.int64)
+        nonempty = cnt > 0
+        if spec.func == "count":
+            out_cols.append(Column(data=cnt, valid=jnp.ones((G,), jnp.bool_)))
+        elif spec.func in ("sum", "avg"):
+            if t.family is Family.FLOAT or spec.func == "avg":
+                v = jnp.where(member, col.data.astype(jnp.float64)[:, None], 0.0)
+                s = jnp.sum(v, axis=0)
+                if spec.func == "avg":
+                    avg = s / jnp.where(nonempty, cnt, 1).astype(jnp.float64)
+                    if t.family is Family.DECIMAL:
+                        avg = avg / (10.0**t.scale)
+                    out_cols.append(Column(data=avg, valid=nonempty))
+                else:
+                    out_cols.append(Column(data=s, valid=nonempty))
+            else:
+                v = jnp.where(member, col.data.astype(jnp.int64)[:, None], 0)
+                out_cols.append(Column(data=jnp.sum(v, axis=0), valid=nonempty))
+        elif spec.func in ("min", "max"):
+            is_min = spec.func == "min"
+            sent = _minmax_sentinel(col.data.dtype, is_min)
+            v = jnp.where(member, col.data[:, None], sent)
+            red = jnp.min(v, axis=0) if is_min else jnp.max(v, axis=0)
+            out_cols.append(Column(data=red, valid=nonempty))
+        elif spec.func == "any_not_null":
+            sent = _minmax_sentinel(col.data.dtype, False)
+            v = jnp.where(member, col.data[:, None], sent)
+            out_cols.append(Column(data=jnp.max(v, axis=0), valid=nonempty))
+        else:
+            raise ValueError(f"unknown aggregate {spec.func}")
+
+    return Batch(cols=tuple(out_cols), mask=out_mask)

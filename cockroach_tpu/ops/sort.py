@@ -1,0 +1,115 @@
+"""Sort / top-k kernels — the colexec Sorter analog.
+
+Reference: pkg/sql/colexec/sort.go:26 (NewSorter) spools all input then runs a
+pdqsort per type (pdqsort.eg.go); sorttopk.go keeps a heap of K. On TPU both
+become XLA's native sort over order-preserving uint64 key transforms:
+
+- every key column maps to a uint64 whose unsigned order equals SQL order
+  (ints: sign-flip bitcast; floats: IEEE total-order trick; strings: host-
+  prepared dictionary rank gather — coldata.Dictionary.ranks);
+- DESC inverts bits; NULL ordering is a leading bool key (CockroachDB sorts
+  NULLs first ascending — tree.Datum ordering);
+- dead rows sort last via a leading ~mask key, so output is also compacted.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..coldata.batch import Batch, Column
+from ..coldata.types import Family, Schema, SQLType
+
+
+@dataclass(frozen=True)
+class SortKey:
+    col: int
+    desc: bool = False
+    # CockroachDB semantics: NULLs order first ascending, last descending.
+    nulls_first: bool | None = None
+
+    def effective_nulls_first(self) -> bool:
+        return (not self.desc) if self.nulls_first is None else self.nulls_first
+
+
+def order_keys(
+    data: jax.Array,
+    valid: jax.Array,
+    k: "SortKey",
+    t: SQLType,
+    rank_table: np.ndarray | None = None,
+) -> list[jax.Array]:
+    """Sort-key operands whose ascending order equals SQL order for this key.
+
+    TPU note: the X64 rewriter cannot bitcast f64<->u64, so floats sort as
+    native float keys (with an explicit NaN flag — CockroachDB orders NaN
+    before all other values) instead of the classic IEEE bit-trick. Integer
+    families use sign-flipped uint64; DESC inverts bits / negates.
+    """
+    nf = k.effective_nulls_first()
+    null_key = valid if nf else ~valid  # False sorts first
+    if t.family is Family.STRING:
+        assert rank_table is not None, "STRING sort needs a dictionary rank table"
+        table = jnp.asarray(rank_table)
+        codes = jnp.clip(data, 0, table.shape[0] - 1)
+        u = table[codes].astype(jnp.int32)
+        return [null_key, -u if k.desc else u]
+    if t.family is Family.FLOAT:
+        d = data.astype(jnp.float64)
+        isnan = jnp.isnan(d)
+        nan_key = isnan if k.desc else ~isnan  # NaN smallest in SQL order
+        d = jnp.where(isnan, 0.0, d)
+        return [null_key, nan_key, -d if k.desc else d]
+    if t.family is Family.BOOL:
+        key = data
+        return [null_key, key != k.desc]
+    u = data.astype(jnp.int64).astype(jnp.uint64) ^ np.uint64(0x8000000000000000)
+    if k.desc:
+        u = ~u
+    return [null_key, u]
+
+
+def sort_perm(
+    batch: Batch,
+    schema: Schema,
+    keys: tuple[SortKey, ...],
+    rank_tables: dict[int, np.ndarray] | None = None,
+) -> jax.Array:
+    """Stable permutation ordering live rows by keys, dead rows last."""
+    rank_tables = rank_tables or {}
+    cap = batch.capacity
+    operands: list[jax.Array] = [~batch.mask]
+    for k in keys:
+        c = batch.cols[k.col]
+        t = schema.types[k.col]
+        operands.extend(order_keys(c.data, c.valid, k, t, rank_tables.get(k.col)))
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    res = jax.lax.sort(operands + [perm], num_keys=len(operands), is_stable=True)
+    return res[-1]
+
+
+def apply_perm(batch: Batch, perm: jax.Array) -> Batch:
+    cols = tuple(
+        Column(data=c.data[perm], valid=c.valid[perm]) for c in batch.cols
+    )
+    return Batch(cols=cols, mask=batch.mask[perm])
+
+
+def sort_batch(
+    batch: Batch,
+    schema: Schema,
+    keys: tuple[SortKey, ...],
+    rank_tables: dict[int, np.ndarray] | None = None,
+) -> Batch:
+    return apply_perm(batch, sort_perm(batch, schema, keys, rank_tables))
+
+
+def limit_mask(batch: Batch, limit: int, offset: int = 0) -> Batch:
+    """LIMIT/OFFSET over live rows in tile order (apply after sort_batch,
+    whose output is compacted). Reference: colexec limit/offset ops."""
+    pos = jnp.cumsum(batch.mask.astype(jnp.int32)) - 1  # rank among live rows
+    keep = batch.mask & (pos >= offset) & (pos < offset + limit)
+    return batch.with_mask(keep)

@@ -1,0 +1,179 @@
+"""Aggregation and sort kernel tests, verified against numpy oracles
+(the oracle pattern from pkg/sql/distsql/columnar_operators_test.go:
+engine result must equal a trusted host computation)."""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu import coldata as cd
+from cockroach_tpu.ops import aggregation as agg
+from cockroach_tpu.ops import sort as srt
+
+
+def groupby_oracle(keys, vals, valid):
+    """dict: key-tuple -> (sum_valid, count_valid, min, max, n_rows)"""
+    out = {}
+    for i in range(len(vals)):
+        k = tuple(keys[j][i] for j in range(len(keys)))
+        s = out.setdefault(k, [0, 0, None, None, 0])
+        s[4] += 1
+        if valid[i]:
+            s[0] += vals[i]
+            s[1] += 1
+            s[2] = vals[i] if s[2] is None else min(s[2], vals[i])
+            s[3] = vals[i] if s[3] is None else max(s[3], vals[i])
+    return out
+
+
+@pytest.mark.parametrize("n,cap", [(50, 64), (1000, 1024)])
+def test_sort_groupby_vs_oracle(rng, n, cap):
+    schema = cd.Schema.of(g=cd.INT64, h=cd.INT32, v=cd.INT64)
+    g = rng.integers(0, 7, n)
+    h = rng.integers(0, 3, n).astype(np.int32)
+    v = rng.integers(-100, 100, n)
+    vv = rng.random(n) > 0.2
+    b = cd.from_host(
+        schema, {"g": g, "h": h, "v": v}, valids={"v": vv}, capacity=cap
+    )
+    specs = (
+        agg.AggSpec("sum", 2, "s"),
+        agg.AggSpec("count", 2, "c"),
+        agg.AggSpec("min", 2, "mn"),
+        agg.AggSpec("max", 2, "mx"),
+        agg.AggSpec("count_rows", None, "n"),
+    )
+    out, ng = agg.sort_groupby(b, schema, (0, 1), specs)
+    out_schema = agg.groupby_output_schema(schema, (0, 1), specs)
+    res = cd.to_host(out, out_schema)
+    oracle = groupby_oracle([g, h], v, vv)
+    assert len(res["g"]) == len(oracle)
+    got = {}
+    for i in range(len(res["g"])):
+        got[(res["g"][i], res["h"][i])] = (
+            res["s"][i],
+            res["c"][i],
+            res["mn"][i],
+            res["mx"][i],
+            res["n"][i],
+        )
+    for k, (s, c, mn, mx, nr) in oracle.items():
+        gs, gc, gmn, gmx, gn = got[k]
+        assert gc == c and gn == nr
+        if c > 0:
+            assert gs == s and gmn == mn and gmx == mx
+        else:
+            assert gs is None and gmn is None and gmx is None
+
+
+def test_groupby_null_keys_form_group(rng):
+    schema = cd.Schema.of(g=cd.INT64, v=cd.INT64)
+    g = np.array([1, 1, 2, 0, 0])
+    gv = np.array([True, True, True, False, False])
+    v = np.arange(5)
+    b = cd.from_host(schema, {"g": g, "v": v}, valids={"g": gv}, capacity=8)
+    out, ng = agg.sort_groupby(b, schema, (0,), (agg.AggSpec("sum", 1, "s"),))
+    out_schema = agg.groupby_output_schema(schema, (0,), (agg.AggSpec("sum", 1, "s"),))
+    res = cd.to_host(out, out_schema)
+    assert int(ng) == 3 and len(res["g"]) == 3  # groups: 1, 2, NULL
+    bykey = {}
+    for i in range(3):
+        bykey[res["g"][i]] = res["s"][i]
+    assert bykey[1] == 1 and bykey[2] == 2 and bykey[None] == 7
+
+
+def test_smallgroup_groupby(rng):
+    schema = cd.Schema.of(code=cd.INT32, v=cd.INT64, f=cd.FLOAT64)
+    n = 500
+    code = rng.integers(0, 6, n).astype(np.int32)
+    v = rng.integers(0, 1000, n)
+    f = rng.random(n)
+    b = cd.from_host(schema, {"code": code, "v": v, "f": f}, capacity=512)
+    out = agg.smallgroup_groupby(
+        b,
+        schema,
+        0,
+        6,
+        (
+            agg.AggSpec("sum", 1, "s"),
+            agg.AggSpec("avg", 2, "a"),
+            agg.AggSpec("count_rows", None, "n"),
+        ),
+    )
+    assert out.capacity == 6
+    data_s = np.asarray(out.cols[1].data)
+    data_a = np.asarray(out.cols[2].data)
+    data_n = np.asarray(out.cols[3].data)
+    for gcode in range(6):
+        sel = code == gcode
+        assert data_s[gcode] == v[sel].sum()
+        np.testing.assert_allclose(data_a[gcode], f[sel].mean())
+        assert data_n[gcode] == sel.sum()
+
+
+def test_sort_multi_key_desc_nulls(rng):
+    schema = cd.Schema.of(a=cd.INT64, b=cd.FLOAT64)
+    a = np.array([3, 1, 2, 1, 3, 0])
+    av = np.array([True, True, True, True, True, False])
+    bv = np.array([0.5, 2.5, -1.5, 1.0, -0.5, 9.9])
+    b = cd.from_host(schema, {"a": a, "b": bv}, valids={"a": av}, capacity=8)
+    out = srt.sort_batch(
+        b, schema, (srt.SortKey(0, desc=False), srt.SortKey(1, desc=True))
+    )
+    res = cd.to_host(out, schema)
+    # NULL first (asc), then 1 (b desc: 2.5 then 1.0), 2, 3 (0.5 then -0.5)
+    assert res["a"][0] is None
+    np.testing.assert_array_equal(list(res["a"][1:]), [1, 1, 2, 3, 3])
+    np.testing.assert_allclose(list(res["b"][1:]), [2.5, 1.0, -1.5, 0.5, -0.5])
+
+
+def test_sort_string_ranks():
+    dic = cd.Dictionary(np.array(["pear", "apple", "mango"], dtype=object))
+    schema = cd.Schema.of(s=cd.STRING)
+    b = cd.from_host(schema, {"s": np.array([0, 1, 2], dtype=np.int32)}, capacity=4)
+    out = srt.sort_batch(
+        b, schema, (srt.SortKey(0),), rank_tables={0: dic.ranks}
+    )
+    res = cd.to_host(out, schema, dictionaries={0: dic})
+    np.testing.assert_array_equal(list(res["s"]), ["apple", "mango", "pear"])
+
+
+def test_limit_offset():
+    schema = cd.Schema.of(x=cd.INT64)
+    b = cd.from_host(schema, {"x": np.arange(10)}, capacity=16)
+    out = srt.limit_mask(b, limit=3, offset=2)
+    res = cd.to_host(out, schema)
+    np.testing.assert_array_equal(res["x"], [2, 3, 4])
+
+
+def test_float_sort_total_order(rng):
+    schema = cd.Schema.of(f=cd.FLOAT64)
+    f = np.array([0.0, -0.0, 1.5, -1.5, np.inf, -np.inf])
+    b = cd.from_host(schema, {"f": f}, capacity=8)
+    out = srt.sort_batch(b, schema, (srt.SortKey(0),))
+    res = cd.to_host(out, schema)
+    np.testing.assert_array_equal(
+        res["f"], [-np.inf, -1.5, -0.0, 0.0, 1.5, np.inf]
+    )
+
+
+def test_null_group_ignores_underlying_data():
+    # NULL keys with differing garbage data beneath must form ONE group
+    schema = cd.Schema.of(g=cd.INT64, v=cd.INT64)
+    b = cd.from_host(
+        schema,
+        {"g": np.array([1, 5, 7]), "v": np.array([10, 20, 30])},
+        valids={"g": np.array([True, False, False])},
+        capacity=8,
+    )
+    out, ng = agg.sort_groupby(b, schema, (0,), (agg.AggSpec("sum", 1, "s"),))
+    assert int(ng) == 2
+    res = cd.to_host(out, agg.groupby_output_schema(schema, (0,), (agg.AggSpec("sum", 1, "s"),)))
+    bykey = dict(zip(res["g"], res["s"]))
+    assert bykey[1] == 10 and bykey[None] == 50
+
+
+def test_groupby_overflow_reports_count(rng):
+    schema = cd.Schema.of(g=cd.INT64, v=cd.INT64)
+    b = cd.from_host(schema, {"g": np.arange(5), "v": np.ones(5, dtype=np.int64)}, capacity=8)
+    out, ng = agg.sort_groupby(b, schema, (0,), (agg.AggSpec("sum", 1, "s"),), out_capacity=4)
+    assert int(ng) == 5  # caller must re-bucket: only 4 groups fit

@@ -1,0 +1,80 @@
+"""Columnar substrate tests (reference analog: pkg/col/coldata tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cockroach_tpu import coldata as cd
+
+
+def make_batch(n=10, cap=16):
+    schema = cd.Schema.of(a=cd.INT64, b=cd.FLOAT64, s=cd.STRING)
+    arrays = {
+        "a": np.arange(n, dtype=np.int64),
+        "b": np.arange(n, dtype=np.float64) * 0.5,
+        "s": np.arange(n, dtype=np.int32) % 3,
+    }
+    return schema, cd.from_host(schema, arrays, capacity=cap)
+
+
+def test_from_host_roundtrip():
+    schema, b = make_batch()
+    assert b.capacity == 16
+    assert int(b.length()) == 10
+    out = cd.to_host(b, schema)
+    np.testing.assert_array_equal(out["a"], np.arange(10))
+    np.testing.assert_allclose(out["b"], np.arange(10) * 0.5)
+
+
+def test_mask_and_compact():
+    schema, b = make_batch()
+    keep = jnp.asarray(np.arange(16) % 2 == 0) & b.mask
+    b2 = b.with_mask(keep)
+    assert int(b2.length()) == 5
+    c = cd.compact(b2)
+    m = np.asarray(c.mask)
+    assert m[:5].all() and not m[5:].any()
+    out = cd.to_host(c, schema)
+    np.testing.assert_array_equal(out["a"], [0, 2, 4, 6, 8])
+
+
+def test_compact_shrink_capacity():
+    schema, b = make_batch(n=4, cap=64)
+    c = cd.compact(b, capacity=8)
+    assert c.capacity == 8
+    out = cd.to_host(c, schema)
+    np.testing.assert_array_equal(out["a"], np.arange(4))
+
+
+def test_nulls_roundtrip():
+    schema = cd.Schema.of(x=cd.INT64)
+    v = np.array([True, False, True])
+    b = cd.from_host(schema, {"x": np.array([1, 2, 3])}, valids={"x": v}, capacity=8)
+    out = cd.to_host(b, schema)
+    assert out["x"][0] == 1 and out["x"][1] is None and out["x"][2] == 3
+
+
+def test_concat():
+    schema, b1 = make_batch(n=3, cap=8)
+    _, b2 = make_batch(n=4, cap=8)
+    c = cd.concat([b1, b2], capacity=16)
+    assert int(c.length()) == 7
+    out = cd.to_host(c, schema)
+    np.testing.assert_array_equal(out["a"], [0, 1, 2, 0, 1, 2, 3])
+
+
+def test_dictionary():
+    d = cd.Dictionary(np.array(["cherry", "apple", "banana"], dtype=object))
+    assert d.code_of("apple") == 1
+    assert d.code_of("missing") == -1
+    # ranks reflect sorted byte order
+    assert d.ranks[1] < d.ranks[2] < d.ranks[0]
+    dec = d.decode(np.array([2, 0, -1]))
+    assert list(dec[:2]) == ["banana", "cherry"] and dec[2] is None
+
+
+def test_dictionary_hash_cross_table():
+    d1 = cd.Dictionary(np.array(["x", "y"], dtype=object))
+    d2 = cd.Dictionary(np.array(["y", "x"], dtype=object))
+    assert d1.hashes[0] == d2.hashes[1]
+    assert d1.hashes[1] == d2.hashes[0]
+    assert d1.hashes[0] != d1.hashes[1]

@@ -1,0 +1,105 @@
+"""Expression eval tests — selection/projection semantics incl. 3-valued logic
+(reference analog: colexecsel/colexecproj generated kernel behavior)."""
+
+import numpy as np
+
+from cockroach_tpu import coldata as cd
+from cockroach_tpu.ops import expr as ex
+
+
+def setup_batch():
+    schema = cd.Schema.of(
+        a=cd.INT64, b=cd.FLOAT64, d=cd.DECIMAL(10, 2), dt=cd.DATE
+    )
+    arrays = {
+        "a": np.array([1, 2, 3, 4, 5]),
+        "b": np.array([0.5, 1.5, 2.5, 3.5, 4.5]),
+        "d": np.array([100, 250, 399, 1000, 5]),  # 1.00 2.50 3.99 10.00 0.05
+        "dt": np.array([0, 365, 10956, 10957, 19000], dtype=np.int32),
+    }
+    valids = {"a": np.array([True, True, False, True, True])}
+    return schema, cd.from_host(schema, arrays, valids=valids, capacity=8)
+
+
+def test_filter_cmp_with_nulls():
+    schema, b = setup_batch()
+    # a > 1 : rows 1,3,4 true; row 2 NULL (excluded); row 0 false
+    m = ex.filter_mask(b, schema, ex.Cmp("gt", ex.ColRef(0), ex.lit(1)))
+    np.testing.assert_array_equal(np.asarray(m)[:5], [False, True, False, True, True])
+
+
+def test_decimal_compare_and_arith():
+    schema, b = setup_batch()
+    # d <= 3.99 -> rows 0,1,2,4
+    pred = ex.Cmp("le", ex.ColRef(2), ex.Const(3.99, cd.DECIMAL(10, 2)))
+    m = ex.filter_mask(b, schema, pred)
+    np.testing.assert_array_equal(np.asarray(m)[:5], [True, True, True, False, True])
+    # d * d has scale 4
+    t = ex.expr_type(ex.BinOp("*", ex.ColRef(2), ex.ColRef(2)), schema)
+    assert t.scale == 4
+    d, v = ex.eval_expr(ex.BinOp("*", ex.ColRef(2), ex.ColRef(2)), b.cols, schema)
+    assert int(np.asarray(d)[1]) == 62500  # 2.50^2 = 6.25 at scale 4
+
+
+def test_kleene_and_or():
+    schema = cd.Schema.of(x=cd.BOOL, y=cd.BOOL)
+    xv = np.array([True, True, True, False, False, False, True, False, True])
+    xn = np.array([True, True, True, True, True, True, False, False, False])
+    yv = np.array([True, False, False, True, False, True, True, False, False])
+    yn = np.array([True, True, False, True, True, False, True, True, False])
+    b = cd.from_host(
+        schema, {"x": xv, "y": yv}, valids={"x": xn, "y": yn}, capacity=16
+    )
+    d, v = ex.eval_expr(ex.and_(ex.ColRef(0), ex.ColRef(1)), b.cols, schema)
+    d, v = np.asarray(d)[:9], np.asarray(v)[:9]
+    # NULL AND false = false (known); NULL AND true = NULL
+    assert v[7] and not d[7]  # x NULL, y false -> false
+    assert not v[6]  # x NULL, y true -> NULL
+    assert not v[8]  # NULL AND NULL -> NULL
+    assert v[0] and d[0]
+    assert v[1] and not d[1]
+    do, vo = ex.eval_expr(ex.or_(ex.ColRef(0), ex.ColRef(1)), b.cols, schema)
+    do, vo = np.asarray(do)[:9], np.asarray(vo)[:9]
+    assert vo[6] and do[6]  # NULL OR true -> true
+    assert not vo[7]  # NULL OR false -> NULL
+    assert vo[0] and do[0]
+
+
+def test_case_and_cast():
+    schema, b = setup_batch()
+    e = ex.Case(
+        whens=((ex.Cmp("gt", ex.ColRef(0), ex.lit(3)), ex.lit(100)),),
+        otherwise=ex.lit(0),
+    )
+    d, v = ex.eval_expr(e, b.cols, schema)
+    np.testing.assert_array_equal(np.asarray(d)[:5], [0, 0, 0, 100, 100])
+    c = ex.Cast(ex.ColRef(2), cd.FLOAT64)
+    d, v = ex.eval_expr(c, b.cols, schema)
+    np.testing.assert_allclose(np.asarray(d)[:5], [1.0, 2.5, 3.99, 10.0, 0.05])
+
+
+def test_extract_year():
+    schema, b = setup_batch()
+    d, v = ex.eval_expr(ex.ExtractYear(ex.ColRef(3)), b.cols, schema)
+    np.testing.assert_array_equal(np.asarray(d)[:5], [1970, 1971, 1999, 2000, 2022])
+    # day 10956 = 1999-12-31, day 10957 = 2000-01-01 (7 leap days in 1970-1999)
+
+
+def test_division_by_zero_is_null():
+    schema = cd.Schema.of(x=cd.INT64, y=cd.INT64)
+    b = cd.from_host(
+        schema, {"x": np.array([10, 10]), "y": np.array([2, 0])}, capacity=4
+    )
+    d, v = ex.eval_expr(ex.BinOp("/", ex.ColRef(0), ex.ColRef(1)), b.cols, schema)
+    assert np.asarray(d)[0] == 5.0
+    assert not np.asarray(v)[1]
+
+
+def test_code_lookup_string_predicate():
+    # s LIKE '%an%' pre-evaluated per dictionary code on host
+    dic = cd.Dictionary(np.array(["apple", "banana", "mango"], dtype=object))
+    table = np.array(["an" in str(s) for s in dic.values])
+    schema = cd.Schema.of(s=cd.STRING)
+    b = cd.from_host(schema, {"s": np.array([0, 1, 2, 1], dtype=np.int32)}, capacity=8)
+    m = ex.filter_mask(b, schema, ex.CodeLookup(col=0, table=table))
+    np.testing.assert_array_equal(np.asarray(m)[:4], [False, True, True, True])
