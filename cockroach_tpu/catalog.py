@@ -1,0 +1,91 @@
+"""Table catalog — host-side table storage feeding device scans.
+
+The reference reads tables from the KV layer through cFetcher
+(pkg/sql/colfetcher/cfetcher.go:230); here a Table holds canonical-typed host
+columns (strings already dictionary-encoded) plus per-column Dictionaries, and
+materializes a device-resident padded Batch once (the "table is in HBM" model
+— the TPU analog of a warmed block cache). The storage layer (cockroach_tpu/
+storage) layers MVCC versions and SST-style runs beneath this.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from .coldata.batch import Batch, Column, Dictionary, from_host
+from .coldata.types import Family, Schema
+
+TILE_ALIGN = 1024  # pad device tables to a multiple of this (8x128 lanes)
+
+
+def _pad_cap(n: int) -> int:
+    return max(TILE_ALIGN, ((n + TILE_ALIGN - 1) // TILE_ALIGN) * TILE_ALIGN)
+
+
+@dataclass
+class Table:
+    name: str
+    schema: Schema
+    columns: dict[str, np.ndarray]
+    valids: dict[str, np.ndarray] = field(default_factory=dict)
+    dictionaries: dict[str, Dictionary] = field(default_factory=dict)
+    _device: Batch | None = None
+
+    @property
+    def num_rows(self) -> int:
+        return len(next(iter(self.columns.values())))
+
+    def dict_by_index(self) -> dict[int, Dictionary]:
+        return {
+            self.schema.index(name): d for name, d in self.dictionaries.items()
+        }
+
+    def device_batch(self) -> Batch:
+        if self._device is None:
+            cap = _pad_cap(self.num_rows)
+            self._device = from_host(
+                self.schema, self.columns, valids=self.valids, capacity=cap
+            )
+        return self._device
+
+    @staticmethod
+    def from_strings(
+        name: str,
+        schema: Schema,
+        raw: dict[str, np.ndarray],
+        valids: dict[str, np.ndarray] | None = None,
+    ) -> "Table":
+        """Build a table from raw host columns, dictionary-encoding STRING
+        columns (object/str arrays -> int32 codes + Dictionary)."""
+        cols: dict[str, np.ndarray] = {}
+        dicts: dict[str, Dictionary] = {}
+        for cname, t in zip(schema.names, schema.types):
+            a = raw[cname]
+            if t.family is Family.STRING and a.dtype.kind in ("O", "U", "S"):
+                values, codes = np.unique(a.astype(str), return_inverse=True)
+                dicts[cname] = Dictionary(values.astype(object))
+                cols[cname] = codes.astype(np.int32)
+            else:
+                cols[cname] = a
+        return Table(
+            name=name,
+            schema=schema,
+            columns=cols,
+            valids=valids or {},
+            dictionaries=dicts,
+        )
+
+
+class Catalog:
+    def __init__(self):
+        self.tables: dict[str, Table] = {}
+
+    def add(self, table: Table) -> Table:
+        self.tables[table.name] = table
+        return table
+
+    def get(self, name: str) -> Table:
+        return self.tables[name]

@@ -96,9 +96,7 @@ class Dictionary:
         ranks = np.empty(len(self.values), dtype=np.int32)
         ranks[order] = np.arange(len(self.values), dtype=np.int32)
         self.ranks = ranks
-        self.hashes = np.array(
-            [_string_hash64(str(v)) for v in self.values], dtype=np.uint64
-        )
+        self.hashes = _fnv64_batch(self.values)
 
     def __len__(self) -> int:
         return len(self.values)
@@ -116,14 +114,36 @@ class Dictionary:
         return out
 
 
-def _string_hash64(s: str) -> int:
-    """FNV-1a 64-bit over utf-8 bytes; deterministic across processes."""
-    h = np.uint64(0xCBF29CE484222325)
+def _fnv64_batch(values: np.ndarray) -> np.ndarray:
+    """FNV-1a 64-bit over utf-8 bytes for an array of strings, vectorized:
+    one masked pass per byte position over the whole dictionary.
+    Deterministic across processes (unlike Python's hash())."""
+    encoded = [str(v).encode("utf-8") for v in values]
+    n = len(encoded)
+    if n == 0:
+        return np.zeros(0, dtype=np.uint64)
+    lens = np.array([len(b) for b in encoded], dtype=np.int64)
+    maxlen = max(1, int(lens.max()))
+    # Sort by length descending so byte-position i only touches a prefix:
+    # total work is O(sum of lengths), immune to one long outlier string.
+    order = np.argsort(-lens, kind="stable")
+    flat = np.frombuffer(b"".join(encoded[j] for j in order), dtype=np.uint8)
+    sorted_lens = lens[order]
+    starts = np.concatenate([[0], np.cumsum(sorted_lens[:-1])])
+    # rows with len > i form the prefix [0, counts[i])
+    asc = sorted_lens[::-1]
+    counts = n - np.searchsorted(asc, np.arange(maxlen), side="right")
+    h = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
     prime = np.uint64(0x100000001B3)
     with np.errstate(over="ignore"):
-        for b in s.encode("utf-8"):
-            h = (h ^ np.uint64(b)) * prime
-    return int(h)
+        for i in range(maxlen):
+            c = int(counts[i])
+            if c == 0:
+                break
+            h[:c] = (h[:c] ^ flat[starts[:c] + i]) * prime
+    out = np.empty_like(h)
+    out[order] = h
+    return out
 
 
 def empty_batch(schema: Schema, capacity: int = DEFAULT_CAPACITY) -> Batch:

@@ -1,0 +1,62 @@
+"""The Operator contract — the colexecop.Operator analog.
+
+Reference: pkg/sql/colexecop/operator.go:21 — ``Operator { Init(ctx);
+Next() coldata.Batch }``, pull-based, zero-length batch means exhausted. Here
+``next_batch() -> Batch | None`` returns device-resident tiles; None means
+exhausted. Device work inside an operator is jitted once per operator
+instance (tiles share static shapes, so each op compiles exactly once).
+
+Operators also surface plan-static metadata the reference carries in specs:
+``output_schema`` and per-column string ``dictionaries`` (the host half of the
+columnar string representation).
+"""
+
+from __future__ import annotations
+
+from ..coldata.batch import Batch, Dictionary
+from ..coldata.types import Schema
+
+
+class Operator:
+    """Base pull operator. Subclasses set output_schema/dictionaries in
+    __init__ and implement _next()."""
+
+    output_schema: Schema
+    dictionaries: dict[int, Dictionary]
+
+    def __init__(self):
+        self.dictionaries = {}
+        self._initialized = False
+
+    def init(self) -> None:
+        """Init(ctx) analog — called once before the first next_batch."""
+        self._initialized = True
+
+    def next_batch(self) -> Batch | None:
+        if not self._initialized:
+            self.init()
+        return self._next()
+
+    def _next(self) -> Batch | None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Closer analog (colexecop/operator.go:194)."""
+
+
+class SourceOperator(Operator):
+    """An operator with no inputs (scan, inbox)."""
+
+
+class OneInputOperator(Operator):
+    def __init__(self, child: Operator):
+        super().__init__()
+        self.child = child
+        self.dictionaries = dict(child.dictionaries)
+
+    def init(self) -> None:
+        self.child.init()
+        super().init()
+
+    def close(self) -> None:
+        self.child.close()

@@ -1,0 +1,669 @@
+"""Flow operators — the colexec operator set over the Operator contract.
+
+Each operator jits its device work once per instance; tiles have static
+shapes, so every operator compiles exactly once per query. Buffering
+operators (sort, hash-join build, aggregation) spool device-resident tiles,
+mirroring the reference's streaming-vs-buffering split decided in
+colbuilder/execplan.go.
+
+Aggregation decomposes into partial/final stages exactly like CRDB's
+local/final aggregation around a shuffle (distsql_physical_planner.go
+aggregation planning): partial state columns (avg -> sum+count) merge with
+sum/min/max merge functions and finalize into SQL results.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..catalog import Table
+from ..coldata.batch import Batch, Column, concat
+from ..coldata.types import FLOAT64, INT64, Family, Schema, SQLType
+from ..ops import aggregation as agg_ops
+from ..ops import expr as ex
+from ..ops import join as join_ops
+from ..ops import sort as sort_ops
+from .operator import OneInputOperator, Operator, SourceOperator
+
+
+def _next_pow2(n: int) -> int:
+    p = 1024
+    while p < n:
+        p *= 2
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Scan
+
+
+class ScanOp(SourceOperator):
+    """Tile-granular scan over a device-resident table (cFetcher analog —
+    the KV decode already happened at table load)."""
+
+    def __init__(self, table: Table, columns: tuple[str, ...] | None = None,
+                 tile: int | None = None):
+        super().__init__()
+        self.table = table
+        names = columns or table.schema.names
+        self.col_idxs = tuple(table.schema.index(n) for n in names)
+        self.output_schema = table.schema.select(self.col_idxs)
+        full_dicts = table.dict_by_index()
+        self.dictionaries = {
+            i: full_dicts[ci]
+            for i, ci in enumerate(self.col_idxs)
+            if ci in full_dicts
+        }
+        self._batch = None
+        self.tile = tile
+        self._offset = 0
+
+    def init(self):
+        dev = self.table.device_batch()
+        self._batch = dev.select(self.col_idxs)
+        if self.tile is None:
+            self.tile = self._batch.capacity
+        self._slice = jax.jit(
+            lambda b, off: jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_slice_in_dim(x, off, self.tile, axis=0),
+                b,
+            )
+        )
+        self._offset = 0
+        super().init()
+
+    def _next(self):
+        if self._offset >= self._batch.capacity:
+            return None
+        if self.tile == self._batch.capacity:
+            self._offset = self._batch.capacity
+            return self._batch
+        out = self._slice(self._batch, self._offset)
+        self._offset += self.tile
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Streaming ops
+
+
+class FilterOp(OneInputOperator):
+    def __init__(self, child: Operator, predicate: ex.Expr):
+        super().__init__(child)
+        self.output_schema = child.output_schema
+        schema = child.output_schema
+        self._fn = jax.jit(
+            lambda b: b.with_mask(ex.filter_mask(b, schema, predicate))
+        )
+
+    def _next(self):
+        b = self.child.next_batch()
+        return None if b is None else self._fn(b)
+
+
+class ProjectOp(OneInputOperator):
+    def __init__(self, child: Operator, exprs: tuple[ex.Expr, ...],
+                 names: tuple[str, ...]):
+        super().__init__(child)
+        schema = child.output_schema
+        types = tuple(ex.expr_type(e, schema) for e in exprs)
+        self.output_schema = Schema(tuple(names), types)
+        # dictionaries survive only through bare column references
+        self.dictionaries = {
+            i: self.child.dictionaries[e.idx]
+            for i, e in enumerate(exprs)
+            if isinstance(e, ex.ColRef) and e.idx in self.child.dictionaries
+        }
+
+        def fn(b: Batch) -> Batch:
+            cols = []
+            for e in exprs:
+                d, v = ex.eval_expr(e, b.cols, schema)
+                cols.append(Column(data=d, valid=v))
+            return Batch(cols=tuple(cols), mask=b.mask)
+
+        self._fn = jax.jit(fn)
+
+    def _next(self):
+        b = self.child.next_batch()
+        return None if b is None else self._fn(b)
+
+
+class LimitOp(OneInputOperator):
+    def __init__(self, child: Operator, limit: int, offset: int = 0):
+        super().__init__(child)
+        self.output_schema = child.output_schema
+        self.limit = limit
+        self.offset = offset
+        self._seen = 0
+
+        def fn(b: Batch, seen):
+            pos = seen + jnp.cumsum(b.mask.astype(jnp.int32)) - 1
+            keep = b.mask & (pos >= offset) & (pos < offset + limit)
+            return b.with_mask(keep), seen + jnp.sum(b.mask, dtype=jnp.int32)
+
+        self._fn = jax.jit(fn)
+
+    def init(self):
+        super().init()
+        self._seen = jnp.int32(0)
+        self._done = False
+
+    def _next(self):
+        if self._done:
+            return None
+        b = self.child.next_batch()
+        if b is None:
+            return None
+        out, self._seen = self._fn(b, self._seen)
+        if int(self._seen) >= self.offset + self.limit:
+            self._done = True
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Aggregation
+
+
+_MERGE_FUNC = {
+    "sum": "sum",
+    "count": "sum",
+    "count_rows": "sum",
+    "min": "min",
+    "max": "max",
+    "any_not_null": "any_not_null",
+}
+
+
+def partial_layout(
+    schema: Schema, group_cols: tuple[int, ...], aggs: tuple[agg_ops.AggSpec, ...]
+):
+    """The partial-aggregation state layout shared by partial and final
+    stages: group keys first, then state columns (avg -> sum + count).
+
+    Returns (partial_specs, state_schema, final_map) where final_map[j] gives,
+    for output agg j, ('avg', sum_state_idx, count_state_idx) or
+    (func, state_idx) with state indices relative to the first state column."""
+    partial_specs: list[agg_ops.AggSpec] = []
+    final_map = []
+    for spec in aggs:
+        if spec.func == "avg":
+            si = len(partial_specs)
+            t = schema.types[spec.col]
+            sum_t = FLOAT64 if t.family is Family.FLOAT else t
+            partial_specs.append(agg_ops.AggSpec("sum", spec.col, f"_s{si}"))
+            partial_specs.append(agg_ops.AggSpec("count", spec.col, f"_c{si}"))
+            final_map.append(("avg", si, si + 1, t))
+        else:
+            si = len(partial_specs)
+            partial_specs.append(
+                agg_ops.AggSpec(spec.func, spec.col, f"_st{si}")
+            )
+            final_map.append((spec.func, si))
+    state_schema = agg_ops.groupby_output_schema(
+        schema, group_cols, tuple(partial_specs)
+    )
+    return tuple(partial_specs), state_schema, final_map
+
+
+class AggregateOp(OneInputOperator):
+    """GROUP BY aggregation (hashAggregator analog). mode:
+    - complete: input rows -> final results
+    - partial:  input rows -> state columns (feeds an Exchange)
+    - final:    state columns (partial layout) -> final results
+    """
+
+    def __init__(
+        self,
+        child: Operator,
+        group_cols: tuple[int, ...],
+        aggs: tuple[agg_ops.AggSpec, ...],
+        mode: str = "complete",
+        input_schema: Schema | None = None,
+    ):
+        super().__init__(child)
+        self.mode = mode
+        self.group_cols = group_cols
+        self.aggs = aggs
+        # the schema over which aggs/group_cols were written
+        base = input_schema if input_schema is not None else child.output_schema
+        self.base_schema = base
+        self.partial_specs, self.state_schema, self.final_map = partial_layout(
+            base, group_cols, aggs
+        )
+        k = len(group_cols)
+        self.num_keys = k
+        # merge aggregation over the state layout
+        self.merge_group_cols = tuple(range(k))
+        self.merge_specs = tuple(
+            agg_ops.AggSpec(_MERGE_FUNC[s.func], k + i, s.name)
+            for i, s in enumerate(self.partial_specs)
+        )
+        final_schema = self._final_schema(base)
+        self.output_schema = (
+            self.state_schema if mode == "partial" else final_schema
+        )
+        keep = {
+            gi: self.child.dictionaries[gi]
+            for gi in group_cols
+            if gi in self.child.dictionaries
+        }
+        if mode == "final":
+            # child emits state layout; group keys are 0..k-1 already
+            keep = {
+                i: self.child.dictionaries[i]
+                for i in range(k)
+                if i in self.child.dictionaries
+            }
+            self.dictionaries = keep
+        else:
+            self.dictionaries = {
+                group_cols.index(gi): d for gi, d in keep.items()
+            }
+        self._acc = None
+        self._emitted = False
+
+    def _final_schema(self, base: Schema) -> Schema:
+        names = [base.names[i] for i in self.group_cols]
+        types = [base.types[i] for i in self.group_cols]
+        if self.mode == "final":
+            names = list(self.state_schema.names[: self.num_keys])
+            types = list(self.state_schema.types[: self.num_keys])
+        for spec, fm in zip(self.aggs, self.final_map):
+            names.append(spec.name or spec.func)
+            if fm[0] == "avg":
+                types.append(FLOAT64)
+            else:
+                types.append(agg_ops.agg_output_type(spec, self.base_schema))
+        return Schema(tuple(names), tuple(types))
+
+    def init(self):
+        super().init()
+        self._acc = None
+        self._emitted = False
+        schema = self.base_schema
+        gcols = self.group_cols
+        pspecs = self.partial_specs
+        sschema = self.state_schema
+        mcols = self.merge_group_cols
+        mspecs = self.merge_specs
+
+        @functools.partial(jax.jit, static_argnames=("cap",))
+        def partial_fn(b, cap):
+            return agg_ops.sort_groupby(b, schema, gcols, pspecs, out_capacity=cap)
+
+        @functools.partial(jax.jit, static_argnames=("cap",))
+        def merge_fn(acc, part, cap):
+            both = concat([acc, part], capacity=acc.capacity + part.capacity)
+            return agg_ops.sort_groupby(both, sschema, mcols, mspecs, out_capacity=cap)
+
+        self._partial_fn = partial_fn
+        self._merge_fn = merge_fn
+        self._finalize_fn = jax.jit(self._finalize)
+
+    def _finalize(self, state: Batch) -> Batch:
+        k = self.num_keys
+        cols = list(state.cols[:k])
+        for fm in self.final_map:
+            if fm[0] == "avg":
+                _, si, ci, t = fm
+                s = state.cols[k + si]
+                c = state.cols[k + ci]
+                denom = jnp.where(c.data > 0, c.data, 1).astype(jnp.float64)
+                d = s.data.astype(jnp.float64) / denom
+                if t.family is Family.DECIMAL:
+                    d = d / (10.0**t.scale)
+                cols.append(Column(data=d, valid=s.valid & (c.data > 0)))
+            else:
+                cols.append(state.cols[k + fm[1]])
+        return Batch(cols=tuple(cols), mask=state.mask)
+
+    def _ingest(self, b: Batch):
+        cap = _next_pow2(int(b.capacity))
+        if self.mode == "final":
+            part = b  # child already emits state layout
+        else:
+            while True:
+                part, ng = self._partial_fn(b, cap=cap)
+                if int(ng) <= cap:
+                    break
+                cap = _next_pow2(int(ng))
+        if self._acc is None:
+            self._acc = part if part.capacity >= 1024 else concat([part], 1024)
+            return
+        cap = max(self._acc.capacity, part.capacity)
+        while True:
+            merged, ng = self._merge_fn(self._acc, part, cap=cap)
+            if int(ng) <= cap:
+                break
+            cap = _next_pow2(int(ng))
+        self._acc = merged
+
+    def _next(self):
+        if self._emitted:
+            return None
+        while True:
+            b = self.child.next_batch()
+            if b is None:
+                break
+            self._ingest(b)
+        self._emitted = True
+        if self._acc is None:
+            return None
+        if self.mode == "partial":
+            return self._acc
+        return self._finalize_fn(self._acc)
+
+
+class ScalarAggregateOp(OneInputOperator):
+    """Aggregation without GROUP BY — exactly one output row, even on empty
+    input (SQL scalar aggregate semantics)."""
+
+    def __init__(self, child: Operator, aggs: tuple[agg_ops.AggSpec, ...]):
+        super().__init__(child)
+        self.aggs = aggs
+        base = child.output_schema
+        self.base_schema = base
+        names, types = [], []
+        for spec in aggs:
+            names.append(spec.name or spec.func)
+            types.append(
+                FLOAT64 if spec.func == "avg"
+                else agg_ops.agg_output_type(spec, base)
+            )
+        self.output_schema = Schema(tuple(names), tuple(types))
+        self.dictionaries = {}
+
+        def tile_states(b: Batch):
+            out = []
+            for spec in aggs:
+                if spec.func == "count_rows":
+                    out.append((jnp.sum(b.mask, dtype=jnp.int64), jnp.bool_(True)))
+                    continue
+                c = b.cols[spec.col]
+                t = base.types[spec.col]
+                m = b.mask & c.valid
+                cnt = jnp.sum(m, dtype=jnp.int64)
+                if spec.func == "count":
+                    out.append((cnt, jnp.bool_(True)))
+                elif spec.func in ("sum", "avg"):
+                    if t.family is Family.FLOAT or spec.func == "avg":
+                        s = jnp.sum(jnp.where(m, c.data.astype(jnp.float64), 0.0))
+                    else:
+                        s = jnp.sum(jnp.where(m, c.data.astype(jnp.int64), 0))
+                    if spec.func == "avg":
+                        out.append(((s, cnt), cnt > 0))
+                    else:
+                        out.append((s, cnt > 0))
+                elif spec.func in ("min", "max"):
+                    is_min = spec.func == "min"
+                    sent = agg_ops._minmax_sentinel(c.data.dtype, is_min)
+                    vals = jnp.where(m, c.data, sent)
+                    red = jnp.min(vals) if is_min else jnp.max(vals)
+                    out.append((red, cnt > 0))
+                else:
+                    raise ValueError(spec.func)
+            return out
+
+        def merge(acc, new):
+            out = []
+            for spec, (a, av), (n, nv) in zip(aggs, acc, new):
+                if spec.func in ("count", "count_rows"):
+                    out.append((a + n, jnp.bool_(True)))
+                elif spec.func == "sum":
+                    out.append((a + n, av | nv))
+                elif spec.func == "avg":
+                    out.append(((a[0] + n[0], a[1] + n[1]), av | nv))
+                elif spec.func == "min":
+                    out.append((jnp.minimum(a, n), av | nv))
+                elif spec.func == "max":
+                    out.append((jnp.maximum(a, n), av | nv))
+            return out
+
+        self._tile_fn = jax.jit(tile_states)
+        self._merge_fn = jax.jit(merge)
+        self._emitted = False
+
+    def init(self):
+        super().init()
+        self._emitted = False
+
+    def _next(self):
+        if self._emitted:
+            return None
+        acc = None
+        while True:
+            b = self.child.next_batch()
+            if b is None:
+                break
+            st = self._tile_fn(b)
+            acc = st if acc is None else self._merge_fn(acc, st)
+        self._emitted = True
+        acc = list(acc) if acc is not None else None
+        cols = []
+        for spec, t in zip(self.aggs, self.output_schema.types):
+            if acc is None:
+                if spec.func in ("count", "count_rows"):
+                    d, v = jnp.zeros((1,), jnp.int64), jnp.ones((1,), jnp.bool_)
+                else:
+                    d = jnp.zeros((1,), t.dtype)
+                    v = jnp.zeros((1,), jnp.bool_)
+            else:
+                (val, valid) = acc.pop(0)  # states consumed in agg order
+                if spec.func == "avg":
+                    s, c = val
+                    base_t = self.base_schema.types[spec.col]
+                    d = s.astype(jnp.float64) / jnp.where(c > 0, c, 1).astype(jnp.float64)
+                    if base_t.family is Family.DECIMAL:
+                        d = d / (10.0**base_t.scale)
+                    d = d[None]
+                else:
+                    d = val.astype(t.dtype)[None]
+                v = jnp.asarray(valid)[None]
+            cols.append(Column(data=d, valid=v))
+        return Batch(cols=tuple(cols), mask=jnp.ones((1,), jnp.bool_))
+
+
+# ---------------------------------------------------------------------------
+# Sort / Distinct
+
+
+class SortOp(OneInputOperator):
+    """Buffering sorter (NewSorter analog): spool all tiles, one device sort."""
+
+    def __init__(self, child: Operator, keys: tuple[sort_ops.SortKey, ...]):
+        super().__init__(child)
+        self.output_schema = child.output_schema
+        self.keys = keys
+        self._emitted = False
+
+    def init(self):
+        super().init()
+        self._emitted = False
+        rank_tables = {
+            k.col: self.child.dictionaries[k.col].ranks
+            for k in self.keys
+            if k.col in self.child.dictionaries
+        }
+        schema = self.output_schema
+        keys = self.keys
+
+        @functools.partial(jax.jit, static_argnames=("cap",))
+        def fn(batches, cap):
+            big = concat(list(batches), capacity=cap)
+            return sort_ops.sort_batch(big, schema, keys, rank_tables)
+
+        self._fn = fn
+
+    def _next(self):
+        if self._emitted:
+            return None
+        tiles = []
+        total = 0
+        while True:
+            b = self.child.next_batch()
+            if b is None:
+                break
+            tiles.append(b)
+            total += b.capacity
+        self._emitted = True
+        if not tiles:
+            return None
+        return self._fn(tuple(tiles), cap=_next_pow2(total))
+
+
+class DistinctOp(OneInputOperator):
+    """DISTINCT via grouped aggregation with no aggregates."""
+
+    def __init__(self, child: Operator, cols: tuple[int, ...] | None = None):
+        super().__init__(child)
+        self.cols = cols or tuple(range(len(child.output_schema)))
+        self.output_schema = child.output_schema.select(self.cols)
+        self.dictionaries = {
+            self.cols.index(i): d
+            for i, d in child.dictionaries.items()
+            if i in self.cols
+        }
+        self._inner = AggregateOp(child, self.cols, (), mode="complete")
+
+    def init(self):
+        self._inner.init()
+        self._initialized = True
+
+    def _next(self):
+        return self._inner._next()
+
+
+# ---------------------------------------------------------------------------
+# Join
+
+
+class HashJoinOp(OneInputOperator):
+    """hashJoiner analog: spool+index the build side once, stream probe tiles."""
+
+    def __init__(
+        self,
+        probe: Operator,
+        build: Operator,
+        probe_keys: tuple[int, ...],
+        build_keys: tuple[int, ...],
+        spec: join_ops.JoinSpec,
+    ):
+        super().__init__(probe)
+        self.build = build
+        self.probe_keys = probe_keys
+        self.build_keys = build_keys
+        self.spec = spec
+        self.output_schema = join_ops.join_output_schema(
+            probe.output_schema, build.output_schema, spec
+        )
+        self.dictionaries = dict(probe.dictionaries)
+        if spec.join_type not in ("semi", "anti"):
+            off = len(probe.output_schema)
+            for i, d in build.dictionaries.items():
+                self.dictionaries[off + i] = d
+        # host-side string-key bridges
+        self.probe_hash_tables = {}
+        self.build_hash_tables = {}
+        self.build_code_remaps = {}
+        for pos, (pk, bk) in enumerate(zip(probe_keys, build_keys)):
+            pt = probe.output_schema.types[pk]
+            if pt.family is Family.STRING:
+                pd = probe.dictionaries[pk]
+                bd = build.dictionaries[bk]
+                self.probe_hash_tables[pk] = pd.hashes
+                self.build_hash_tables[bk] = bd.hashes
+                self.build_code_remaps[pos] = np.array(
+                    [pd.code_of(str(v)) for v in bd.values], dtype=np.int32
+                )
+        self._built = False
+
+    def init(self):
+        self.build.init()
+        super().init()
+        self._built = False
+        bschema = self.build.output_schema
+        bkeys = self.build_keys
+        bht = self.build_hash_tables or None
+
+        @functools.partial(jax.jit, static_argnames=("cap",))
+        def build_fn(tiles, cap):
+            big = concat(list(tiles), capacity=cap)
+            index = join_ops.build_index(big, bschema, bkeys, bht)
+            return big, index
+
+        self._build_fn = build_fn
+        pschema = self.child.output_schema
+        pkeys = self.probe_keys
+        pht = self.probe_hash_tables or None
+        remaps = self.build_code_remaps or None
+        spec = self.spec
+
+        if spec.build_unique:
+
+            def probe_fn(p, build, index):
+                return join_ops.hash_join_unique(
+                    p, pschema, pkeys, build, bschema, bkeys, spec,
+                    pht, bht, remaps, index=index,
+                )
+
+            self._probe_fn = jax.jit(probe_fn)
+        else:
+
+            @functools.partial(jax.jit, static_argnames=("out_cap",))
+            def probe_gen_fn(p, build, index, out_cap):
+                return join_ops.hash_join_general(
+                    p, pschema, pkeys, build, bschema, bkeys, spec, out_cap,
+                    pht, bht, remaps, index=index,
+                )
+
+            self._probe_gen_fn = probe_gen_fn
+        self._out_cap = 4096
+
+    def _ensure_built(self):
+        if self._built:
+            return
+        tiles = []
+        total = 0
+        while True:
+            b = self.build.next_batch()
+            if b is None:
+                break
+            tiles.append(b)
+            total += b.capacity
+        if not tiles:
+            from ..coldata.batch import empty_batch
+
+            self._build_batch = empty_batch(self.build.output_schema, 1024)
+            self._index = join_ops.build_index(
+                self._build_batch, self.build.output_schema, self.build_keys,
+                self.build_hash_tables or None,
+            )
+        else:
+            self._build_batch, self._index = self._build_fn(
+                tuple(tiles), cap=_next_pow2(total)
+            )
+        self._built = True
+
+    def _next(self):
+        self._ensure_built()
+        p = self.child.next_batch()
+        if p is None:
+            return None
+        if self.spec.build_unique:
+            return self._probe_fn(p, self._build_batch, self._index)
+        while True:
+            out, total = self._probe_gen_fn(
+                p, self._build_batch, self._index, out_cap=self._out_cap
+            )
+            if int(total) <= self._out_cap:
+                return out
+            self._out_cap = _next_pow2(int(total))
+
+    def close(self):
+        super().close()
+        self.build.close()

@@ -1,0 +1,36 @@
+"""Flow execution — the FlowCoordinator/Materializer pull loop.
+
+Reference: distsql_running.go:710 Run drives the root operator;
+colexec/materializer.go:30 converts the final columnar batches to rows for
+pgwire. Here run_plan pulls every tile from the root operator and materializes
+live rows to host numpy columns (decoding string dictionaries)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..catalog import Catalog
+from ..coldata.batch import to_host
+from ..plan import builder as plan_builder
+from ..plan.spec import PlanNode
+
+
+def run_operator(root) -> dict[str, np.ndarray]:
+    root.init()
+    outs: list[dict[str, np.ndarray]] = []
+    while True:
+        b = root.next_batch()
+        if b is None:
+            break
+        outs.append(to_host(b, root.output_schema, root.dictionaries))
+    root.close()
+    if not outs:
+        return {n: np.array([]) for n in root.output_schema.names}
+    return {
+        n: np.concatenate([o[n] for o in outs])
+        for n in root.output_schema.names
+    }
+
+
+def run_plan(plan: PlanNode, catalog: Catalog) -> dict[str, np.ndarray]:
+    return run_operator(plan_builder.build(plan, catalog))

@@ -98,12 +98,17 @@ def hash_join_unique(
     probe_hash_tables=None,
     build_hash_tables=None,
     build_code_remaps=None,
+    index=None,
 ) -> Batch:
     """Join with unique build keys. Output tile is probe-capacity:
-    probe columns followed by build columns (semi/anti: probe columns only)."""
+    probe columns followed by build columns (semi/anti: probe columns only).
+    `index` is an optional precomputed build_index() result so the build-side
+    sort runs once per build batch, not once per probe tile."""
     cap = probe.capacity
     bcap = build.capacity
-    sh, order = build_index(build, build_schema, build_keys, build_hash_tables)
+    sh, order = index if index is not None else build_index(
+        build, build_schema, build_keys, build_hash_tables
+    )
     ph, p_active = _key_hashes(probe, probe_keys, probe_schema, probe_hash_tables)
     pos = _probe_positions(sh, jnp.where(p_active, ph, _SENTINEL))
 
@@ -168,13 +173,16 @@ def hash_join_general(
     probe_hash_tables=None,
     build_hash_tables=None,
     build_code_remaps=None,
+    index=None,
 ):
     """General join (duplicate build keys). Returns (out_batch, total_rows);
     if total_rows > out_capacity the caller must retry with a larger tile
     (capacity bucketing keeps shapes static per bucket)."""
     cap = probe.capacity
     bcap = build.capacity
-    sh, order = build_index(build, build_schema, build_keys, build_hash_tables)
+    sh, order = index if index is not None else build_index(
+        build, build_schema, build_keys, build_hash_tables
+    )
     ph, p_active = _key_hashes(probe, probe_keys, probe_schema, probe_hash_tables)
     phs = jnp.where(p_active, ph, _SENTINEL)
     lo = jnp.searchsorted(sh, phs, side="left").astype(jnp.int32)

@@ -1,0 +1,32 @@
+"""Device mesh helpers — the cluster topology analog.
+
+The reference's "cluster" is N symmetric nodes connected by gRPC
+(pkg/rpc, pkg/gossip); here it is a jax.sharding.Mesh over TPU chips
+connected by ICI. One mesh axis ("d") plays the role of DistSQL's node set:
+table rows shard across it (partitioned scans, SURVEY §2.2) and hash
+repartitioning rides all_to_all over it (HashRouter analog).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "d"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    devs = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded across the mesh axis (partitioned-scan placement)."""
+    return NamedSharding(mesh, P(AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,45 @@
+"""Plan -> operator tree — the colbuilder.NewColOperator analog
+(reference: pkg/sql/colexec/colbuilder/execplan.go:736, core dispatch at
+:153-270). Walks the PlanNode tree and instantiates flow operators, threading
+catalog tables and host-side dictionary bridges."""
+
+from __future__ import annotations
+
+from ..catalog import Catalog
+from ..flow import operators as ops
+from ..flow.operator import Operator
+from . import spec as S
+
+
+def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
+    if isinstance(plan, S.TableScan):
+        return ops.ScanOp(catalog.get(plan.table), plan.columns)
+    if isinstance(plan, S.Filter):
+        return ops.FilterOp(build(plan.input, catalog), plan.predicate)
+    if isinstance(plan, S.Project):
+        return ops.ProjectOp(build(plan.input, catalog), plan.exprs, plan.names)
+    if isinstance(plan, S.Aggregate):
+        return ops.AggregateOp(
+            build(plan.input, catalog), plan.group_cols, plan.aggs, plan.mode
+        )
+    if isinstance(plan, S.ScalarAggregate):
+        return ops.ScalarAggregateOp(build(plan.input, catalog), plan.aggs)
+    if isinstance(plan, S.Sort):
+        return ops.SortOp(build(plan.input, catalog), plan.keys)
+    if isinstance(plan, S.Limit):
+        return ops.LimitOp(build(plan.input, catalog), plan.limit, plan.offset)
+    if isinstance(plan, S.Distinct):
+        return ops.DistinctOp(build(plan.input, catalog), plan.cols)
+    if isinstance(plan, S.HashJoin):
+        return ops.HashJoinOp(
+            build(plan.probe, catalog),
+            build(plan.build, catalog),
+            plan.probe_keys,
+            plan.build_keys,
+            plan.spec,
+        )
+    if isinstance(plan, S.Exchange):
+        # single-device build: the shuffle is the identity; the multi-device
+        # path lives in parallel/shuffle.py and is planned by parallel/dist.py
+        return build(plan.input, catalog)
+    raise TypeError(f"unknown plan node {plan}")

@@ -1,0 +1,102 @@
+"""Physical plan IR — the execinfrapb.ProcessorSpec analog.
+
+Reference: pkg/sql/execinfrapb/processors*.proto defines ProcessorSpec (core +
+post-processing) wired by stream edges into a FlowSpec; colbuilder's
+NewColOperator (pkg/sql/colexec/colbuilder/execplan.go:736) maps each spec to
+an operator. Here the IR is a tree of frozen dataclasses; plan/builder.py maps
+it to flow operators. Distribution nodes (Exchange) mirror OutputRouterSpec /
+InputSyncSpec (execinfrapb/data.proto:111,149).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..coldata.types import Schema
+from ..ops.aggregation import AggSpec
+from ..ops.expr import Expr
+from ..ops.join import JoinSpec
+from ..ops.sort import SortKey
+
+
+class PlanNode:
+    pass
+
+
+@dataclass(frozen=True)
+class TableScan(PlanNode):
+    table: str
+    columns: tuple[str, ...] | None = None  # None = all
+
+
+@dataclass(frozen=True)
+class Filter(PlanNode):
+    input: PlanNode
+    predicate: Expr
+
+
+@dataclass(frozen=True)
+class Project(PlanNode):
+    input: PlanNode
+    exprs: tuple[Expr, ...]
+    names: tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class Aggregate(PlanNode):
+    input: PlanNode
+    group_cols: tuple[int, ...]
+    aggs: tuple[AggSpec, ...]
+    # "complete" | "partial" | "final" — partial/final mirror CRDB's
+    # local/final aggregation stages around a shuffle
+    mode: str = "complete"
+    # planner hint: dense group codes in [0, max_groups) in column group_cols[0]
+    max_groups: int | None = None
+
+
+@dataclass(frozen=True)
+class HashJoin(PlanNode):
+    probe: PlanNode
+    build: PlanNode
+    probe_keys: tuple[int, ...]
+    build_keys: tuple[int, ...]
+    spec: JoinSpec = JoinSpec()
+
+
+@dataclass(frozen=True)
+class Sort(PlanNode):
+    input: PlanNode
+    keys: tuple[SortKey, ...]
+
+
+@dataclass(frozen=True)
+class Limit(PlanNode):
+    input: PlanNode
+    limit: int
+    offset: int = 0
+
+
+@dataclass(frozen=True)
+class Distinct(PlanNode):
+    input: PlanNode
+    cols: tuple[int, ...] | None = None  # None = all columns
+
+
+@dataclass(frozen=True)
+class Exchange(PlanNode):
+    """Repartition rows across the mesh by key hash — the HashRouter +
+    Outbox/Inbox shuffle (colflow/routers.go:420, colrpc) as an ICI
+    all-to-all. No-op on a single device."""
+
+    input: PlanNode
+    keys: tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class ScalarAggregate(PlanNode):
+    """Aggregation without GROUP BY: always exactly one output row."""
+
+    input: PlanNode
+    aggs: tuple[AggSpec, ...]
+    mode: str = "complete"

@@ -1,0 +1,203 @@
+"""Relational plan builder — the optbuilder analog.
+
+Reference: pkg/sql/opt/optbuilder turns ASTs into a typed relational tree,
+resolving names against the catalog. Here ``Rel`` is a fluent builder over the
+plan IR that tracks output schema and string dictionaries as the plan grows,
+so string literals resolve to dictionary codes and string predicates become
+host-prepared CodeLookup tables at plan time (TPC-H queries in
+bench/queries.py are written against this API; it is also the user-facing
+"dataframe" surface of the framework)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from ..catalog import Catalog
+from ..coldata.batch import Dictionary
+from ..coldata.types import BOOL, Schema, SQLType, Family
+from ..flow.runtime import run_plan
+from ..ops import aggregation as agg_ops
+from ..ops import expr as ex
+from ..ops import join as join_ops
+from ..ops import sort as sort_ops
+from ..plan import spec as S
+from ..flow import operators as flow_ops
+
+
+@dataclass
+class Rel:
+    catalog: Catalog
+    plan: S.PlanNode
+    schema: Schema
+    dicts: dict[int, Dictionary] = field(default_factory=dict)
+
+    # -- name resolution ----------------------------------------------------
+
+    def idx(self, name: str) -> int:
+        return self.schema.index(name)
+
+    def c(self, name: str) -> ex.ColRef:
+        return ex.ColRef(self.idx(name))
+
+    def type_of(self, name: str) -> SQLType:
+        return self.schema.type_of(name)
+
+    def str_lit(self, col: str, value: str) -> ex.Const:
+        """Literal of a dictionary-coded string column -> its code."""
+        i = self.idx(col)
+        code = self.dicts[i].code_of(value)
+        from ..coldata.types import INT32
+
+        return ex.Const(code, INT32)
+
+    def str_eq(self, col: str, value: str) -> ex.Expr:
+        return ex.Cmp("eq", self.c(col), self.str_lit(col, value))
+
+    def str_in(self, col: str, values: list[str]) -> ex.Expr:
+        i = self.idx(col)
+        d = self.dicts[i]
+        table = np.zeros(max(1, len(d)), dtype=bool)
+        for v in values:
+            code = d.code_of(v)
+            if code >= 0:
+                table[code] = True
+        return ex.CodeLookup(col=i, table=table)
+
+    def str_pred(self, col: str, fn: Callable[[str], bool]) -> ex.Expr:
+        """Arbitrary string predicate (LIKE etc.) evaluated per dictionary
+        entry on the host, becoming a device gather."""
+        i = self.idx(col)
+        d = self.dicts[i]
+        table = np.array([bool(fn(str(v))) for v in d.values])
+        if len(table) == 0:
+            table = np.zeros(1, dtype=bool)
+        return ex.CodeLookup(col=i, table=table)
+
+    def str_cmp(self, col: str, op: str, value: str) -> ex.Expr:
+        """Range comparison on strings via the dictionary's rank table."""
+        import operator
+
+        fns = {"lt": operator.lt, "le": operator.le, "gt": operator.gt,
+               "ge": operator.ge}
+        return self.str_pred(col, lambda s: fns[op](s, value))
+
+    # -- relational operators ----------------------------------------------
+
+    @staticmethod
+    def scan(catalog: Catalog, table: str,
+             cols: tuple[str, ...] | None = None) -> "Rel":
+        t = catalog.get(table)
+        names = cols or t.schema.names
+        idxs = tuple(t.schema.index(n) for n in names)
+        schema = t.schema.select(idxs)
+        full = t.dict_by_index()
+        dicts = {i: full[ci] for i, ci in enumerate(idxs) if ci in full}
+        return Rel(catalog, S.TableScan(table, tuple(names)), schema, dicts)
+
+    def filter(self, pred: ex.Expr) -> "Rel":
+        return Rel(self.catalog, S.Filter(self.plan, pred), self.schema,
+                   dict(self.dicts))
+
+    def project(self, items: list[tuple[str, ex.Expr]]) -> "Rel":
+        names = tuple(n for n, _ in items)
+        exprs = tuple(e for _, e in items)
+        types = tuple(ex.expr_type(e, self.schema) for e in exprs)
+        dicts = {
+            i: self.dicts[e.idx]
+            for i, (_, e) in enumerate(items)
+            if isinstance(e, ex.ColRef) and e.idx in self.dicts
+        }
+        return Rel(self.catalog, S.Project(self.plan, exprs, names),
+                   Schema(names, types), dicts)
+
+    def select(self, *names: str) -> "Rel":
+        return self.project([(n, self.c(n)) for n in names])
+
+    def groupby(self, by: list[str],
+                aggs: list[tuple[str, str, str | None]]) -> "Rel":
+        """aggs: (output name, func, input col name or None)."""
+        gcols = tuple(self.idx(n) for n in by)
+        specs = tuple(
+            agg_ops.AggSpec(f, None if cn is None else self.idx(cn), name)
+            for name, f, cn in aggs
+        )
+        node = S.Aggregate(self.plan, gcols, specs)
+        names = tuple([self.schema.names[i] for i in gcols] +
+                      [s[0] for s in aggs])
+        types = []
+        for i in gcols:
+            types.append(self.schema.types[i])
+        for name, f, cn in aggs:
+            spec = agg_ops.AggSpec(f, None if cn is None else self.idx(cn), name)
+            if f == "avg":
+                from ..coldata.types import FLOAT64
+
+                types.append(FLOAT64)
+            else:
+                types.append(agg_ops.agg_output_type(spec, self.schema))
+        dicts = {
+            by.index(self.schema.names[i]): self.dicts[i]
+            for i in gcols
+            if i in self.dicts
+        }
+        return Rel(self.catalog, node, Schema(names, tuple(types)), dicts)
+
+    def scalar_agg(self, aggs: list[tuple[str, str, str | None]]) -> "Rel":
+        specs = tuple(
+            agg_ops.AggSpec(f, None if cn is None else self.idx(cn), name)
+            for name, f, cn in aggs
+        )
+        node = S.ScalarAggregate(self.plan, specs)
+        names, types = [], []
+        for name, f, cn in aggs:
+            names.append(name)
+            if f == "avg":
+                from ..coldata.types import FLOAT64
+
+                types.append(FLOAT64)
+            else:
+                spec = agg_ops.AggSpec(f, None if cn is None else self.idx(cn), name)
+                types.append(agg_ops.agg_output_type(spec, self.schema))
+        return Rel(self.catalog, node, Schema(tuple(names), tuple(types)), {})
+
+    def sort(self, keys: list[tuple[str, bool]]) -> "Rel":
+        sk = tuple(sort_ops.SortKey(self.idx(n), desc=d) for n, d in keys)
+        return Rel(self.catalog, S.Sort(self.plan, sk), self.schema,
+                   dict(self.dicts))
+
+    def limit(self, n: int, offset: int = 0) -> "Rel":
+        return Rel(self.catalog, S.Limit(self.plan, n, offset), self.schema,
+                   dict(self.dicts))
+
+    def distinct(self, cols: list[str] | None = None) -> "Rel":
+        idxs = (tuple(self.idx(n) for n in cols)
+                if cols else tuple(range(len(self.schema))))
+        schema = self.schema.select(idxs)
+        dicts = {
+            idxs.index(i): d for i, d in self.dicts.items() if i in idxs
+        }
+        return Rel(self.catalog, S.Distinct(self.plan, idxs), schema, dicts)
+
+    def join(self, build: "Rel", on: list[tuple[str, str]],
+             how: str = "inner", build_unique: bool = True) -> "Rel":
+        pkeys = tuple(self.idx(l) for l, _ in on)
+        bkeys = tuple(build.idx(r) for _, r in on)
+        spec = join_ops.JoinSpec(how, build_unique)
+        node = S.HashJoin(self.plan, build.plan, pkeys, bkeys, spec)
+        if how in ("semi", "anti"):
+            schema, dicts = self.schema, dict(self.dicts)
+        else:
+            schema = self.schema.concat(build.schema)
+            dicts = dict(self.dicts)
+            off = len(self.schema)
+            for i, d in build.dicts.items():
+                dicts[off + i] = d
+        return Rel(self.catalog, node, schema, dicts)
+
+    # -- execution ----------------------------------------------------------
+
+    def run(self) -> dict[str, np.ndarray]:
+        return run_plan(self.plan, self.catalog)

@@ -4,7 +4,10 @@ Tests run on a virtual 8-device CPU mesh (the reference's analog is `fakedist`
 — pkg/sql/physicalplan/fake_span_resolver.go — which fakes multi-node
 distribution inside one process). Real-TPU runs happen only via bench.py.
 
-Must set env before jax is imported anywhere.
+The environment injects a TPU PJRT plugin via a PYTHONPATH sitecustomize, and
+that plugin opens a hardware tunnel even under JAX_PLATFORMS=cpu — making CPU
+tests hostage to tunnel health. Backend init is lazy, so at conftest time we
+can still drop the plugin's backend factory before anything initializes.
 """
 
 import os
@@ -15,6 +18,24 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# sitecustomize imports jax before conftest, freezing jax_platforms at the
+# env value ("axon") — override the live config, not just the env var.
+jax.config.update("jax_platforms", "cpu")
+
+try:
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name not in ("cpu",):
+            _xb._backend_factories.pop(_name, None)
+except Exception:  # pragma: no cover - defensive: jax internals moved
+    pass
+
+assert jax.devices()[0].platform == "cpu"
+assert len(jax.devices()) == 8, "virtual 8-device CPU mesh required"
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

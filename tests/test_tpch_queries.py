@@ -1,0 +1,119 @@
+"""End-to-end TPC-H query tests vs pandas oracles at tiny scale — the analog
+of pkg/workload/tpch expected-row validation + the vec-vs-row oracle pattern
+(pkg/sql/distsql/columnar_operators_test.go)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cockroach_tpu.bench import queries as Q
+from cockroach_tpu.bench import tpch
+
+
+@pytest.fixture(scope="module")
+def cat():
+    return tpch.gen_tpch(sf=0.005, seed=7)
+
+
+@pytest.fixture(scope="module")
+def dfs(cat):
+    return {
+        n: tpch.to_pandas(cat, n)
+        for n in ("lineitem", "orders", "customer", "nation", "region",
+                  "supplier")
+    }
+
+
+def test_q1(cat, dfs):
+    res = Q.q1(cat).run()
+    li = dfs["lineitem"]
+    cutoff = tpch.d("1998-12-01") - 90
+    f = li[li.l_shipdate <= cutoff].copy()
+    f["disc_price"] = (f.l_extendedprice * (1 - f.l_discount)).round(10)
+    f["charge"] = (f.disc_price * (1 + f.l_tax)).round(10)
+    want = (
+        f.groupby(["l_returnflag", "l_linestatus"])
+        .agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("disc_price", "sum"),
+            sum_charge=("charge", "sum"),
+            avg_qty=("l_quantity", "mean"),
+            avg_price=("l_extendedprice", "mean"),
+            avg_disc=("l_discount", "mean"),
+            count_order=("l_quantity", "size"),
+        )
+        .reset_index()
+        .sort_values(["l_returnflag", "l_linestatus"])
+    )
+    assert len(res["l_returnflag"]) == len(want)
+    np.testing.assert_array_equal(res["l_returnflag"], want.l_returnflag)
+    np.testing.assert_array_equal(res["l_linestatus"], want.l_linestatus)
+    np.testing.assert_allclose(res["sum_qty"], want.sum_qty, rtol=1e-12)
+    np.testing.assert_allclose(res["sum_base_price"], want.sum_base_price, rtol=1e-12)
+    np.testing.assert_allclose(res["sum_disc_price"], want.sum_disc_price, rtol=1e-9)
+    np.testing.assert_allclose(res["sum_charge"], want.sum_charge, rtol=1e-9)
+    np.testing.assert_allclose(res["avg_qty"], want.avg_qty, rtol=1e-12)
+    np.testing.assert_allclose(res["avg_disc"], want.avg_disc, rtol=1e-12)
+    np.testing.assert_array_equal(res["count_order"], want.count_order)
+
+
+def test_q3(cat, dfs):
+    res = Q.q3(cat).run()
+    li, o, c = dfs["lineitem"], dfs["orders"], dfs["customer"]
+    date = tpch.d("1995-03-15")
+    cb = c[c.c_mktsegment == "BUILDING"]
+    ob = o[o.o_orderdate < date].merge(cb, left_on="o_custkey", right_on="c_custkey")
+    lb = li[li.l_shipdate > date]
+    j = lb.merge(ob, left_on="l_orderkey", right_on="o_orderkey")
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    want = (
+        j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
+        .agg(revenue=("revenue", "sum"))
+        .reset_index()
+        .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+        .head(10)
+    )
+    assert len(res["l_orderkey"]) == len(want)
+    np.testing.assert_array_equal(res["l_orderkey"], want.l_orderkey)
+    np.testing.assert_allclose(res["revenue"], want.revenue, rtol=1e-9)
+
+
+def test_q6(cat, dfs):
+    res = Q.q6(cat).run()
+    li = dfs["lineitem"]
+    date = tpch.d("1994-01-01")
+    f = li[
+        (li.l_shipdate >= date)
+        & (li.l_shipdate < date + 365)
+        & (li.l_discount >= 0.05 - 1e-9)
+        & (li.l_discount <= 0.07 + 1e-9)
+        & (li.l_quantity < 24)
+    ]
+    want = (f.l_extendedprice * f.l_discount).sum()
+    np.testing.assert_allclose(res["revenue"][0], want, rtol=1e-9)
+
+
+def test_q5(cat, dfs):
+    res = Q.q5(cat).run()
+    li, o, c = dfs["lineitem"], dfs["orders"], dfs["customer"]
+    s, n, r = dfs["supplier"], dfs["nation"], dfs["region"]
+    date = tpch.d("1994-01-01")
+    nr = n.merge(r[r.r_name == "ASIA"], left_on="n_regionkey",
+                 right_on="r_regionkey")
+    of = o[(o.o_orderdate >= date) & (o.o_orderdate < date + 365)]
+    j = (
+        li.merge(of, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+    )
+    j = j[j.c_nationkey == j.s_nationkey]
+    j = j.merge(nr, left_on="s_nationkey", right_on="n_nationkey")
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    want = (
+        j.groupby("n_name").agg(revenue=("revenue", "sum")).reset_index()
+        .sort_values("revenue", ascending=False)
+    )
+    assert len(res["n_name"]) == len(want)
+    np.testing.assert_array_equal(res["n_name"], want.n_name)
+    np.testing.assert_allclose(res["revenue"], want.revenue, rtol=1e-9)
