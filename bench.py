@@ -1,0 +1,134 @@
+"""Benchmark driver — TPC-H Q1 (BASELINE.json config #1) on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value: engine throughput in lineitem rows/sec through the full Q1 pipeline
+(scan -> filter -> decimal projections -> 8-aggregate group-by -> sort),
+median of BENCH_RUNS timed runs after a compile warm-up.
+
+vs_baseline: ratio against a single-host pandas implementation of the same
+query measured in-process (the reference's 8-vCPU colexec baseline cannot be
+executed in this image — no Go toolchain; pandas columnar eval is the closest
+measurable stand-in and is itself vectorized C).
+
+Env knobs: TPCH_SF (default 1.0), BENCH_RUNS (default 3), BENCH_QUERY (q1).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _pandas_baseline(qname, cat, res) -> float:
+    """Run the same query in pandas, assert the engine result matches, and
+    return the elapsed seconds (the measured stand-in baseline)."""
+    from cockroach_tpu.bench import tpch
+
+    li = tpch.to_pandas(cat, "lineitem")
+    if qname == "q1":
+        t0 = time.time()
+        cutoff = tpch.d("1998-12-01") - 90
+        f = li[li.l_shipdate <= cutoff].copy()
+        f["disc_price"] = f.l_extendedprice * (1 - f.l_discount)
+        base = (
+            f.groupby(["l_returnflag", "l_linestatus"])
+            .agg(sum_qty=("l_quantity", "sum"))
+            .sort_index()
+        )
+        el = time.time() - t0
+        np.testing.assert_allclose(
+            np.asarray(res["sum_qty"], dtype=np.float64),
+            base.sum_qty.to_numpy(), rtol=1e-9,
+        )
+        return el
+    if qname == "q6":
+        t0 = time.time()
+        date = tpch.d("1994-01-01")
+        f = li[(li.l_shipdate >= date) & (li.l_shipdate < date + 365)
+               & (li.l_discount >= 0.05 - 1e-9) & (li.l_discount <= 0.07 + 1e-9)
+               & (li.l_quantity < 24)]
+        want = (f.l_extendedprice * f.l_discount).sum()
+        el = time.time() - t0
+        np.testing.assert_allclose(float(res["revenue"][0]), want, rtol=1e-9)
+        return el
+    if qname == "q3":
+        o = tpch.to_pandas(cat, "orders")
+        c = tpch.to_pandas(cat, "customer")
+        t0 = time.time()
+        date = tpch.d("1995-03-15")
+        cb = c[c.c_mktsegment == "BUILDING"]
+        ob = o[o.o_orderdate < date].merge(
+            cb, left_on="o_custkey", right_on="c_custkey")
+        lb = li[li.l_shipdate > date]
+        j = lb.merge(ob, left_on="l_orderkey", right_on="o_orderkey")
+        j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+        want = (
+            j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
+            .agg(revenue=("revenue", "sum")).reset_index()
+            .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+            .head(10)
+        )
+        el = time.time() - t0
+        np.testing.assert_allclose(
+            np.asarray(res["revenue"], dtype=np.float64),
+            want.revenue.to_numpy(), rtol=1e-9,
+        )
+        return el
+    raise SystemExit(f"no pandas baseline for {qname}")
+
+
+def main() -> None:
+    sf = float(os.environ.get("TPCH_SF", "1.0"))
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    qname = os.environ.get("BENCH_QUERY", "q1")
+
+    import jax
+
+    from cockroach_tpu.bench import queries as Q
+    from cockroach_tpu.bench import tpch
+    from cockroach_tpu.flow.runtime import run_operator
+    from cockroach_tpu.plan import builder as plan_builder
+
+    t0 = time.time()
+    cat = tpch.gen_tpch(sf=sf)
+    nrows = cat.get("lineitem").num_rows
+    gen_s = time.time() - t0
+    print(f"# gen sf={sf}: {nrows} lineitems in {gen_s:.1f}s "
+          f"on {jax.devices()[0].platform}", file=sys.stderr)
+
+    rel = Q.QUERIES[qname](cat)
+
+    # warm-up: compiles every operator + uploads the table columns
+    t0 = time.time()
+    rel.run()
+    print(f"# warmup (compile+upload): {time.time()-t0:.1f}s", file=sys.stderr)
+
+    # one operator tree, re-initialized per run: jitted kernels compile once
+    root = plan_builder.build(rel.plan, cat)
+    times = []
+    for _ in range(runs):
+        t0 = time.time()
+        res = run_operator(root)
+        times.append(time.time() - t0)
+    med = sorted(times)[len(times) // 2]
+    rows_per_sec = nrows / med
+
+    # pandas baseline of the same query (asserts engine result matches)
+    pandas_s = _pandas_baseline(qname, cat, res)
+    baseline_rows_per_sec = nrows / pandas_s
+
+    print(f"# engine: {med*1e3:.0f}ms ({rows_per_sec/1e6:.1f}M rows/s); "
+          f"pandas: {pandas_s*1e3:.0f}ms", file=sys.stderr)
+    print(json.dumps({
+        "metric": f"tpch_{qname}_sf{sf:g}_rows_per_sec",
+        "value": round(rows_per_sec),
+        "unit": "rows/sec",
+        "vs_baseline": round(rows_per_sec / baseline_rows_per_sec, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,8 @@ import numpy as np
 from .coldata.batch import Batch, Column, Dictionary, from_host
 from .coldata.types import Family, Schema
 
+
+
 TILE_ALIGN = 1024  # pad device tables to a multiple of this (8x128 lanes)
 
 
@@ -32,7 +34,7 @@ class Table:
     columns: dict[str, np.ndarray]
     valids: dict[str, np.ndarray] = field(default_factory=dict)
     dictionaries: dict[str, Dictionary] = field(default_factory=dict)
-    _device: Batch | None = None
+    _device: dict | None = None
 
     @property
     def num_rows(self) -> int:
@@ -43,13 +45,35 @@ class Table:
             self.schema.index(name): d for name, d in self.dictionaries.items()
         }
 
-    def device_batch(self) -> Batch:
+    def device_batch(self, names: tuple[str, ...] | None = None) -> Batch:
+        """Device-resident batch of the requested columns. Cached per column,
+        so a query never uploads columns it does not scan."""
+        names = names or self.schema.names
         if self._device is None:
-            cap = _pad_cap(self.num_rows)
-            self._device = from_host(
-                self.schema, self.columns, valids=self.valids, capacity=cap
-            )
-        return self._device
+            self._device = {}
+        cap = _pad_cap(self.num_rows)
+        n = self.num_rows
+        if "__mask__" not in self._device:
+            m = np.zeros((cap,), dtype=np.bool_)
+            m[:n] = True
+            self._device["__mask__"] = jnp.asarray(m)
+        cols = []
+        for cname in names:
+            if cname not in self._device:
+                t = self.schema.type_of(cname)
+                a = np.asarray(self.columns[cname])
+                if t.family is Family.BYTES:
+                    buf = np.zeros((cap, t.width), dtype=np.uint8)
+                else:
+                    buf = np.zeros((cap,), dtype=t.dtype)
+                buf[:n] = a.astype(buf.dtype) if buf.ndim == 1 else a
+                v = np.zeros((cap,), dtype=np.bool_)
+                v[:n] = self.valids.get(cname, np.ones(n, dtype=np.bool_))
+                self._device[cname] = Column(
+                    data=jnp.asarray(buf), valid=jnp.asarray(v)
+                )
+            cols.append(self._device[cname])
+        return Batch(cols=tuple(cols), mask=self._device["__mask__"])
 
     @staticmethod
     def from_strings(

@@ -24,6 +24,7 @@ from ..catalog import Table
 from ..coldata.batch import Batch, Column, concat
 from ..coldata.types import FLOAT64, INT64, Family, Schema, SQLType
 from ..ops import aggregation as agg_ops
+from ..ops.aggregation import partial_layout
 from ..ops import expr as ex
 from ..ops import join as join_ops
 from ..ops import sort as sort_ops
@@ -63,8 +64,7 @@ class ScanOp(SourceOperator):
         self._offset = 0
 
     def init(self):
-        dev = self.table.device_batch()
-        self._batch = dev.select(self.col_idxs)
+        self._batch = self.table.device_batch(self.output_schema.names)
         if self.tile is None:
             self.tile = self._batch.capacity
         self._slice = jax.jit(
@@ -169,47 +169,6 @@ class LimitOp(OneInputOperator):
 # Aggregation
 
 
-_MERGE_FUNC = {
-    "sum": "sum",
-    "count": "sum",
-    "count_rows": "sum",
-    "min": "min",
-    "max": "max",
-    "any_not_null": "any_not_null",
-}
-
-
-def partial_layout(
-    schema: Schema, group_cols: tuple[int, ...], aggs: tuple[agg_ops.AggSpec, ...]
-):
-    """The partial-aggregation state layout shared by partial and final
-    stages: group keys first, then state columns (avg -> sum + count).
-
-    Returns (partial_specs, state_schema, final_map) where final_map[j] gives,
-    for output agg j, ('avg', sum_state_idx, count_state_idx) or
-    (func, state_idx) with state indices relative to the first state column."""
-    partial_specs: list[agg_ops.AggSpec] = []
-    final_map = []
-    for spec in aggs:
-        if spec.func == "avg":
-            si = len(partial_specs)
-            t = schema.types[spec.col]
-            sum_t = FLOAT64 if t.family is Family.FLOAT else t
-            partial_specs.append(agg_ops.AggSpec("sum", spec.col, f"_s{si}"))
-            partial_specs.append(agg_ops.AggSpec("count", spec.col, f"_c{si}"))
-            final_map.append(("avg", si, si + 1, t))
-        else:
-            si = len(partial_specs)
-            partial_specs.append(
-                agg_ops.AggSpec(spec.func, spec.col, f"_st{si}")
-            )
-            final_map.append((spec.func, si))
-    state_schema = agg_ops.groupby_output_schema(
-        schema, group_cols, tuple(partial_specs)
-    )
-    return tuple(partial_specs), state_schema, final_map
-
-
 class AggregateOp(OneInputOperator):
     """GROUP BY aggregation (hashAggregator analog). mode:
     - complete: input rows -> final results
@@ -239,10 +198,7 @@ class AggregateOp(OneInputOperator):
         self.num_keys = k
         # merge aggregation over the state layout
         self.merge_group_cols = tuple(range(k))
-        self.merge_specs = tuple(
-            agg_ops.AggSpec(_MERGE_FUNC[s.func], k + i, s.name)
-            for i, s in enumerate(self.partial_specs)
-        )
+        self.merge_specs = agg_ops.merge_specs_for(self.partial_specs, k)
         final_schema = self._final_schema(base)
         self.output_schema = (
             self.state_schema if mode == "partial" else final_schema
@@ -285,6 +241,8 @@ class AggregateOp(OneInputOperator):
         super().init()
         self._acc = None
         self._emitted = False
+        if hasattr(self, "_partial_fn"):
+            return
         schema = self.base_schema
         gcols = self.group_cols
         pspecs = self.partial_specs
@@ -306,21 +264,7 @@ class AggregateOp(OneInputOperator):
         self._finalize_fn = jax.jit(self._finalize)
 
     def _finalize(self, state: Batch) -> Batch:
-        k = self.num_keys
-        cols = list(state.cols[:k])
-        for fm in self.final_map:
-            if fm[0] == "avg":
-                _, si, ci, t = fm
-                s = state.cols[k + si]
-                c = state.cols[k + ci]
-                denom = jnp.where(c.data > 0, c.data, 1).astype(jnp.float64)
-                d = s.data.astype(jnp.float64) / denom
-                if t.family is Family.DECIMAL:
-                    d = d / (10.0**t.scale)
-                cols.append(Column(data=d, valid=s.valid & (c.data > 0)))
-            else:
-                cols.append(state.cols[k + fm[1]])
-        return Batch(cols=tuple(cols), mask=state.mask)
+        return agg_ops.finalize_states(state, self.final_map, self.num_keys)
 
     def _ingest(self, b: Batch):
         cap = _next_pow2(int(b.capacity))
@@ -484,6 +428,8 @@ class SortOp(OneInputOperator):
     def init(self):
         super().init()
         self._emitted = False
+        if hasattr(self, "_fn"):
+            return
         rank_tables = {
             k.col: self.child.dictionaries[k.col].ranks
             for k in self.keys
@@ -586,6 +532,8 @@ class HashJoinOp(OneInputOperator):
         self.build.init()
         super().init()
         self._built = False
+        if hasattr(self, "_build_fn"):
+            return
         bschema = self.build.output_schema
         bkeys = self.build_keys
         bht = self.build_hash_tables or None
@@ -667,3 +615,121 @@ class HashJoinOp(OneInputOperator):
     def close(self):
         super().close()
         self.build.close()
+
+
+class SmallGroupAggregateOp(OneInputOperator):
+    """Dense-code aggregation for planner-known small group cardinality —
+    the MXU/VPU-friendly hashAggregator specialization (e.g. TPC-H Q1's
+    returnflag x linestatus). Group keys must be dictionary-coded columns
+    with known sizes; each column gets one extra code for NULL, so every
+    distinct key combination (SQL GROUP BY semantics, NULLs included) is
+    its own dense group.
+
+    States are positionally aligned [G] arrays, so cross-tile (and
+    cross-device) merging is elementwise — no sorting anywhere."""
+
+    def __init__(self, child: Operator, group_cols: tuple[int, ...],
+                 aggs: tuple[agg_ops.AggSpec, ...], key_sizes: tuple[int, ...]):
+        super().__init__(child)
+        self.group_cols = group_cols
+        self.aggs = aggs
+        self.key_sizes = key_sizes
+        base = child.output_schema
+        self.base_schema = base
+        self.partial_specs, _, self.final_map = partial_layout(
+            base, group_cols, aggs
+        )
+        # one extra code per column for NULL: every NULL combination is a
+        # distinct group, matching SQL GROUP BY
+        eff = tuple(s + 1 for s in key_sizes)
+        G = 1
+        for s in eff:
+            G *= s
+        self.G = G
+        strides = []
+        acc = 1
+        for s in reversed(eff):
+            strides.append(acc)
+            acc *= s
+        self.strides = tuple(reversed(strides))
+        names = [base.names[i] for i in group_cols]
+        types = [base.types[i] for i in group_cols]
+        for spec, fm in zip(aggs, self.final_map):
+            names.append(spec.name or spec.func)
+            types.append(FLOAT64 if fm[0] == "avg"
+                         else agg_ops.agg_output_type(spec, base))
+        self.output_schema = Schema(tuple(names), tuple(types))
+        self.dictionaries = {
+            group_cols.index(gi): d
+            for gi, d in child.dictionaries.items()
+            if gi in group_cols
+        }
+        self._emitted = False
+
+    def init(self):
+        super().init()
+        self._emitted = False
+        if hasattr(self, "_tile_fn"):
+            return
+        base = self.base_schema
+        gcols = self.group_cols
+        strides = self.strides
+        G = self.G
+        sizes = self.key_sizes
+        pspecs = self.partial_specs
+
+        def tile_fn(b: Batch):
+            code = jnp.zeros((b.capacity,), jnp.int32)
+            for gi, st, size in zip(gcols, strides, sizes):
+                c = b.cols[gi]
+                ci = jnp.where(c.valid, c.data.astype(jnp.int32), size)
+                code = code + ci * st
+            states, rows = agg_ops.smallgroup_partial_states(
+                b, base, code, G, pspecs
+            )
+            return states, rows
+
+        def merge_fn(acc, new):
+            astates, arows = acc
+            nstates, nrows = new
+            return (agg_ops.merge_dense_states(pspecs, astates, nstates),
+                    arows + nrows)
+
+        def finalize_fn(acc):
+            states, rows = acc
+            gid = jnp.arange(G, dtype=jnp.int32)
+            cols = []
+            for gi, st, size in zip(gcols, strides, sizes):
+                code_i = (gid // st) % (size + 1)
+                t = base.types[gi]
+                valid = code_i < size  # code==size means NULL key
+                cols.append(Column(
+                    data=jnp.where(valid, code_i, 0).astype(t.dtype),
+                    valid=valid,
+                ))
+            mask = rows > 0
+            for (d, v) in states:
+                cols.append(Column(data=d, valid=v & mask))
+            state_batch = Batch(cols=tuple(cols), mask=mask)
+            return agg_ops.finalize_states(
+                state_batch, self.final_map, len(gcols)
+            )
+
+        self._tile_fn = jax.jit(tile_fn)
+        self._merge_fn = jax.jit(merge_fn)
+        self._finalize_fn = jax.jit(finalize_fn)
+
+    def _next(self):
+        if self._emitted:
+            return None
+        acc = None
+        while True:
+            b = self.child.next_batch()
+            if b is None:
+                break
+            st = self._tile_fn(b)
+            acc = st if acc is None else self._merge_fn(acc, st)
+        self._emitted = True
+        if acc is None:
+            return None
+        return self._finalize_fn(acc)

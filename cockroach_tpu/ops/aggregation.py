@@ -122,11 +122,13 @@ def sort_groupby(
     live = batch.mask
 
     # Sort live rows first, then by group keys (nulls are their own group).
+    # NULL rows carry garbage data: zero it in the sort key so the NULL
+    # group is contiguous even with later key columns in play.
     operands = [~live]
     for gi in group_cols:
         c = batch.cols[gi]
         operands.append(~c.valid)
-        operands.append(c.data)
+        operands.append(jnp.where(c.valid, c.data, jnp.zeros_like(c.data)))
     perm = jnp.arange(cap, dtype=jnp.int32)
     num_keys = len(operands)
     sorted_ops = jax.lax.sort(operands + [perm], num_keys=num_keys, is_stable=True)
@@ -189,69 +191,144 @@ def groupby_output_schema(
     return Schema(tuple(names), tuple(types))
 
 
-def smallgroup_groupby(
-    batch: Batch,
-    schema: Schema,
-    code_col: int,
-    num_groups: int,
-    aggs: tuple[AggSpec, ...],
-) -> Batch:
-    """Aggregation when the planner knows group ids are dense codes in
-    [0, num_groups) (from dictionary codes or packed key codes). One-hot
-    membership + masked reductions; exact for int64; no sort.
+_MERGE_FUNC = {
+    "sum": "sum",
+    "count": "sum",
+    "count_rows": "sum",
+    "min": "min",
+    "max": "max",
+    "any_not_null": "any_not_null",
+}
 
-    Output tile capacity == num_groups (static); group id g lands in row g.
-    The caller decodes row index -> key values via host-side tables."""
-    G = num_groups
-    live = batch.mask
-    codes = jnp.clip(batch.cols[code_col].data.astype(jnp.int32), 0, G - 1)
-    onehot = (codes[:, None] == jnp.arange(G, dtype=jnp.int32)[None, :]) & live[:, None]
 
-    group_rows = jnp.sum(onehot, axis=0, dtype=jnp.int64)  # [G]
-    out_mask = group_rows > 0
+def partial_layout(
+    schema: Schema, group_cols: tuple[int, ...], aggs: tuple[AggSpec, ...]
+):
+    """The partial-aggregation state layout shared by partial and final
+    stages: group keys first, then state columns (avg -> sum + count).
 
-    out_cols: list[Column] = []
-    # group id column (dense code) so callers can decode keys
-    out_cols.append(
-        Column(data=jnp.arange(G, dtype=jnp.int32), valid=jnp.ones((G,), jnp.bool_))
+    Returns (partial_specs, state_schema, final_map) where final_map[j] gives,
+    for output agg j, ('avg', sum_state_idx, count_state_idx) or
+    (func, state_idx) with state indices relative to the first state column."""
+    partial_specs: list[AggSpec] = []
+    final_map = []
+    for spec in aggs:
+        if spec.func == "avg":
+            si = len(partial_specs)
+            t = schema.types[spec.col]
+            sum_t = FLOAT64 if t.family is Family.FLOAT else t
+            partial_specs.append(AggSpec("sum", spec.col, f"_s{si}"))
+            partial_specs.append(AggSpec("count", spec.col, f"_c{si}"))
+            final_map.append(("avg", si, si + 1, t))
+        else:
+            si = len(partial_specs)
+            partial_specs.append(
+                AggSpec(spec.func, spec.col, f"_st{si}")
+            )
+            final_map.append((spec.func, si))
+    state_schema = groupby_output_schema(
+        schema, group_cols, tuple(partial_specs)
+    )
+    return tuple(partial_specs), state_schema, final_map
+
+
+
+
+def merge_specs_for(partial_specs: tuple[AggSpec, ...], num_keys: int):
+    """Merge aggregation specs over the partial-state layout (group keys at
+    0..num_keys-1, states after)."""
+    return tuple(
+        AggSpec(_MERGE_FUNC[s.func], num_keys + i, s.name)
+        for i, s in enumerate(partial_specs)
     )
 
-    for spec in aggs:
+
+def finalize_states(state: Batch, final_map, num_keys: int) -> Batch:
+    """Turn a merged partial-state batch into final SQL results (avg = sum /
+    count, decimal scale restored). Shared by the single-node AggregateOp and
+    the distributed final stage."""
+    k = num_keys
+    cols = list(state.cols[:k])
+    for fm in final_map:
+        if fm[0] == "avg":
+            _, si, ci, t = fm
+            s = state.cols[k + si]
+            c = state.cols[k + ci]
+            denom = jnp.where(c.data > 0, c.data, 1).astype(jnp.float64)
+            d = s.data.astype(jnp.float64) / denom
+            if t.family is Family.DECIMAL:
+                d = d / (10.0**t.scale)
+            cols.append(Column(data=d, valid=s.valid & (c.data > 0)))
+        else:
+            cols.append(state.cols[k + fm[1]])
+    return Batch(cols=tuple(cols), mask=state.mask)
+
+
+def smallgroup_partial_states(
+    batch: Batch,
+    schema: Schema,
+    codes,
+    num_groups: int,
+    specs: tuple[AggSpec, ...],
+):
+    """Dense-code partial aggregation: rows with group code g (precomputed,
+    in [0, num_groups)) reduce into row g of [num_groups] state arrays.
+
+    Unlike sort_groupby there is no sort and the output is POSITIONALLY
+    aligned by code, so cross-tile / cross-device merging is elementwise
+    (sum/min/max of equal-shaped arrays) — the TPU-ideal layout for
+    planner-known small cardinalities (e.g. TPC-H Q1: 3x2 flag groups).
+
+    Returns (state_cols, group_rows): state_cols is a list of (data[G],
+    valid[G]) per spec; group_rows[G] counts rows per group."""
+    G = num_groups
+    live = batch.mask
+    codes = jnp.clip(codes.astype(jnp.int32), 0, G - 1)
+    onehot = (codes[:, None] == jnp.arange(G, dtype=jnp.int32)[None, :]) & live[:, None]
+    group_rows = jnp.sum(onehot, axis=0, dtype=jnp.int64)
+    out = []
+    for spec in specs:
         if spec.func == "count_rows":
-            out_cols.append(Column(data=group_rows, valid=jnp.ones((G,), jnp.bool_)))
+            out.append((group_rows, jnp.ones((G,), jnp.bool_)))
             continue
         col = batch.cols[spec.col]
         t = schema.types[spec.col]
-        member = onehot & col.valid[:, None]  # [cap, G]
+        member = onehot & col.valid[:, None]
         cnt = jnp.sum(member, axis=0, dtype=jnp.int64)
         nonempty = cnt > 0
         if spec.func == "count":
-            out_cols.append(Column(data=cnt, valid=jnp.ones((G,), jnp.bool_)))
-        elif spec.func in ("sum", "avg"):
-            if t.family is Family.FLOAT or spec.func == "avg":
+            out.append((cnt, jnp.ones((G,), jnp.bool_)))
+        elif spec.func == "sum":
+            if t.family is Family.FLOAT:
                 v = jnp.where(member, col.data.astype(jnp.float64)[:, None], 0.0)
-                s = jnp.sum(v, axis=0)
-                if spec.func == "avg":
-                    avg = s / jnp.where(nonempty, cnt, 1).astype(jnp.float64)
-                    if t.family is Family.DECIMAL:
-                        avg = avg / (10.0**t.scale)
-                    out_cols.append(Column(data=avg, valid=nonempty))
-                else:
-                    out_cols.append(Column(data=s, valid=nonempty))
             else:
                 v = jnp.where(member, col.data.astype(jnp.int64)[:, None], 0)
-                out_cols.append(Column(data=jnp.sum(v, axis=0), valid=nonempty))
+            out.append((jnp.sum(v, axis=0), nonempty))
         elif spec.func in ("min", "max"):
             is_min = spec.func == "min"
             sent = _minmax_sentinel(col.data.dtype, is_min)
             v = jnp.where(member, col.data[:, None], sent)
-            red = jnp.min(v, axis=0) if is_min else jnp.max(v, axis=0)
-            out_cols.append(Column(data=red, valid=nonempty))
+            out.append((jnp.min(v, axis=0) if is_min else jnp.max(v, axis=0),
+                        nonempty))
         elif spec.func == "any_not_null":
             sent = _minmax_sentinel(col.data.dtype, False)
             v = jnp.where(member, col.data[:, None], sent)
-            out_cols.append(Column(data=jnp.max(v, axis=0), valid=nonempty))
+            out.append((jnp.max(v, axis=0), nonempty))
         else:
-            raise ValueError(f"unknown aggregate {spec.func}")
+            raise ValueError(f"unsupported dense-state aggregate {spec.func}")
+    return out, group_rows
 
-    return Batch(cols=tuple(out_cols), mask=out_mask)
+
+def merge_dense_states(specs: tuple[AggSpec, ...], acc, new):
+    """Elementwise merge of positionally-aligned dense states."""
+    out = []
+    for spec, (ad, av), (nd, nv) in zip(specs, acc, new):
+        if spec.func in ("sum", "count", "count_rows"):
+            out.append((ad + nd, av | nv))
+        elif spec.func == "min":
+            out.append((jnp.minimum(ad, nd), av | nv))
+        elif spec.func in ("max", "any_not_null"):
+            out.append((jnp.maximum(ad, nd), av | nv))
+        else:
+            raise ValueError(spec.func)
+    return out

@@ -19,9 +19,12 @@ def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
     if isinstance(plan, S.Project):
         return ops.ProjectOp(build(plan.input, catalog), plan.exprs, plan.names)
     if isinstance(plan, S.Aggregate):
-        return ops.AggregateOp(
-            build(plan.input, catalog), plan.group_cols, plan.aggs, plan.mode
-        )
+        child = build(plan.input, catalog)
+        if plan.key_sizes is not None and plan.mode == "complete":
+            return ops.SmallGroupAggregateOp(
+                child, plan.group_cols, plan.aggs, plan.key_sizes
+            )
+        return ops.AggregateOp(child, plan.group_cols, plan.aggs, plan.mode)
     if isinstance(plan, S.ScalarAggregate):
         return ops.ScalarAggregateOp(build(plan.input, catalog), plan.aggs)
     if isinstance(plan, S.Sort):

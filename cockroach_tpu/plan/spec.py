@@ -51,8 +51,9 @@ class Aggregate(PlanNode):
     # "complete" | "partial" | "final" — partial/final mirror CRDB's
     # local/final aggregation stages around a shuffle
     mode: str = "complete"
-    # planner hint: dense group codes in [0, max_groups) in column group_cols[0]
-    max_groups: int | None = None
+    # planner hint: every group key is a dense code of known cardinality
+    # (dictionary size); enables the sort-free dense-state aggregation path
+    key_sizes: tuple[int, ...] | None = None
 
 
 @dataclass(frozen=True)

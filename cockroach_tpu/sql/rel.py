@@ -124,7 +124,22 @@ class Rel:
             agg_ops.AggSpec(f, None if cn is None else self.idx(cn), name)
             for name, f, cn in aggs
         )
-        node = S.Aggregate(self.plan, gcols, specs)
+        # dense-state path: all keys dictionary-coded with small product
+        key_sizes = None
+        if gcols and all(i in self.dicts for i in gcols):
+            sizes = tuple(len(self.dicts[i]) for i in gcols)
+            prod = 1
+            for s in sizes:
+                prod *= s + 1  # +1 NULL code per column
+            # the one-hot dense path does O(rows*G) work: only worth it for
+            # genuinely small G (sort path is O(rows log rows) otherwise)
+            if 0 < prod <= 256 and all(
+                sp.func in ("sum", "count", "count_rows", "min", "max",
+                            "avg", "any_not_null")
+                for sp in specs
+            ):
+                key_sizes = sizes
+        node = S.Aggregate(self.plan, gcols, specs, key_sizes=key_sizes)
         names = tuple([self.schema.names[i] for i in gcols] +
                       [s[0] for s in aggs])
         types = []

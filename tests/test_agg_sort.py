@@ -81,33 +81,64 @@ def test_groupby_null_keys_form_group(rng):
     assert bykey[1] == 1 and bykey[2] == 2 and bykey[None] == 7
 
 
-def test_smallgroup_groupby(rng):
-    schema = cd.Schema.of(code=cd.INT32, v=cd.INT64, f=cd.FLOAT64)
-    n = 500
-    code = rng.integers(0, 6, n).astype(np.int32)
-    v = rng.integers(0, 1000, n)
-    f = rng.random(n)
-    b = cd.from_host(schema, {"code": code, "v": v, "f": f}, capacity=512)
-    out = agg.smallgroup_groupby(
-        b,
-        schema,
-        0,
-        6,
-        (
-            agg.AggSpec("sum", 1, "s"),
-            agg.AggSpec("avg", 2, "a"),
-            agg.AggSpec("count_rows", None, "n"),
-        ),
+def test_smallgroup_operator_vs_general(rng):
+    """Dense-state aggregation must agree with the general sort path,
+    including NULL group keys (each NULL combination its own group)."""
+    from cockroach_tpu.flow.operators import AggregateOp, SmallGroupAggregateOp
+    from cockroach_tpu.flow.operator import SourceOperator
+
+    class OneShot(SourceOperator):
+        def __init__(self, batch, schema, dicts=None):
+            super().__init__()
+            self.output_schema = schema
+            self.dictionaries = dicts or {}
+            self._batch = batch
+
+        def _next(self):
+            b, self._batch = self._batch, None
+            return b
+
+    schema = cd.Schema.of(a=cd.STRING, b=cd.STRING, v=cd.INT64)
+    n = 300
+    a = rng.integers(0, 3, n).astype(np.int32)
+    b_ = rng.integers(0, 2, n).astype(np.int32)
+    v = rng.integers(-50, 50, n)
+    av = rng.random(n) > 0.15  # NULL keys present
+    bv = rng.random(n) > 0.15
+    vv = rng.random(n) > 0.2
+    mk = lambda: cd.from_host(
+        schema, {"a": a, "b": b_, "v": v},
+        valids={"a": av, "b": bv, "v": vv}, capacity=512,
     )
-    assert out.capacity == 6
-    data_s = np.asarray(out.cols[1].data)
-    data_a = np.asarray(out.cols[2].data)
-    data_n = np.asarray(out.cols[3].data)
-    for gcode in range(6):
-        sel = code == gcode
-        assert data_s[gcode] == v[sel].sum()
-        np.testing.assert_allclose(data_a[gcode], f[sel].mean())
-        assert data_n[gcode] == sel.sum()
+    specs = (
+        agg.AggSpec("sum", 2, "s"),
+        agg.AggSpec("avg", 2, "m"),
+        agg.AggSpec("count_rows", None, "n"),
+    )
+    dense = SmallGroupAggregateOp(OneShot(mk(), schema), (0, 1), specs, (3, 2))
+    general = AggregateOp(OneShot(mk(), schema), (0, 1), specs)
+    out_d = dense.next_batch()
+    out_g = general.next_batch()
+    rd = cd.to_host(out_d, dense.output_schema)
+    rg = cd.to_host(out_g, general.output_schema)
+    assert len(rd["s"]) == len(rg["s"])
+
+    def keyed(r):
+        return {
+            (r["a"][i], r["b"][i]): (r["s"][i], r["m"][i], r["n"][i])
+            for i in range(len(r["s"]))
+        }
+
+    kd, kg = keyed(rd), keyed(rg)
+    assert set(kd) == set(kg)
+    for k in kd:
+        sd, md, nd = kd[k]
+        sg, mg, ng = kg[k]
+        assert sd == sg and nd == ng
+        if md is None:
+            assert mg is None
+        else:
+            np.testing.assert_allclose(md, mg)
 
 
 def test_sort_multi_key_desc_nulls(rng):
