@@ -33,16 +33,29 @@ def _pandas_baseline(qname, cat, res) -> float:
         cutoff = tpch.d("1998-12-01") - 90
         f = li[li.l_shipdate <= cutoff].copy()
         f["disc_price"] = f.l_extendedprice * (1 - f.l_discount)
+        f["charge"] = f.disc_price * (1 + f.l_tax)
         base = (
             f.groupby(["l_returnflag", "l_linestatus"])
-            .agg(sum_qty=("l_quantity", "sum"))
+            .agg(
+                sum_qty=("l_quantity", "sum"),
+                sum_base_price=("l_extendedprice", "sum"),
+                sum_disc_price=("disc_price", "sum"),
+                sum_charge=("charge", "sum"),
+                avg_qty=("l_quantity", "mean"),
+                avg_price=("l_extendedprice", "mean"),
+                avg_disc=("l_discount", "mean"),
+                count_order=("l_quantity", "size"),
+            )
             .sort_index()
         )
         el = time.time() - t0
-        np.testing.assert_allclose(
-            np.asarray(res["sum_qty"], dtype=np.float64),
-            base.sum_qty.to_numpy(), rtol=1e-9,
-        )
+        for col in ("sum_qty", "sum_base_price", "sum_disc_price",
+                    "sum_charge", "avg_qty", "avg_price", "avg_disc",
+                    "count_order"):
+            np.testing.assert_allclose(
+                np.asarray(res[col], dtype=np.float64),
+                base[col].to_numpy().astype(np.float64), rtol=1e-9,
+            )
         return el
     if qname == "q6":
         t0 = time.time()
@@ -101,13 +114,13 @@ def main() -> None:
 
     rel = Q.QUERIES[qname](cat)
 
-    # warm-up: compiles every operator + uploads the table columns
+    # one operator tree, re-initialized per run: its jitted kernels compile
+    # during the warm-up run and are reused by every timed run
+    root = plan_builder.build(rel.plan, cat)
     t0 = time.time()
-    rel.run()
+    run_operator(root)
     print(f"# warmup (compile+upload): {time.time()-t0:.1f}s", file=sys.stderr)
 
-    # one operator tree, re-initialized per run: jitted kernels compile once
-    root = plan_builder.build(rel.plan, cat)
     times = []
     for _ in range(runs):
         t0 = time.time()

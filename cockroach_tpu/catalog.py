@@ -61,17 +61,15 @@ class Table:
         for cname in names:
             if cname not in self._device:
                 t = self.schema.type_of(cname)
-                a = np.asarray(self.columns[cname])
-                if t.family is Family.BYTES:
-                    buf = np.zeros((cap, t.width), dtype=np.uint8)
-                else:
-                    buf = np.zeros((cap,), dtype=t.dtype)
-                buf[:n] = a.astype(buf.dtype) if buf.ndim == 1 else a
-                v = np.zeros((cap,), dtype=np.bool_)
-                v[:n] = self.valids.get(cname, np.ones(n, dtype=np.bool_))
-                self._device[cname] = Column(
-                    data=jnp.asarray(buf), valid=jnp.asarray(v)
+                one = Schema((cname,), (t,))
+                valids = (
+                    {cname: self.valids[cname]} if cname in self.valids else None
                 )
+                b = from_host(
+                    one, {cname: np.asarray(self.columns[cname])},
+                    valids=valids, capacity=cap,
+                )
+                self._device[cname] = b.cols[0]
             cols.append(self._device[cname])
         return Batch(cols=tuple(cols), mask=self._device["__mask__"])
 

@@ -67,12 +67,14 @@ class ScanOp(SourceOperator):
         self._batch = self.table.device_batch(self.output_schema.names)
         if self.tile is None:
             self.tile = self._batch.capacity
-        self._slice = jax.jit(
-            lambda b, off: jax.tree_util.tree_map(
-                lambda x: jax.lax.dynamic_slice_in_dim(x, off, self.tile, axis=0),
-                b,
+        if not hasattr(self, "_slice"):
+            tile = self.tile
+            self._slice = jax.jit(
+                lambda b, off: jax.tree_util.tree_map(
+                    lambda x: jax.lax.dynamic_slice_in_dim(x, off, tile, axis=0),
+                    b,
+                )
             )
-        )
         self._offset = 0
         super().init()
 

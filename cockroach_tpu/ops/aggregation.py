@@ -10,7 +10,7 @@ strategies, both static-shape:
    padded output tile. Replaces pointer-chasing hash tables, which TPUs cannot
    do, with sorts, which they do well.
 
-2. ``smallgroup_groupby`` — the MXU/VPU path for planner-known small group
+2. ``smallgroup_partial_states`` — the MXU/VPU path for planner-known small group
    cardinality G (e.g. TPC-H Q1's returnflag x linestatus = 6): a one-hot
    [tile, G] membership matrix and masked reductions; exact in int64, no sort.
 
@@ -216,7 +216,6 @@ def partial_layout(
         if spec.func == "avg":
             si = len(partial_specs)
             t = schema.types[spec.col]
-            sum_t = FLOAT64 if t.family is Family.FLOAT else t
             partial_specs.append(AggSpec("sum", spec.col, f"_s{si}"))
             partial_specs.append(AggSpec("count", spec.col, f"_c{si}"))
             final_map.append(("avg", si, si + 1, t))

@@ -20,7 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..coldata.batch import Batch
@@ -92,7 +92,7 @@ def make_distributed_groupby(
         mesh=mesh,
         in_specs=(P(AXIS),),
         out_specs=(P(AXIS), P(AXIS)),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(fn), final_schema
 
@@ -139,6 +139,6 @@ def make_distributed_join(
         mesh=mesh,
         in_specs=(P(AXIS), P(AXIS)),
         out_specs=(P(AXIS), P(AXIS)),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(fn), join_ops.join_output_schema(probe_schema, build_schema, spec)

@@ -23,7 +23,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..coldata.batch import Batch, Column
@@ -134,6 +134,6 @@ def make_shuffle(
         mesh=mesh,
         in_specs=(P(AXIS),),
         out_specs=(P(AXIS), P(AXIS)),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(sharded)

@@ -1,0 +1,20 @@
+"""Storage layer — MVCC kernels + LSM engine, TPU-first.
+
+Reference mapping:
+- ``mvcc.mvcc_scan_filter``  <- pebbleMVCCScanner's per-KV hot loop
+  (pkg/storage/pebble_mvcc_scanner.go:381), vectorized over a sorted block.
+- ``mvcc.merge_blocks``      <- pebble's compaction/merging iterator k-way
+  merge, as one lane-parallel device sort.
+- ``lsm.Engine``             <- the Pebble wrapper (pkg/storage/pebble.go):
+  memtable, sorted runs, compaction trigger, checkpoints, MVCC stats.
+"""
+
+from .keys import DEFAULT_KEY_WIDTH, decode_keys, encode_keys
+from .lsm import Engine, MVCCStats, WriteIntentError
+from .mvcc import KVBlock, merge_blocks, mvcc_scan_filter, sort_block
+
+__all__ = [
+    "DEFAULT_KEY_WIDTH", "decode_keys", "encode_keys",
+    "Engine", "MVCCStats", "WriteIntentError",
+    "KVBlock", "merge_blocks", "mvcc_scan_filter", "sort_block",
+]

@@ -1,0 +1,107 @@
+"""Fixed-width key encoding for device-resident KV blocks.
+
+Reference: CockroachDB MVCC keys are variable-length roachpb.Key bytes plus an
+HLC timestamp suffix (pkg/storage/mvcc_key.go). TPUs want static shapes and
+lane-parallel comparisons, so keys here are zero-padded fixed-width byte rows
+([N, KW] uint8) whose big-endian uint64 "word lanes" compare in the same
+lexicographic order as the raw bytes:
+
+- zero-padding preserves order for keys that do not contain 0x00 bytes; the
+  engine enforces max key length KW (longer keys are rejected, as the
+  reference rejects keys over its limits).
+- each group of 8 bytes packs into one big-endian uint64; (w0, w1, ...) tuple
+  order == bytewise lexicographic order. All device comparisons, sorts and
+  merges operate on these word lanes (VPU-friendly), never on strings.
+
+Timestamps are a single int64 (the HLC walltime+logical pair collapsed; the
+reference's ordering "key asc, ts desc" is preserved — pkg/storage/mvcc_key.go
+EncodeMVCCKey puts the inverted ts after the key).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_KEY_WIDTH = 24  # 3 uint64 word lanes
+
+
+def encode_keys(keys: list[bytes | str], width: int = DEFAULT_KEY_WIDTH) -> np.ndarray:
+    """Host: list of byte/str keys -> [N, width] uint8, zero padded."""
+    out = np.zeros((len(keys), width), dtype=np.uint8)
+    for i, k in enumerate(keys):
+        b = k.encode("utf-8") if isinstance(k, str) else bytes(k)
+        if len(b) > width:
+            raise ValueError(f"key longer than key width {width}: {b!r}")
+        out[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return out
+
+
+def decode_keys(arr: np.ndarray) -> list[bytes]:
+    """Host: [N, width] uint8 -> raw bytes with zero padding stripped."""
+    out = []
+    for row in np.asarray(arr, dtype=np.uint8):
+        nz = np.nonzero(row)[0]
+        out.append(bytes(row[: nz[-1] + 1]) if len(nz) else b"")
+    return out
+
+
+def key_words(key: jax.Array) -> jax.Array:
+    """[N, KW] uint8 -> [N, KW//8] big-endian uint64 word lanes.
+
+    Tuple order over the word lanes equals bytewise lexicographic order.
+    """
+    n, kw = key.shape
+    assert kw % 8 == 0, "key width must be a multiple of 8"
+    groups = key.reshape(n, kw // 8, 8).astype(jnp.uint64)
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint64) * jnp.uint64(8)
+    return jnp.sum(groups << shifts, axis=-1, dtype=jnp.uint64)
+
+
+def words_cmp_lt(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Lexicographic a < b over [N, W] word lanes -> [N] bool."""
+    lt = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
+    eq = jnp.ones(a.shape[:-1], dtype=jnp.bool_)
+    for i in range(a.shape[-1]):
+        lt = lt | (eq & (a[..., i] < b[..., i]))
+        eq = eq & (a[..., i] == b[..., i])
+    return lt
+
+
+def words_cmp_eq(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.all(a == b, axis=-1)
+
+
+def words_in_range(
+    words: jax.Array, start: jax.Array | None, end: jax.Array | None
+) -> jax.Array:
+    """start <= key < end over word lanes. start/end are [W] vectors (or None
+    for unbounded), matching the reference's [start, end) scan bounds."""
+    ok = jnp.ones(words.shape[:-1], dtype=jnp.bool_)
+    if start is not None:
+        ok = ok & ~words_cmp_lt(words, jnp.broadcast_to(start, words.shape))
+    if end is not None:
+        ok = ok & words_cmp_lt(words, jnp.broadcast_to(end, words.shape))
+    return ok
+
+
+def encode_bound(key: bytes | str | None, width: int = DEFAULT_KEY_WIDTH):
+    """Host: one scan bound -> [width//8] uint64 word vector, or None."""
+    if key is None:
+        return None
+    enc = encode_keys([key], width)
+    return np.asarray(key_words(jnp.asarray(enc)))[0]
+
+
+def bound_next(words: np.ndarray) -> np.ndarray:
+    """Host: the word-lane successor of an encoded key — the exclusive end
+    bound for a point lookup (zero padding makes ``key + b"\\x00"`` encode
+    identically to ``key``, so the successor is a +1 with carry instead;
+    the reference's Key.Next() appends a 0x00 byte for the same purpose)."""
+    out = np.array(words, dtype=np.uint64, copy=True)
+    for i in range(len(out) - 1, -1, -1):
+        out[i] = out[i] + np.uint64(1)
+        if out[i] != 0:  # no carry
+            break
+    return out

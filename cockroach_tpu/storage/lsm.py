@@ -1,0 +1,335 @@
+"""LSM storage engine — the Pebble-wrapper analog (pkg/storage/pebble.go).
+
+Host-side orchestration of device-resident sorted runs:
+
+- writes append to a host memtable (plus an in-memory WAL record list);
+- ``flush`` sorts the memtable into an immutable device run (an "SST");
+- when runs pile past ``l0_trigger`` they compact: ``mvcc.merge_blocks``
+  (the k-way-merge kernel) + ``mvcc.mvcc_gc_filter`` — the Pebble compaction
+  loop as one lane-parallel device pass;
+- reads (``get``/``scan``) merge the relevant runs and run the
+  ``mvcc_scan_filter`` kernel (pebble_mvcc_scanner.go:381 semantics);
+- ``checkpoint``/``open_checkpoint`` persist runs+memtable to .npz files
+  (pkg/storage/pebble.go:2077 CreateCheckpoint analog).
+
+Intents: provisional writes carry a txn id; ``resolve_intents`` commits or
+aborts them engine-wide (MVCCResolveWriteIntent). A scan that hits another
+txn's visible intent raises WriteIntentError, like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import keys as K
+from . import mvcc
+
+_RUN_ALIGN = 1024
+
+
+def _pad(n: int) -> int:
+    """Next power-of-2 capacity >= n (min 1024): runs and merges then take
+    only O(log) distinct static shapes, so every kernel compiles a handful
+    of times total no matter how write volume fluctuates."""
+    p = _RUN_ALIGN
+    while p < n:
+        p *= 2
+    return p
+
+
+def _shrink(block: mvcc.KVBlock) -> mvcc.KVBlock:
+    """Slice a *sorted* block (dead rows last) down to a power-of-2 capacity
+    covering its live rows — keeps merge/compaction capacities proportional
+    to data, not to the sum of historical paddings."""
+    live = int(np.asarray(jnp.sum(block.mask)))
+    cap = _pad(live)
+    if cap >= block.capacity:
+        return block
+    return jax.tree_util.tree_map(lambda x: x[:cap], block)
+
+
+class WriteIntentError(Exception):
+    def __init__(self, keys: list[bytes], txns: list[int]):
+        super().__init__(f"conflicting intents on {keys} (txns {txns})")
+        self.keys = keys
+        self.txns = txns
+
+
+@dataclass
+class MVCCStats:
+    """Coarse engine stats (enginepb.MVCCStats analog)."""
+
+    live_count: int = 0
+    key_count: int = 0
+    val_count: int = 0
+    intent_count: int = 0
+    runs: int = 0
+    compactions: int = 0
+    flushes: int = 0
+
+
+@dataclass
+class _Memtable:
+    keys: list[bytes] = field(default_factory=list)
+    ts: list[int] = field(default_factory=list)
+    txn: list[int] = field(default_factory=list)
+    tomb: list[bool] = field(default_factory=list)
+    value: list[bytes] = field(default_factory=list)
+
+    def __len__(self) -> int:
+        return len(self.ts)
+
+
+class Engine:
+    """MVCC LSM engine over device-resident sorted runs."""
+
+    def __init__(
+        self,
+        key_width: int = K.DEFAULT_KEY_WIDTH,
+        val_width: int = 16,
+        l0_trigger: int = 4,
+        memtable_size: int = 4096,
+        gc_ts: int = 0,
+    ):
+        assert key_width % 8 == 0
+        self.key_width = key_width
+        self.val_width = val_width
+        self.l0_trigger = l0_trigger  # DefaultPebbleOptions L0CompactionThreshold
+        self.memtable_size = memtable_size
+        self.gc_ts = gc_ts
+        self.mem = _Memtable()
+        self.runs: list[mvcc.KVBlock] = []  # sorted device runs, newest first
+        self.stats = MVCCStats()
+
+    # -- writes -------------------------------------------------------------
+
+    def put(self, key: bytes | str, value: bytes | str, ts: int, txn: int = 0):
+        self._append(key, value, ts, txn, tomb=False)
+
+    def delete(self, key: bytes | str, ts: int, txn: int = 0):
+        self._append(key, b"", ts, txn, tomb=True)
+
+    def _append(self, key, value, ts: int, txn: int, tomb: bool):
+        b = key.encode() if isinstance(key, str) else bytes(key)
+        v = value.encode() if isinstance(value, str) else bytes(value)
+        if len(b) > self.key_width:
+            raise ValueError(f"key too long ({len(b)} > {self.key_width})")
+        if len(v) > self.val_width:
+            raise ValueError(f"value too long ({len(v)} > {self.val_width})")
+        self.mem.keys.append(b)
+        self.mem.ts.append(int(ts))
+        self.mem.txn.append(int(txn))
+        self.mem.tomb.append(bool(tomb))
+        self.mem.value.append(v)
+        if len(self.mem) >= self.memtable_size:
+            self.flush()
+
+    # -- flush / compaction -------------------------------------------------
+
+    def _mem_block(self) -> mvcc.KVBlock | None:
+        if not len(self.mem):
+            return None
+        n = len(self.mem)
+        keys = K.encode_keys(self.mem.keys, self.key_width)
+        vals = np.zeros((n, self.val_width), dtype=np.uint8)
+        vlen = np.zeros((n,), dtype=np.int32)
+        for i, v in enumerate(self.mem.value):
+            vals[i, : len(v)] = np.frombuffer(v, dtype=np.uint8)
+            vlen[i] = len(v)
+        return mvcc.block_from_host(
+            keys,
+            np.asarray(self.mem.ts),
+            np.asarray(self.mem.txn),
+            np.asarray(self.mem.tomb),
+            vals,
+            vlen,
+            cap=_pad(n),
+        )
+
+    def flush(self):
+        """Memtable -> sorted immutable run (Pebble memtable flush)."""
+        blk = self._mem_block()
+        if blk is None:
+            return
+        self.runs.insert(0, mvcc.sort_block(blk))
+        self.mem = _Memtable()
+        self.stats.flushes += 1
+        self.stats.runs = len(self.runs)
+        if len(self.runs) > self.l0_trigger:
+            self.compact()
+
+    def compact(self, bottom: bool = True):
+        """Merge all runs into one via the k-way merge kernel + GC filter."""
+        self.flush_mem_only()
+        if not self.runs:
+            return
+        total = sum(r.capacity for r in self.runs)
+        merged = mvcc.merge_blocks(tuple(self.runs), cap=_pad(total))
+        keep = mvcc.mvcc_gc_filter(merged, jnp.int64(self.gc_ts), bottom)
+        merged = mvcc.KVBlock(
+            key=merged.key, ts=merged.ts, txn=merged.txn, tomb=merged.tomb,
+            value=merged.value, vlen=merged.vlen, mask=merged.mask & keep,
+        )
+        self.runs = [_shrink(mvcc.sort_block(merged))]
+        self.stats.compactions += 1
+        self.stats.runs = 1
+
+    def flush_mem_only(self):
+        blk = self._mem_block()
+        if blk is not None:
+            self.runs.insert(0, mvcc.sort_block(blk))
+            self.mem = _Memtable()
+            self.stats.flushes += 1
+            self.stats.runs = len(self.runs)
+
+    # -- reads --------------------------------------------------------------
+
+    def _merged_view(self) -> mvcc.KVBlock | None:
+        """One sorted device view over memtable + all runs (the read path's
+        merging iterator)."""
+        self.flush_mem_only()
+        if not self.runs:
+            return None
+        if len(self.runs) == 1:
+            return self.runs[0]
+        total = sum(r.capacity for r in self.runs)
+        merged = _shrink(mvcc.merge_blocks(tuple(self.runs), cap=_pad(total)))
+        self.runs = [merged]  # merged view is also a valid single run
+        self.stats.runs = 1
+        return merged
+
+    def scan(
+        self,
+        start: bytes | str | None,
+        end: bytes | str | None,
+        ts: int,
+        txn: int = 0,
+        max_keys: int | None = None,
+    ) -> list[tuple[bytes, bytes]]:
+        """[start, end) snapshot scan at `ts` -> [(key, value)] host pairs."""
+        view = self._merged_view()
+        if view is None:
+            return []
+        sw = K.encode_bound(start, self.key_width)
+        ew = K.encode_bound(end, self.key_width)
+        sel, conflict = mvcc.mvcc_scan_filter(
+            view, jnp.int64(ts), jnp.int64(txn),
+            None if sw is None else jnp.asarray(sw),
+            None if ew is None else jnp.asarray(ew),
+        )
+        conflict_np = np.asarray(conflict)
+        if conflict_np.any():
+            idx = np.nonzero(conflict_np)[0]
+            ck = K.decode_keys(np.asarray(view.key)[idx])
+            ct = [int(t) for t in np.asarray(view.txn)[idx]]
+            raise WriteIntentError(ck, ct)
+        sel_np = np.asarray(sel)
+        idx = np.nonzero(sel_np)[0]
+        if max_keys is not None:
+            idx = idx[:max_keys]
+        ks = K.decode_keys(np.asarray(view.key)[idx])
+        vals = np.asarray(view.value)[idx]
+        vls = np.asarray(view.vlen)[idx]
+        return [(k, bytes(v[:n])) for k, v, n in zip(ks, vals, vls)]
+
+    def get(self, key: bytes | str, ts: int, txn: int = 0) -> bytes | None:
+        view = self._merged_view()
+        if view is None:
+            return None
+        b = key.encode() if isinstance(key, str) else bytes(key)
+        sw = K.encode_bound(b, self.key_width)
+        ew = K.bound_next(sw)
+        sel, conflict = mvcc.mvcc_scan_filter(
+            view, jnp.int64(ts), jnp.int64(txn),
+            jnp.asarray(sw), jnp.asarray(ew),
+        )
+        if np.asarray(conflict).any():
+            idx = np.nonzero(np.asarray(conflict))[0]
+            raise WriteIntentError(
+                K.decode_keys(np.asarray(view.key)[idx]),
+                [int(t) for t in np.asarray(view.txn)[idx]],
+            )
+        idx = np.nonzero(np.asarray(sel))[0]
+        if not len(idx):
+            return None
+        i = idx[0]
+        n = int(np.asarray(view.vlen)[i])
+        return bytes(np.asarray(view.value)[i][:n])
+
+    # -- intents ------------------------------------------------------------
+
+    def resolve_intents(self, txn: int, commit_ts: int, commit: bool):
+        """Commit or abort all of txn's intents across memtable + runs."""
+        self.flush_mem_only()
+        self.runs = [
+            mvcc.sort_block(
+                mvcc.resolve_intents(
+                    r, jnp.int64(txn), jnp.int64(commit_ts), commit
+                )
+            )
+            for r in self.runs
+        ]
+
+    def intent_keys(self, txn: int) -> list[bytes]:
+        view = self._merged_view()
+        if view is None:
+            return []
+        m = np.asarray(view.mask & (view.txn == txn))
+        return K.decode_keys(np.asarray(view.key)[np.nonzero(m)[0]])
+
+    # -- stats / checkpoint -------------------------------------------------
+
+    def compute_stats(self) -> MVCCStats:
+        view = self._merged_view()
+        s = self.stats
+        if view is None:
+            s.live_count = s.key_count = s.val_count = s.intent_count = 0
+            return s
+        mask = np.asarray(view.mask)
+        s.val_count = int(mask.sum())
+        s.intent_count = int((mask & (np.asarray(view.txn) != 0)).sum())
+        words = np.asarray(K.key_words(view.key))[mask]
+        s.key_count = len(np.unique(words, axis=0)) if len(words) else 0
+        sel, _ = mvcc.mvcc_scan_filter(
+            view, jnp.int64(np.iinfo(np.int64).max), jnp.int64(0)
+        )
+        s.live_count = int(np.asarray(sel).sum())
+        return s
+
+    def checkpoint(self, path: str):
+        """Persist the engine state (CreateCheckpoint analog)."""
+        self.flush_mem_only()
+        os.makedirs(path, exist_ok=True)
+        for i, r in enumerate(self.runs):
+            np.savez(
+                os.path.join(path, f"run{i:04d}.npz"),
+                key=np.asarray(r.key), ts=np.asarray(r.ts),
+                txn=np.asarray(r.txn), tomb=np.asarray(r.tomb),
+                value=np.asarray(r.value), vlen=np.asarray(r.vlen),
+                mask=np.asarray(r.mask),
+            )
+        with open(os.path.join(path, "MANIFEST"), "w") as f:
+            f.write(f"{len(self.runs)} {self.key_width} {self.val_width}\n")
+
+    @classmethod
+    def open_checkpoint(cls, path: str, **kwargs) -> "Engine":
+        with open(os.path.join(path, "MANIFEST")) as f:
+            nruns, kw, vw = (int(x) for x in f.read().split())
+        eng = cls(key_width=kw, val_width=vw, **kwargs)
+        for i in range(nruns):
+            z = np.load(os.path.join(path, f"run{i:04d}.npz"))
+            eng.runs.append(
+                mvcc.KVBlock(
+                    key=jnp.asarray(z["key"]), ts=jnp.asarray(z["ts"]),
+                    txn=jnp.asarray(z["txn"]), tomb=jnp.asarray(z["tomb"]),
+                    value=jnp.asarray(z["value"]), vlen=jnp.asarray(z["vlen"]),
+                    mask=jnp.asarray(z["mask"]),
+                )
+            )
+        eng.stats.runs = len(eng.runs)
+        return eng

@@ -1,0 +1,267 @@
+"""MVCC kernels — the pebbleMVCCScanner hot loop, TPU-first.
+
+Reference semantics (pkg/storage/pebble_mvcc_scanner.go:381): iterate entries
+sorted by (key asc, ts desc); per key pick the newest version with ts <=
+read_ts; skip deletion tombstones; an intent (provisional value of an
+uncommitted txn) at ts <= read_ts from another txn is a WriteIntentError,
+while the reader's own intent is visible regardless of its timestamp.
+
+The reference walks this one KV at a time per range scan. Here the whole
+sorted block is processed in one vectorized pass:
+
+- key-run boundaries come from comparing adjacent key word lanes;
+- "newest visible per key" is a segmented argmin over row position (rows are
+  already ts-desc within a key), via ``jax.ops.segment_min``;
+- intents, tombstones and bounds are boolean algebra over the block.
+
+Compaction (pebble's merging iterator + GC, the "LSM compaction k-way merge"
+north-star kernel) is the same machinery: sort the concatenation of runs by
+(key, ts desc) with XLA's lane-parallel sort, then a segmented pass drops
+versions shadowed below the GC threshold.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .keys import key_words, words_cmp_eq, words_in_range
+
+_BIG = np.int32(2**31 - 1)
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class KVBlock:
+    """Columnar MVCC entries over a static-capacity tile.
+
+    key   : [cap, KW] uint8 zero-padded key bytes
+    ts    : [cap] int64 version timestamp (HLC collapsed to one int64)
+    txn   : [cap] int64 intent owner txn id; 0 = committed
+    tomb  : [cap] bool deletion tombstone
+    value : [cap, VW] uint8 fixed-width value payload
+    vlen  : [cap] int32 logical value length
+    mask  : [cap] bool row liveness
+    """
+
+    key: jax.Array
+    ts: jax.Array
+    txn: jax.Array
+    tomb: jax.Array
+    value: jax.Array
+    vlen: jax.Array
+    mask: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.mask.shape[0]
+
+
+def empty_block(cap: int, key_width: int, val_width: int) -> KVBlock:
+    return KVBlock(
+        key=jnp.zeros((cap, key_width), jnp.uint8),
+        ts=jnp.zeros((cap,), jnp.int64),
+        txn=jnp.zeros((cap,), jnp.int64),
+        tomb=jnp.zeros((cap,), jnp.bool_),
+        value=jnp.zeros((cap, val_width), jnp.uint8),
+        vlen=jnp.zeros((cap,), jnp.int32),
+        mask=jnp.zeros((cap,), jnp.bool_),
+    )
+
+
+def block_from_host(
+    keys: np.ndarray,
+    ts: np.ndarray,
+    txn: np.ndarray,
+    tomb: np.ndarray,
+    value: np.ndarray,
+    vlen: np.ndarray,
+    cap: int | None = None,
+) -> KVBlock:
+    n = len(ts)
+    cap = cap or max(1, n)
+    b = empty_block(cap, keys.shape[1], value.shape[1])
+    return KVBlock(
+        key=b.key.at[:n].set(jnp.asarray(keys)),
+        ts=b.ts.at[:n].set(jnp.asarray(ts, dtype=jnp.int64)),
+        txn=b.txn.at[:n].set(jnp.asarray(txn, dtype=jnp.int64)),
+        tomb=b.tomb.at[:n].set(jnp.asarray(tomb, dtype=jnp.bool_)),
+        value=b.value.at[:n].set(jnp.asarray(value)),
+        vlen=b.vlen.at[:n].set(jnp.asarray(vlen, dtype=jnp.int32)),
+        mask=b.mask.at[:n].set(True),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sorting / merging
+
+
+@jax.jit
+def sort_block(block: KVBlock) -> KVBlock:
+    """Sort by (key asc, ts desc), dead rows last — the SST/memtable order
+    (pkg/storage/mvcc_key.go EncodeMVCCKey ordering)."""
+    words = key_words(block.key)
+    cap = block.capacity
+    operands = [~block.mask]
+    operands += [words[:, i] for i in range(words.shape[1])]
+    # ts desc: flip sign bit of the int64 bit pattern, then invert
+    operands.append(~(block.ts.astype(jnp.uint64) ^ np.uint64(1 << 63)))
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    res = jax.lax.sort(operands + [perm], num_keys=len(operands), is_stable=True)
+    p = res[-1]
+    return jax.tree_util.tree_map(lambda x: x[p], block)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def merge_blocks(blocks: tuple[KVBlock, ...], cap: int) -> KVBlock:
+    """K-way merge of sorted runs into one sorted tile of `cap` rows.
+
+    The reference merges with a loser-tree of iterators (pebble
+    mergingIter); on TPU the idiomatic merge of K sorted runs is a single
+    lane-parallel sort of the concatenation — XLA lowers it onto the VPU,
+    and the pre-sortedness costs nothing.
+    """
+    big = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *blocks
+    )
+    total = big.capacity
+    if total < cap:
+        pad = empty_block(cap - total, big.key.shape[1], big.value.shape[1])
+        big = jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), big, pad
+        )
+    return sort_block(big)
+
+
+# ---------------------------------------------------------------------------
+# The scan-filter kernel
+
+
+def _segments(block: KVBlock) -> jax.Array:
+    """Segment id per row: consecutive rows with equal keys share an id.
+    Requires the block sorted by key."""
+    words = key_words(block.key)
+    same = words_cmp_eq(words[1:], words[:-1]) & block.mask[1:] & block.mask[:-1]
+    boundary = jnp.concatenate([jnp.ones((1,), jnp.bool_), ~same])
+    return jnp.cumsum(boundary.astype(jnp.int32)) - 1
+
+
+@jax.jit
+def mvcc_scan_filter(
+    block: KVBlock,
+    read_ts: jax.Array,
+    reader_txn: jax.Array,
+    start_words: jax.Array | None = None,
+    end_words: jax.Array | None = None,
+):
+    """Newest-visible-version selection over a sorted block.
+
+    Returns (selected, conflict):
+      selected : [cap] bool — rows that the scan returns (newest version per
+                 key with ts <= read_ts, own intents always visible, deletion
+                 tombstones dropped, bounds applied)
+      conflict : [cap] bool — intents of *other* txns at ts <= read_ts that
+                 shadow the read (WriteIntentError rows; pebble_mvcc_scanner
+                 accumulates these the same way)
+    """
+    cap = block.capacity
+    words = key_words(block.key)
+    in_range = block.mask & words_in_range(words, start_words, end_words)
+    seg = _segments(block)
+
+    own = block.txn == reader_txn
+    committed = block.txn == 0
+    # visibility: committed at or before read_ts, or the reader's own intent
+    # (CRDB: a txn always reads its own provisional values)
+    visible = in_range & ((committed & (block.ts <= read_ts)) | (own & (block.txn != 0)))
+
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    cand_pos = jnp.where(visible, pos, _BIG)
+    first = jax.ops.segment_min(cand_pos, seg, num_segments=cap)
+    newest = visible & (pos == first[seg])
+
+    # an *other-txn* intent visible to this read shadows any selected version
+    # at-or-below it — that's a conflict, not a silent skip
+    conflict = (
+        in_range
+        & (block.txn != 0)
+        & ~own
+        & (block.ts <= read_ts)
+    )
+    # conflicts only matter if they are the newest candidate or newer than it:
+    # since rows are ts-desc, an intent above `first` within the segment
+    # conflicts; one below `first` is shadowed and irrelevant.
+    conflict = conflict & (pos <= first[seg])
+
+    selected = newest & ~block.tomb
+    return selected, conflict
+
+
+@functools.partial(jax.jit, static_argnames=("bottom",))
+def mvcc_gc_filter(block: KVBlock, gc_ts: jax.Array, bottom: bool):
+    """Compaction GC (pebble compaction + MVCC GC semantics, pkg/storage
+    mvcc.go GC): keep rows that are
+
+    - intents (never GC'd by compaction),
+    - versions with ts > gc_ts (still readable by someone), or
+    - the newest version at-or-below gc_ts per key — unless `bottom` and it
+      is a tombstone with nothing below it (tombstone elision at the last
+      level).
+    """
+    cap = block.capacity
+    seg = _segments(block)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+
+    old = block.mask & (block.txn == 0) & (block.ts <= gc_ts)
+    cand_pos = jnp.where(old, pos, _BIG)
+    first_old = jax.ops.segment_min(cand_pos, seg, num_segments=cap)
+    newest_old = old & (pos == first_old[seg])
+
+    keep = block.mask & (
+        (block.txn != 0) | (block.ts > gc_ts) | newest_old
+    )
+    if bottom:
+        # elide a kept tombstone when it is the oldest surviving row of its
+        # key (nothing below it to shadow)
+        keep_pos = jnp.where(keep, pos, -1)
+        last_keep = jax.ops.segment_max(keep_pos, seg, num_segments=cap)
+        elide = keep & block.tomb & newest_old & (pos == last_keep[seg])
+        keep = keep & ~elide
+    return keep
+
+
+# ---------------------------------------------------------------------------
+# Intent resolution
+
+
+@functools.partial(jax.jit, static_argnames=("commit",))
+def resolve_intents(
+    block: KVBlock, txn_id: jax.Array, commit_ts: jax.Array, commit: bool
+) -> KVBlock:
+    """Commit (rewrite to committed at commit_ts) or abort (drop) all intents
+    of one txn — intent resolution (reference: pkg/storage/mvcc.go
+    MVCCResolveWriteIntent), applied blockwise."""
+    is_intent = block.mask & (block.txn == txn_id) & (block.txn != 0)
+    if commit:
+        return KVBlock(
+            key=block.key,
+            ts=jnp.where(is_intent, commit_ts, block.ts),
+            txn=jnp.where(is_intent, 0, block.txn),
+            tomb=block.tomb,
+            value=block.value,
+            vlen=block.vlen,
+            mask=block.mask,
+        )
+    return KVBlock(
+        key=block.key,
+        ts=block.ts,
+        txn=block.txn,
+        tomb=block.tomb,
+        value=block.value,
+        vlen=block.vlen,
+        mask=block.mask & ~is_intent,
+    )
