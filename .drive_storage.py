@@ -1,0 +1,99 @@
+"""Drive the storage layer on the real TPU: engine write/scan/compact at
+multi-tile scale, diffed against a pure-python MVCC model."""
+
+import time
+
+import numpy as np
+
+import jax
+
+print("devices:", jax.devices())
+
+from cockroach_tpu.storage import Engine, WriteIntentError
+
+t0 = time.time()
+eng = Engine(val_width=12, memtable_size=8192, l0_trigger=4)
+model = {}  # key -> list[(ts, tomb, value)]
+
+rng = np.random.default_rng(7)
+N = 30000
+keys = [f"user{int(i):05d}".encode() for i in range(4000)]
+ts = 0
+for step in range(N):
+    ts += 1
+    k = keys[rng.integers(len(keys))]
+    if rng.random() < 0.9:
+        v = f"v{step}".encode()
+        eng.put(k, v, ts=ts)
+        model.setdefault(k, []).append((ts, False, v))
+    else:
+        eng.delete(k, ts=ts)
+        model.setdefault(k, []).append((ts, True, b""))
+print(f"wrote {N} ops in {time.time()-t0:.1f}s "
+      f"(flushes={eng.stats.flushes} compactions={eng.stats.compactions})")
+
+
+def model_scan(read_ts, lo=None, hi=None):
+    out = []
+    for k in sorted(model):
+        if lo is not None and k < lo:
+            continue
+        if hi is not None and k >= hi:
+            continue
+        vis = [x for x in model[k] if x[0] <= read_ts]
+        if vis:
+            newest = max(vis, key=lambda x: x[0])
+            if not newest[1]:
+                out.append((k, newest[2]))
+    return out
+
+
+for read_ts in (N, N // 2, N // 10, 1):
+    t0 = time.time()
+    got = eng.scan(None, None, ts=read_ts)
+    want = model_scan(read_ts)
+    assert got == want, f"scan@{read_ts}: {len(got)} vs {len(want)} rows"
+    print(f"scan@{read_ts}: {len(got)} rows OK in {time.time()-t0:.1f}s")
+
+# bounded scan + point gets
+got = eng.scan(b"user01000", b"user02000", ts=N)
+want = model_scan(N, b"user01000", b"user02000")
+assert got == want
+print(f"bounded scan: {len(got)} rows OK")
+for k in (keys[0], keys[-1], b"userXXXXX"):
+    vis = [x for x in model.get(k, []) if x[0] <= N]
+    newest = max(vis, key=lambda x: x[0]) if vis else None
+    want_v = None if newest is None or newest[1] else newest[2]
+    assert eng.get(k, ts=N) == want_v
+print("point gets OK")
+
+# intents: conflict, own-read, commit, abort
+eng.put(b"user00001", b"prov", ts=ts + 1, txn=99)
+try:
+    eng.scan(None, None, ts=ts + 2)
+    raise SystemExit("expected WriteIntentError")
+except WriteIntentError as e:
+    assert b"user00001" in e.keys
+assert eng.get(b"user00001", ts=ts + 2, txn=99) == b"prov"
+eng.resolve_intents(txn=99, commit_ts=ts + 2, commit=True)
+assert eng.get(b"user00001", ts=ts + 2) == b"prov"
+print("intent flow OK")
+
+# full compaction with GC threshold, then re-check latest snapshot
+eng.gc_ts = N // 2
+eng.compact()
+got = eng.scan(None, None, ts=N + 2)
+want = model_scan(N + 2)
+want = [(k, v) for k, v in want]
+# user00001 now has prov at ts+2
+assert got == sorted(
+    {**dict(want), b"user00001": b"prov"}.items()
+), "post-GC scan diverged"
+print(f"post-GC scan: {len(got)} rows OK; stats={eng.compute_stats()}")
+
+# empty engine edge
+e2 = Engine()
+assert e2.scan(None, None, ts=5) == [] and e2.get(b"x", ts=5) is None
+e2.compact()
+print("empty engine OK")
+print("ALL STORAGE DRIVES PASSED")

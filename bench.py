@@ -90,6 +90,67 @@ def _pandas_baseline(qname, cat, res) -> float:
             want.revenue.to_numpy(), rtol=1e-9,
         )
         return el
+    if qname == "q9":
+        import pandas as pd
+
+        o = tpch.to_pandas(cat, "orders")
+        s = tpch.to_pandas(cat, "supplier")
+        n = tpch.to_pandas(cat, "nation")
+        p = tpch.to_pandas(cat, "part")
+        ps = tpch.to_pandas(cat, "partsupp")
+        t0 = time.time()
+        pg = p[p.p_name.str.contains("green")]
+        j = (
+            li[li.l_partkey.isin(pg.p_partkey)]
+            .merge(ps, left_on=["l_partkey", "l_suppkey"],
+                   right_on=["ps_partkey", "ps_suppkey"])
+            .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+            .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+            .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        )
+        j["o_year"] = pd.to_datetime(
+            j.o_orderdate, unit="D", origin="unix"
+        ).dt.year
+        j["amount"] = (
+            j.l_extendedprice * (1 - j.l_discount)
+            - j.ps_supplycost * j.l_quantity
+        )
+        want = (
+            j.groupby(["n_name", "o_year"]).agg(sum_profit=("amount", "sum"))
+            .reset_index()
+            .sort_values(["n_name", "o_year"], ascending=[True, False])
+        )
+        el = time.time() - t0
+        np.testing.assert_allclose(
+            np.asarray(res["sum_profit"], dtype=np.float64),
+            want.sum_profit.to_numpy(), rtol=1e-9,
+        )
+        return el
+    if qname == "q18":
+        o = tpch.to_pandas(cat, "orders")
+        c = tpch.to_pandas(cat, "customer")
+        t0 = time.time()
+        qty = li.groupby("l_orderkey").l_quantity.sum()
+        big = qty[qty > 300].index
+        j = (
+            o[o.o_orderkey.isin(big)]
+            .merge(c, left_on="o_custkey", right_on="c_custkey")
+            .merge(li, left_on="o_orderkey", right_on="l_orderkey")
+        )
+        want = (
+            j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                       "o_totalprice"])
+            .agg(sum_qty=("l_quantity", "sum")).reset_index()
+            .sort_values(["o_totalprice", "o_orderdate"],
+                         ascending=[False, True])
+            .head(100)
+        )
+        el = time.time() - t0
+        np.testing.assert_allclose(
+            np.asarray(res["sum_qty"], dtype=np.float64),
+            want.sum_qty.to_numpy(), rtol=1e-12,
+        )
+        return el
     raise SystemExit(f"no pandas baseline for {qname}")
 
 

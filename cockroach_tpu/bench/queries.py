@@ -144,4 +144,178 @@ def q5(cat: Catalog, region: str = "ASIA", date: str = "1994-01-01") -> Rel:
     return g.sort([("revenue", True)])
 
 
-QUERIES = {"q1": q1, "q3": q3, "q5": q5, "q6": q6}
+def q4(cat: Catalog, date: str = "1993-07-01") -> Rel:
+    """Order priority checking: EXISTS (late lineitem) as a semi join."""
+    late = Rel.scan(cat, "lineitem", ("l_orderkey", "l_commitdate",
+                                      "l_receiptdate"))
+    late = late.filter(
+        ex.Cmp("lt", late.c("l_commitdate"), late.c("l_receiptdate"))
+    )
+    orders = Rel.scan(cat, "orders", ("o_orderkey", "o_orderdate",
+                                      "o_orderpriority"))
+    orders = orders.filter(ex.and_(
+        ex.Cmp("ge", orders.c("o_orderdate"), ex.lit(d(date))),
+        ex.Cmp("lt", orders.c("o_orderdate"), ex.lit(d(date) + 92)),
+    ))
+    j = orders.join(late, on=[("o_orderkey", "l_orderkey")], how="semi",
+                    build_unique=False)
+    g = j.groupby(["o_orderpriority"], [("order_count", "count_rows", None)])
+    return g.sort([("o_orderpriority", False)])
+
+
+def q9(cat: Catalog, color: str = "green") -> Rel:
+    """Product type profit: 6-way join, LIKE filter on p_name, profit by
+    (nation, year of order date)."""
+    part = Rel.scan(cat, "part", ("p_partkey", "p_name"))
+    part = part.filter(part.str_pred("p_name", lambda s: color in s))
+    supp = Rel.scan(cat, "supplier", ("s_suppkey", "s_nationkey"))
+    nat = Rel.scan(cat, "nation", ("n_nationkey", "n_name"))
+    ps = Rel.scan(cat, "partsupp", ("ps_partkey", "ps_suppkey",
+                                    "ps_supplycost"))
+    orders = Rel.scan(cat, "orders", ("o_orderkey", "o_orderdate"))
+    li = Rel.scan(cat, "lineitem", (
+        "l_orderkey", "l_partkey", "l_suppkey", "l_quantity",
+        "l_extendedprice", "l_discount",
+    ))
+    j = li.join(part, on=[("l_partkey", "p_partkey")], how="semi")
+    j = j.join(ps, on=[("l_partkey", "ps_partkey"),
+                       ("l_suppkey", "ps_suppkey")], how="inner")
+    j = j.join(supp, on=[("l_suppkey", "s_suppkey")], how="inner")
+    j = j.join(nat, on=[("s_nationkey", "n_nationkey")], how="inner")
+    j = j.join(orders, on=[("l_orderkey", "o_orderkey")], how="inner")
+    one = ex.Const(1.0, j.type_of("l_discount"))
+    amount = ex.BinOp(
+        "-",
+        ex.BinOp("*", j.c("l_extendedprice"),
+                 ex.BinOp("-", one, j.c("l_discount"))),
+        ex.BinOp("*", j.c("ps_supplycost"), j.c("l_quantity")),
+    )
+    j = j.project([
+        ("nation", j.c("n_name")),
+        ("o_year", ex.ExtractYear(j.c("o_orderdate"))),
+        ("amount", amount),
+    ])
+    g = j.groupby(["nation", "o_year"], [("sum_profit", "sum", "amount")])
+    return g.sort([("nation", False), ("o_year", True)])
+
+
+def q10(cat: Catalog, date: str = "1993-10-01") -> Rel:
+    """Returned item reporting: top 20 customers by lost revenue."""
+    orders = Rel.scan(cat, "orders", ("o_orderkey", "o_custkey",
+                                      "o_orderdate"))
+    orders = orders.filter(ex.and_(
+        ex.Cmp("ge", orders.c("o_orderdate"), ex.lit(d(date))),
+        ex.Cmp("lt", orders.c("o_orderdate"), ex.lit(d(date) + 92)),
+    ))
+    li = Rel.scan(cat, "lineitem", ("l_orderkey", "l_extendedprice",
+                                    "l_discount", "l_returnflag"))
+    li = li.filter(li.str_eq("l_returnflag", "R"))
+    j = li.join(orders, on=[("l_orderkey", "o_orderkey")], how="inner")
+    cust = Rel.scan(cat, "customer", (
+        "c_custkey", "c_name", "c_acctbal", "c_nationkey", "c_phone",
+        "c_address", "c_comment",
+    ))
+    j = j.join(cust, on=[("o_custkey", "c_custkey")], how="inner")
+    nat = Rel.scan(cat, "nation", ("n_nationkey", "n_name"))
+    j = j.join(nat, on=[("c_nationkey", "n_nationkey")], how="inner")
+    one = ex.Const(1.0, j.type_of("l_discount"))
+    rev = ex.BinOp("*", j.c("l_extendedprice"),
+                   ex.BinOp("-", one, j.c("l_discount")))
+    j = j.project([
+        ("c_custkey", j.c("c_custkey")), ("c_name", j.c("c_name")),
+        ("rev", rev), ("c_acctbal", j.c("c_acctbal")),
+        ("n_name", j.c("n_name")), ("c_address", j.c("c_address")),
+        ("c_phone", j.c("c_phone")), ("c_comment", j.c("c_comment")),
+    ])
+    g = j.groupby(
+        ["c_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+         "c_address", "c_comment"],
+        [("revenue", "sum", "rev")],
+    )
+    return g.sort([("revenue", True), ("c_custkey", False)]).limit(20)
+
+
+def q12(cat: Catalog, mode1: str = "MAIL", mode2: str = "SHIP",
+        date: str = "1994-01-01") -> Rel:
+    """Shipping modes and order priority: CASE aggregation."""
+    li = Rel.scan(cat, "lineitem", (
+        "l_orderkey", "l_shipmode", "l_commitdate", "l_receiptdate",
+        "l_shipdate",
+    ))
+    li = li.filter(ex.and_(
+        li.str_in("l_shipmode", [mode1, mode2]),
+        ex.Cmp("lt", li.c("l_commitdate"), li.c("l_receiptdate")),
+        ex.Cmp("lt", li.c("l_shipdate"), li.c("l_commitdate")),
+        ex.Cmp("ge", li.c("l_receiptdate"), ex.lit(d(date))),
+        ex.Cmp("lt", li.c("l_receiptdate"), ex.lit(d(date) + 365)),
+    ))
+    orders = Rel.scan(cat, "orders", ("o_orderkey", "o_orderpriority"))
+    j = li.join(orders, on=[("l_orderkey", "o_orderkey")], how="inner")
+    high = j.str_in("o_orderpriority", ["1-URGENT", "2-HIGH"])
+    one, zero = ex.lit(1), ex.lit(0)
+    j = j.project([
+        ("l_shipmode", j.c("l_shipmode")),
+        ("high", ex.Case(((high, one),), zero)),
+        ("low", ex.Case(((ex.Not(high), one),), zero)),
+    ])
+    g = j.groupby(["l_shipmode"], [
+        ("high_line_count", "sum", "high"),
+        ("low_line_count", "sum", "low"),
+    ])
+    return g.sort([("l_shipmode", False)])
+
+
+def q14(cat: Catalog, date: str = "1995-09-01") -> Rel:
+    """Promotion effect: 100 * promo revenue / total revenue."""
+    li = Rel.scan(cat, "lineitem", ("l_partkey", "l_extendedprice",
+                                    "l_discount", "l_shipdate"))
+    li = li.filter(ex.and_(
+        ex.Cmp("ge", li.c("l_shipdate"), ex.lit(d(date))),
+        ex.Cmp("lt", li.c("l_shipdate"), ex.lit(d(date) + 30)),
+    ))
+    part = Rel.scan(cat, "part", ("p_partkey", "p_type"))
+    j = li.join(part, on=[("l_partkey", "p_partkey")], how="inner")
+    promo = j.str_pred("p_type", lambda s: s.startswith("PROMO"))
+    one = ex.Const(1.0, j.type_of("l_discount"))
+    rev = ex.BinOp("*", j.c("l_extendedprice"),
+                   ex.BinOp("-", one, j.c("l_discount")))
+    zero = ex.Const(0.0, ex.expr_type(rev, j.schema))
+    j = j.project([
+        ("promo_rev", ex.Case(((promo, rev),), zero)),
+        ("rev", rev),
+    ])
+    g = j.scalar_agg([
+        ("promo", "sum", "promo_rev"), ("total", "sum", "rev"),
+    ])
+    ratio = ex.BinOp("/", g.c("promo"), g.c("total"))
+    hundred = ex.Const(100.0, ex.expr_type(ratio, g.schema))
+    return g.project([("promo_revenue", ex.BinOp("*", hundred, ratio))])
+
+
+def q18(cat: Catalog, quantity: int = 300) -> Rel:
+    """Large volume customer: HAVING subquery as groupby-filter-semi-join,
+    top 100 by order value."""
+    li = Rel.scan(cat, "lineitem", ("l_orderkey", "l_quantity"))
+    big = li.groupby(["l_orderkey"], [("sum_qty", "sum", "l_quantity")])
+    big = big.filter(ex.Cmp(
+        "gt", big.c("sum_qty"), ex.Const(quantity, big.type_of("sum_qty"))
+    ))
+    orders = Rel.scan(cat, "orders", (
+        "o_orderkey", "o_custkey", "o_orderdate", "o_totalprice",
+    ))
+    orders = orders.join(big, on=[("o_orderkey", "l_orderkey")], how="semi")
+    cust = Rel.scan(cat, "customer", ("c_custkey", "c_name"))
+    j = orders.join(cust, on=[("o_custkey", "c_custkey")], how="inner")
+    li2 = Rel.scan(cat, "lineitem", ("l_orderkey", "l_quantity"))
+    j2 = li2.join(j, on=[("l_orderkey", "o_orderkey")], how="inner")
+    g = j2.groupby(
+        ["c_name", "c_custkey", "o_orderkey", "o_orderdate", "o_totalprice"],
+        [("sum_qty", "sum", "l_quantity")],
+    )
+    return g.sort([("o_totalprice", True), ("o_orderdate", False)]).limit(100)
+
+
+QUERIES = {
+    "q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q9": q9,
+    "q10": q10, "q12": q12, "q14": q14, "q18": q18,
+}

@@ -193,12 +193,15 @@ def gen_tpch(sf: float = 0.01, seed: int = 19920101) -> Catalog:
     ))
 
     # partsupp: 4 suppliers per part (spec formula)
+    # dbgen's stride (S/4 + (pk-1)/S) can produce duplicate suppliers per part
+    # at scaled-down S; a plain S/4 stride keeps i*stride distinct mod S for
+    # i in 0..3 at every scale (3*floor(S/4) < S), preserving the spec's
+    # "4 distinct suppliers per part" invariant that unique-build joins rely on
+    ps_stride = max(1, n_supp // 4)
     ps_partkey = np.repeat(partkey, 4)
     n_ps = len(ps_partkey)
     i = np.tile(np.arange(4), n_part)
-    ps_suppkey = (
-        (ps_partkey + i * (n_supp // 4 + (ps_partkey - 1) // n_supp)) % n_supp
-    ) + 1
+    ps_suppkey = ((ps_partkey + i * ps_stride) % n_supp) + 1
     cat.add(Table.from_strings(
         "partsupp",
         Schema.of(ps_partkey=INT64, ps_suppkey=INT64, ps_availqty=INT64,
@@ -251,8 +254,7 @@ def gen_tpch(sf: float = 0.01, seed: int = 19920101) -> Catalog:
     ).astype(np.int64)
     l_partkey = rng.integers(1, n_part + 1, n_li, dtype=np.int64)
     l_suppkey = (
-        (l_partkey + rng.integers(0, 4, n_li) *
-         (n_supp // 4 + (l_partkey - 1) // n_supp)) % n_supp
+        (l_partkey + rng.integers(0, 4, n_li) * ps_stride) % n_supp
     ).astype(np.int64) + 1
     l_quantity = rng.integers(1, 51, n_li, dtype=np.int64) * 100  # DEC2
     l_extprice = (l_quantity // 100) * retail[l_partkey - 1]

@@ -20,7 +20,7 @@ def dfs(cat):
     return {
         n: tpch.to_pandas(cat, n)
         for n in ("lineitem", "orders", "customer", "nation", "region",
-                  "supplier")
+                  "supplier", "part", "partsupp")
     }
 
 
@@ -92,6 +92,134 @@ def test_q6(cat, dfs):
     ]
     want = (f.l_extendedprice * f.l_discount).sum()
     np.testing.assert_allclose(res["revenue"][0], want, rtol=1e-9)
+
+
+def test_q4(cat, dfs):
+    res = Q.q4(cat).run()
+    li, o = dfs["lineitem"], dfs["orders"]
+    date = tpch.d("1993-07-01")
+    of = o[(o.o_orderdate >= date) & (o.o_orderdate < date + 92)]
+    late = li[li.l_commitdate < li.l_receiptdate].l_orderkey.unique()
+    j = of[of.o_orderkey.isin(late)]
+    want = (
+        j.groupby("o_orderpriority").size().reset_index(name="order_count")
+        .sort_values("o_orderpriority")
+    )
+    np.testing.assert_array_equal(res["o_orderpriority"], want.o_orderpriority)
+    np.testing.assert_array_equal(res["order_count"], want.order_count)
+
+
+def test_q9(cat, dfs):
+    res = Q.q9(cat).run()
+    li, o, s = dfs["lineitem"], dfs["orders"], dfs["supplier"]
+    n, p, ps = dfs["nation"], dfs["part"], dfs["partsupp"]
+    pg = p[p.p_name.str.contains("green")]
+    j = (
+        li[li.l_partkey.isin(pg.p_partkey)]
+        .merge(ps, left_on=["l_partkey", "l_suppkey"],
+               right_on=["ps_partkey", "ps_suppkey"])
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+        .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+    )
+    j["o_year"] = (
+        pd.to_datetime(j.o_orderdate, unit="D", origin="unix").dt.year
+    )
+    j["amount"] = (
+        (j.l_extendedprice * (1 - j.l_discount)).round(4)
+        - (j.ps_supplycost * j.l_quantity).round(4)
+    )
+    want = (
+        j.groupby(["n_name", "o_year"]).agg(sum_profit=("amount", "sum"))
+        .reset_index().sort_values(["n_name", "o_year"],
+                                   ascending=[True, False])
+    )
+    assert len(res["nation"]) == len(want)
+    np.testing.assert_array_equal(res["nation"], want.n_name)
+    np.testing.assert_array_equal(res["o_year"], want.o_year)
+    np.testing.assert_allclose(res["sum_profit"], want.sum_profit, rtol=1e-9)
+
+
+def test_q10(cat, dfs):
+    res = Q.q10(cat).run()
+    li, o, c, n = dfs["lineitem"], dfs["orders"], dfs["customer"], dfs["nation"]
+    date = tpch.d("1993-10-01")
+    of = o[(o.o_orderdate >= date) & (o.o_orderdate < date + 92)]
+    j = (
+        li[li.l_returnflag == "R"]
+        .merge(of, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(n, left_on="c_nationkey", right_on="n_nationkey")
+    )
+    j["rev"] = j.l_extendedprice * (1 - j.l_discount)
+    want = (
+        j.groupby(["c_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+                   "c_address", "c_comment"])
+        .agg(revenue=("rev", "sum")).reset_index()
+        .sort_values(["revenue", "c_custkey"], ascending=[False, True])
+        .head(20)
+    )
+    assert len(res["c_custkey"]) == len(want)
+    np.testing.assert_array_equal(res["c_custkey"], want.c_custkey)
+    np.testing.assert_allclose(res["revenue"], want.revenue, rtol=1e-9)
+
+
+def test_q12(cat, dfs):
+    res = Q.q12(cat).run()
+    li, o = dfs["lineitem"], dfs["orders"]
+    date = tpch.d("1994-01-01")
+    f = li[
+        li.l_shipmode.isin(["MAIL", "SHIP"])
+        & (li.l_commitdate < li.l_receiptdate)
+        & (li.l_shipdate < li.l_commitdate)
+        & (li.l_receiptdate >= date)
+        & (li.l_receiptdate < date + 365)
+    ].merge(o, left_on="l_orderkey", right_on="o_orderkey")
+    f["high"] = f.o_orderpriority.isin(["1-URGENT", "2-HIGH"]).astype(int)
+    f["low"] = 1 - f.high
+    want = (
+        f.groupby("l_shipmode").agg(
+            high_line_count=("high", "sum"), low_line_count=("low", "sum")
+        ).reset_index().sort_values("l_shipmode")
+    )
+    np.testing.assert_array_equal(res["l_shipmode"], want.l_shipmode)
+    np.testing.assert_array_equal(res["high_line_count"], want.high_line_count)
+    np.testing.assert_array_equal(res["low_line_count"], want.low_line_count)
+
+
+def test_q14(cat, dfs):
+    res = Q.q14(cat).run()
+    li, p = dfs["lineitem"], dfs["part"]
+    date = tpch.d("1995-09-01")
+    f = li[(li.l_shipdate >= date) & (li.l_shipdate < date + 30)].merge(
+        p, left_on="l_partkey", right_on="p_partkey"
+    )
+    f["rev"] = f.l_extendedprice * (1 - f.l_discount)
+    promo = f[f.p_type.str.startswith("PROMO")].rev.sum()
+    want = 100.0 * promo / f.rev.sum()
+    np.testing.assert_allclose(res["promo_revenue"][0], want, rtol=1e-9)
+
+
+def test_q18(cat, dfs):
+    res = Q.q18(cat).run()
+    li, o, c = dfs["lineitem"], dfs["orders"], dfs["customer"]
+    qty = li.groupby("l_orderkey").l_quantity.sum()
+    big = qty[qty > 300].index
+    j = (
+        o[o.o_orderkey.isin(big)]
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    )
+    want = (
+        j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                   "o_totalprice"])
+        .agg(sum_qty=("l_quantity", "sum")).reset_index()
+        .sort_values(["o_totalprice", "o_orderdate"], ascending=[False, True])
+        .head(100)
+    )
+    assert len(res["o_orderkey"]) == len(want)
+    np.testing.assert_array_equal(res["o_orderkey"], want.o_orderkey)
+    np.testing.assert_allclose(res["sum_qty"], want.sum_qty, rtol=1e-12)
 
 
 def test_q5(cat, dfs):
