@@ -1,0 +1,8 @@
+"""SQL front end: parser (pkg/sql/parser analog), binder (optbuilder analog),
+and the Rel fluent plan builder. ``sql(catalog, text)`` parses + plans a
+SELECT into an executable Rel."""
+
+from .binder import BindError, sql
+from .rel import Rel
+
+__all__ = ["BindError", "Rel", "sql"]

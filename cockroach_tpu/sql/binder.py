@@ -1,0 +1,840 @@
+"""SQL binder/lowering — the optbuilder analog (pkg/sql/opt/optbuilder).
+
+Turns a parsed ``Select`` AST into a ``Rel`` plan against a catalog:
+
+- FROM sources bind to scans (or nested Selects); implicit-join queries are
+  planned by extracting equi-join conjuncts from WHERE and greedily joining
+  connected sources largest-probe-first (a cut-down version of the join
+  ordering the reference's cost-based xform rules perform);
+- single-source conjuncts push down below the join (the norm rules'
+  filter-pushdown equivalent);
+- EXISTS / IN (SELECT ...) decorrelate into semi/anti joins on the
+  correlated equality columns (optbuilder's subquery hoisting);
+- aggregation splits into pre-projection -> groupby -> HAVING filter ->
+  post-projection, with aggregates collected across SELECT/HAVING/ORDER BY;
+- string predicates (LIKE, =, IN, range) lower to host-prepared dictionary
+  lookups (CodeLookup), date/interval literal arithmetic constant-folds to
+  day literals on the host.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..catalog import Catalog
+from ..coldata.types import FLOAT64, INT64, Family, SQLType
+from ..ops import expr as ex
+from . import parser as P
+from .rel import Rel
+
+AGG_FUNCS = {"sum", "avg", "min", "max", "count"}
+
+
+class BindError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _conjuncts(e: P.Node | None) -> list[P.Node]:
+    if e is None:
+        return []
+    if isinstance(e, P.Bin) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _days(date_str: str) -> int:
+    return int(
+        (np.datetime64(date_str) - np.datetime64("1970-01-01")).astype(int)
+    )
+
+
+def _date_add(days: int, n: int, unit: str) -> int:
+    """Calendar-correct date + interval on the host (constant folding)."""
+    d = np.datetime64("1970-01-01") + np.timedelta64(days, "D")
+    if unit == "day":
+        d = d + np.timedelta64(n, "D")
+    elif unit == "month":
+        m = d.astype("datetime64[M]") + np.timedelta64(n, "M")
+        dom = (d - d.astype("datetime64[M]")).astype(int)
+        d = m.astype("datetime64[D]") + np.timedelta64(dom, "D")
+    elif unit == "year":
+        return _date_add(days, 12 * n, "month")
+    else:
+        raise BindError(f"unsupported interval unit {unit}")
+    return int((d - np.datetime64("1970-01-01")).astype(int))
+
+
+def _fold(e: P.Node) -> P.Node:
+    """Fold date/interval/numeric literal arithmetic into literals."""
+    if isinstance(e, P.Bin) and e.op in ("+", "-"):
+        l, r = _fold(e.left), _fold(e.right)
+        if isinstance(l, P.NumLit) and isinstance(r, P.IntervalLit):
+            # folded DateLits are day numbers; intervals add calendar-exactly
+            n = r.n if e.op == "+" else -r.n
+            return P.NumLit(_date_add(int(l.value), n, r.unit))
+        if isinstance(l, P.NumLit) and isinstance(r, P.NumLit):
+            v = l.value + r.value if e.op == "+" else l.value - r.value
+            return P.NumLit(v)
+        return P.Bin(e.op, l, r)
+    if isinstance(e, P.DateLit):
+        return P.NumLit(_days(e.value))
+    return e
+
+
+def _like_regex(pattern: str) -> re.Pattern:
+    parts = []
+    for ch in pattern:
+        if ch == "%":
+            parts.append(".*")
+        elif ch == "_":
+            parts.append(".")
+        else:
+            parts.append(re.escape(ch))
+    return re.compile("^" + "".join(parts) + "$", re.DOTALL)
+
+
+def _walk(e: P.Node):
+    yield e
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, P.Node) and not isinstance(v, P.Select):
+            yield from _walk(v)
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, P.Node) and not isinstance(x, P.Select):
+                    yield from _walk(x)
+                elif (isinstance(x, tuple) and len(x) == 2
+                      and isinstance(x[0], P.Node)):
+                    yield from _walk(x[0])
+                    yield from _walk(x[1])
+
+
+def _has_agg(e: P.Node) -> bool:
+    return any(
+        isinstance(x, P.FuncCall) and x.name in AGG_FUNCS for x in _walk(e)
+    )
+
+
+# ---------------------------------------------------------------------------
+# bound sources
+
+
+@dataclass
+class Source:
+    """One FROM item bound to a Rel, with name scoping."""
+
+    alias: str
+    rel: Rel
+    cols: tuple[str, ...]  # output names as exposed to the query
+    # base-table cardinality, captured before filter pushdown (join ordering
+    # still sees the true relative sizes); subqueries get a large default
+    base_rows: int = 1 << 30
+
+
+class Scope:
+    """Resolves Ident -> (source index, column name)."""
+
+    def __init__(self, sources: list[Source]):
+        self.sources = sources
+
+    def resolve(self, ident: P.Ident) -> tuple[int, str]:
+        if ident.table is not None:
+            for i, s in enumerate(self.sources):
+                if s.alias == ident.table:
+                    if ident.name not in s.cols:
+                        raise BindError(
+                            f"column {ident.name} not in {ident.table}"
+                        )
+                    return i, ident.name
+            raise BindError(f"unknown table alias {ident.table}")
+        hits = [
+            (i, ident.name)
+            for i, s in enumerate(self.sources)
+            if ident.name in s.cols
+        ]
+        if not hits:
+            raise BindError(f"unknown column {ident.name}")
+        if len(hits) > 1:
+            raise BindError(f"ambiguous column {ident.name}")
+        return hits[0]
+
+    def sources_of(self, e: P.Node) -> set[int]:
+        out = set()
+        for x in _walk(e):
+            if isinstance(x, P.Ident):
+                out.add(self.resolve(x)[0])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# expression lowering against a single Rel
+
+
+class ExprLowerer:
+    """Lower AST expressions against one Rel's schema (after joins)."""
+
+    def __init__(self, rel: Rel, names: dict[str, int] | None = None):
+        self.rel = rel
+        # name -> column index (defaults to the rel's schema)
+        self.names = names or {
+            n: i for i, n in enumerate(rel.schema.names)
+        }
+
+    def idx(self, ident: P.Ident) -> int:
+        if ident.name in self.names:
+            return self.names[ident.name]
+        raise BindError(f"unknown column {ident.name}")
+
+    def _is_string_col(self, e: P.Node) -> int | None:
+        if isinstance(e, P.Ident):
+            i = self.idx(e)
+            if self.rel.schema.types[i].family is Family.STRING:
+                return i
+        return None
+
+    def _colname(self, i: int) -> str:
+        return self.rel.schema.names[i]
+
+    def lower(self, e: P.Node) -> ex.Expr:
+        e = _fold(e)
+        if isinstance(e, P.Ident):
+            return ex.ColRef(self.idx(e))
+        if isinstance(e, P.NumLit):
+            if isinstance(e.value, int):
+                return ex.lit(int(e.value))
+            return ex.Const(float(e.value), FLOAT64)
+        if isinstance(e, P.NullLit):
+            return ex.Const(None, INT64)
+        if isinstance(e, P.Bin) and e.op in ("and", "or"):
+            return ex.BoolOp(e.op, (self.lower(e.left), self.lower(e.right)))
+        if isinstance(e, P.Bin):
+            if e.op == "%":
+                raise BindError("modulo not supported on device")
+            return ex.BinOp(e.op, self.lower(e.left), self.lower(e.right))
+        if isinstance(e, P.Not):
+            return ex.Not(self.lower(e.arg))
+        if isinstance(e, P.IsNull):
+            return ex.IsNull(self.lower(e.arg), negate=e.negated)
+        if isinstance(e, P.Cmp):
+            return self.lower_cmp(e)
+        if isinstance(e, P.Between):
+            b = ex.and_(
+                self.lower(P.Cmp("ge", e.arg, e.lo)),
+                self.lower(P.Cmp("le", e.arg, e.hi)),
+            )
+            return ex.Not(b) if e.negated else b
+        if isinstance(e, P.Like):
+            i = self._is_string_col(e.arg)
+            if i is None:
+                raise BindError("LIKE requires a string column")
+            rx = _like_regex(e.pattern)
+            pred = self.rel.str_pred(
+                self._colname(i), lambda s: rx.match(s) is not None
+            )
+            return ex.Not(pred) if e.negated else pred
+        if isinstance(e, P.InList):
+            i = self._is_string_col(e.arg)
+            if i is not None:
+                vals = [
+                    x.value for x in e.items if isinstance(x, P.StrLit)
+                ]
+                if len(vals) != len(e.items):
+                    raise BindError("string IN list must be all literals")
+                pred = self.rel.str_in(self._colname(i), vals)
+                return ex.Not(pred) if e.negated else pred
+            if (isinstance(e.arg, P.FuncCall)
+                    and e.arg.name == "substring"):
+                return self.lower_substring_in(e)
+            arg = self.lower(e.arg)
+            cmps = [
+                ex.Cmp("eq", arg, self.lower(x)) for x in e.items
+            ]
+            pred = ex.or_(*cmps) if len(cmps) > 1 else cmps[0]
+            return ex.Not(pred) if e.negated else pred
+        if isinstance(e, P.Case):
+            whens = tuple(
+                (self.lower(c), self.lower(v)) for c, v in e.whens
+            )
+            if e.otherwise is None:
+                otherwise = ex.Const(None, ex.expr_type(
+                    whens[0][1], self.rel.schema))
+            else:
+                otherwise = self.lower(e.otherwise)
+            return ex.Case(whens, otherwise)
+        if isinstance(e, P.Cast):
+            to = {
+                "int": INT64, "integer": INT64, "bigint": INT64,
+                "float": FLOAT64, "double": FLOAT64, "real": FLOAT64,
+                "decimal": SQLType(Family.DECIMAL, precision=38, scale=2),
+                "numeric": SQLType(Family.DECIMAL, precision=38, scale=2),
+            }.get(e.to)
+            if to is None:
+                raise BindError(f"unsupported cast target {e.to}")
+            return ex.Cast(self.lower(e.arg), to)
+        if isinstance(e, P.Extract):
+            if e.part != "year":
+                raise BindError(f"EXTRACT({e.part}) not supported")
+            return ex.ExtractYear(self.lower(e.arg))
+        if isinstance(e, P.FuncCall) and e.name in AGG_FUNCS:
+            raise BindError(
+                f"aggregate {e.name} not allowed in this context"
+            )
+        raise BindError(f"cannot lower expression {e}")
+
+    def lower_cmp(self, e: P.Cmp) -> ex.Expr:
+        # string column vs string literal
+        for a, b, flip in ((e.left, e.right, False), (e.right, e.left, True)):
+            i = self._is_string_col(a)
+            if i is not None and isinstance(b, P.StrLit):
+                name = self._colname(i)
+                op = e.op
+                if flip:
+                    op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                          "eq": "eq", "ne": "ne"}[op]
+                if op == "eq":
+                    return self.rel.str_eq(name, b.value)
+                if op == "ne":
+                    return ex.Not(self.rel.str_eq(name, b.value))
+                return self.rel.str_cmp(name, op, b.value)
+        # substring(col from a for n) = 'lit'  (Q22 country-code pattern)
+        if (isinstance(e.left, P.FuncCall) and e.left.name == "substring"
+                and isinstance(e.right, P.StrLit)):
+            return self.lower_substring_in(
+                P.InList(e.left, (e.right,), negated=(e.op == "ne"))
+            )
+        l = self.lower(e.left)
+        r = self.lower(e.right)
+        # exact decimal compare: float literal vs DECIMAL column folds to a
+        # scaled-int literal when representable (avoids fp rounding surprises)
+        lt = ex.expr_type(l, self.rel.schema)
+        rt = ex.expr_type(r, self.rel.schema)
+        if (lt.family is Family.DECIMAL and isinstance(r, ex.Const)
+                and rt.family is Family.FLOAT):
+            scaled = r.value * (10 ** lt.scale)
+            if abs(scaled - round(scaled)) < 1e-9:
+                r = ex.Const(r.value, lt)
+        if (rt.family is Family.DECIMAL and isinstance(l, ex.Const)
+                and lt.family is Family.FLOAT):
+            scaled = l.value * (10 ** rt.scale)
+            if abs(scaled - round(scaled)) < 1e-9:
+                l = ex.Const(l.value, rt)
+        return ex.Cmp(e.op, l, r)
+
+    def lower_substring_in(self, e: P.InList) -> ex.Expr:
+        fc = e.arg
+        col = fc.args[0]
+        i = self._is_string_col(col)
+        if i is None:
+            raise BindError("substring requires a string column")
+        start = int(fc.args[1].value) - 1
+        n = int(fc.args[2].value)
+        vals = {x.value for x in e.items}
+        pred = self.rel.str_pred(
+            self._colname(i), lambda s: s[start:start + n] in vals
+        )
+        return ex.Not(pred) if e.negated else pred
+
+
+# ---------------------------------------------------------------------------
+# the binder
+
+
+class Binder:
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+
+    def bind(self, sel: P.Select) -> Rel:
+        sources, join_filters = self._bind_from(sel.from_)
+        scope = Scope(sources)
+
+        conjuncts = [(_fold(c)) for c in _conjuncts(sel.where)]
+        conjuncts = join_filters + conjuncts
+
+        # classify conjuncts
+        equi_edges: list[tuple[int, str, int, str]] = []
+        per_source: dict[int, list[P.Node]] = {}
+        residual: list[P.Node] = []
+        sub_joins: list[tuple[P.Node, set[int]]] = []
+        for c in conjuncts:
+            if isinstance(c, (P.Exists, P.InSelect)) or (
+                isinstance(c, P.Not)
+                and isinstance(c.arg, (P.Exists, P.InSelect))
+            ):
+                node = c.arg if isinstance(c, P.Not) else c
+                negate = isinstance(c, P.Not)
+                sub_joins.append((node, negate))
+                continue
+            if isinstance(c, P.Cmp) and c.op == "eq" and \
+                    isinstance(c.left, P.Ident) and isinstance(c.right, P.Ident):
+                li, ln = scope.resolve(c.left)
+                ri, rn = scope.resolve(c.right)
+                if li != ri:
+                    equi_edges.append((li, ln, ri, rn))
+                    continue
+            srcs = scope.sources_of(c)
+            if len(srcs) == 1:
+                per_source.setdefault(next(iter(srcs)), []).append(c)
+            else:
+                residual.append(c)
+
+        # scalar subqueries inside residual/per-source conjuncts: execute
+        # uncorrelated ones now (constant folding through the engine)
+        # (correlated scalar subqueries are future work)
+
+        # push single-source filters down
+        for i, preds in per_source.items():
+            s = sources[i]
+            lower = ExprLowerer(s.rel)
+            for p in preds:
+                s.rel = s.rel.filter(self._lower_with_subqueries(lower, p))
+                lower = ExprLowerer(s.rel)
+
+        # greedy join order: start at the largest source
+        joined = self._join_sources(sources, equi_edges, scope)
+
+        # decorrelated EXISTS / IN-select as semi/anti joins
+        for node, negate in sub_joins:
+            joined = self._apply_sub_join(joined, node, negate, scope, sources)
+
+        # residual multi-source predicates
+        if residual:
+            lower = ExprLowerer(joined.rel)
+            for c in residual:
+                joined.rel = joined.rel.filter(
+                    self._lower_with_subqueries(lower, c))
+                lower = ExprLowerer(joined.rel)
+
+        return self._finish(sel, joined.rel)
+
+    # -- FROM ---------------------------------------------------------------
+
+    def _bind_from(self, items) -> tuple[list[Source], list[P.Node]]:
+        sources: list[Source] = []
+        join_filters: list[P.Node] = []
+
+        def bind_item(it):
+            if isinstance(it, P.TableRef):
+                rel = Rel.scan(self.catalog, it.name)
+                sources.append(
+                    Source(it.alias or it.name, rel, rel.schema.names,
+                           base_rows=self.catalog.get(it.name).num_rows)
+                )
+            elif isinstance(it, P.SubqueryRef):
+                rel = self.bind(it.select)
+                sources.append(Source(it.alias, rel, rel.schema.names))
+            elif isinstance(it, P.Join):
+                bind_item(it.left)
+                bind_item(it.right)
+                # ON conjuncts go into the shared predicate pool; the join
+                # planner extracts the equi keys (left-join ON handled below)
+                if it.kind != "inner":
+                    raise BindError(
+                        "outer joins are planned explicitly (future work)"
+                    )
+                join_filters.extend(_conjuncts(it.on))
+            else:
+                raise BindError(f"unsupported FROM item {it}")
+
+        for it in items:
+            bind_item(it)
+        return sources, join_filters
+
+    # -- join planning ------------------------------------------------------
+
+    def _join_sources(self, sources, equi_edges, scope) -> "BoundQuery":
+        n = len(sources)
+        if n == 1:
+            return BoundQuery(sources[0].rel, {0: sources[0]})
+        sizes = [s.base_rows for s in sources]
+        start = max(range(n), key=lambda i: sizes[i])
+        placed = {start}
+        rel = sources[start].rel
+        while len(placed) < n:
+            # find edges from placed to unplaced
+            cand: dict[int, list[tuple[str, str]]] = {}
+            for li, ln, ri, rn in equi_edges:
+                if li in placed and ri not in placed:
+                    cand.setdefault(ri, []).append((ln, rn))
+                elif ri in placed and li not in placed:
+                    cand.setdefault(li, []).append((rn, ln))
+            if not cand:
+                raise BindError("cross join required but not supported")
+            # smallest build side first
+            nxt = min(cand, key=lambda i: sizes[i])
+            on = cand[nxt]
+            rel = rel.join(
+                sources[nxt].rel, on=on, how="inner", build_unique=False
+            )
+            placed.add(nxt)
+        return BoundQuery(rel, {i: sources[i] for i in placed})
+
+    def _apply_sub_join(self, joined: "BoundQuery", node, negate, scope,
+                        sources) -> "BoundQuery":
+        if isinstance(node, P.InSelect):
+            how = "anti" if (negate != node.negated) else "semi"
+            sub = self.bind_subquery_for_in(node.select)
+            arg = node.arg
+            if not isinstance(arg, P.Ident):
+                raise BindError("IN (SELECT) argument must be a column")
+            outer_col = arg.name
+            inner_col = sub.schema.names[0]
+            joined.rel = joined.rel.join(
+                sub, on=[(outer_col, inner_col)], how=how, build_unique=False
+            )
+            return joined
+        how = "anti" if negate else "semi"
+        if isinstance(node, P.Exists):
+            # correlated equality conjuncts reference outer columns
+            sub_sel = node.select
+            inner_rel, corr = self._bind_correlated(sub_sel, joined)
+            joined.rel = joined.rel.join(
+                inner_rel, on=corr, how=how, build_unique=False
+            )
+            return joined
+        raise BindError(f"unsupported subquery predicate {node}")
+
+    def bind_subquery_for_in(self, sel: P.Select) -> Rel:
+        rel = self.bind(sel)
+        if len(rel.schema) != 1:
+            raise BindError("IN subquery must produce one column")
+        return rel
+
+    def _bind_correlated(self, sel: P.Select, joined: "BoundQuery"):
+        """Bind an EXISTS subquery: conjuncts of its WHERE that are
+        equality with an outer column become the semi-join keys."""
+        inner_sources, jf = self._bind_from(sel.from_)
+        if len(inner_sources) != 1:
+            raise BindError("correlated EXISTS supports one inner table")
+        inner = inner_sources[0]
+        outer_names = set(joined.rel.schema.names)
+
+        def side(ident: P.Ident) -> str:
+            """'inner' | 'outer' for one identifier, honoring qualifiers.
+            An unqualified name present on both sides is ambiguous."""
+            if ident.table is not None:
+                if ident.table == inner.alias:
+                    return "inner"
+                return "outer"
+            inn = ident.name in inner.cols
+            out = ident.name in outer_names
+            if inn and out:
+                raise BindError(
+                    f"ambiguous correlated column {ident.name}: qualify it"
+                )
+            if inn:
+                return "inner"
+            if out:
+                return "outer"
+            raise BindError(f"unknown column {ident.name}")
+
+        corr: list[tuple[str, str]] = []
+        inner_preds: list[P.Node] = []
+        for c in jf + [(_fold(x)) for x in _conjuncts(sel.where)]:
+            if (isinstance(c, P.Cmp) and c.op == "eq"
+                    and isinstance(c.left, P.Ident)
+                    and isinstance(c.right, P.Ident)):
+                ls, rs = side(c.left), side(c.right)
+                if ls == "inner" and rs == "outer":
+                    corr.append((c.right.name, c.left.name))
+                    continue
+                if rs == "inner" and ls == "outer":
+                    corr.append((c.left.name, c.right.name))
+                    continue
+            # any other predicate must be purely inner; an outer reference
+            # here is a correlation shape the semi-join rewrite can't express
+            for x in _walk(c):
+                if isinstance(x, P.Ident) and side(x) == "outer":
+                    raise BindError(
+                        "correlated non-equality predicate "
+                        f"({x.table or ''}.{x.name}) not supported"
+                    )
+            inner_preds.append(c)
+        rel = inner.rel
+        for p in inner_preds:
+            rel = rel.filter(ExprLowerer(rel).lower(p))
+        if not corr:
+            raise BindError("uncorrelated EXISTS not supported")
+        return rel, corr
+
+    def _lower_with_subqueries(self, lower: ExprLowerer, c: P.Node) -> ex.Expr:
+        """Lower a predicate, executing uncorrelated scalar subqueries into
+        literals first (the one-row result is a plan-time constant)."""
+        c = self._replace_scalar_subqueries(c)
+        return lower.lower(c)
+
+    def _replace_scalar_subqueries(self, c: P.Node) -> P.Node:
+        if isinstance(c, P.ScalarSubquery):
+            rel = self.bind(c.select)
+            res = rel.run()
+            if len(rel.schema) != 1:
+                raise BindError("scalar subquery must produce one column")
+            col = res[rel.schema.names[0]]
+            if len(col) != 1:
+                raise BindError("scalar subquery returned != 1 row")
+            v = col[0]
+            if isinstance(v, (str, bytes)):
+                return P.StrLit(v if isinstance(v, str) else v.decode())
+            if np.asarray(v).dtype.kind in "iu":
+                return P.NumLit(int(v))
+            return P.NumLit(float(v))
+        if isinstance(c, P.Cmp):
+            return P.Cmp(c.op, self._replace_scalar_subqueries(c.left),
+                         self._replace_scalar_subqueries(c.right))
+        if isinstance(c, P.Bin):
+            return P.Bin(c.op, self._replace_scalar_subqueries(c.left),
+                         self._replace_scalar_subqueries(c.right))
+        if isinstance(c, P.Not):
+            return P.Not(self._replace_scalar_subqueries(c.arg))
+        return c
+
+    # -- SELECT list / aggregation / ordering -------------------------------
+
+    def _finish(self, sel: P.Select, rel: Rel) -> Rel:
+        has_agg = (
+            bool(sel.group_by)
+            or any(_has_agg(it.expr) for it in sel.items)
+            or (sel.having is not None and _has_agg(sel.having))
+        )
+        if has_agg:
+            rel = self._aggregate(sel, rel)
+        else:
+            rel = self._project(sel, rel)
+        if sel.distinct:
+            rel = rel.distinct()
+        rel = self._order_limit(sel, rel)
+        return rel
+
+    def _project(self, sel: P.Select, rel: Rel) -> Rel:
+        items: list[tuple[str, ex.Expr]] = []
+        expr_names: dict[P.Node, str] = {}
+        used: set[str] = set()
+        lower = ExprLowerer(rel)
+        for it in sel.items:
+            if isinstance(it.expr, P.Star):
+                for n in rel.schema.names:
+                    items.append((self._uniq(n, used), ex.ColRef(rel.idx(n))))
+                continue
+            name = self._uniq(
+                it.alias or self._default_name(it.expr, len(items)), used
+            )
+            items.append((name, lower.lower(it.expr)))
+            expr_names[it.expr] = name
+        # resolve ORDER BY to output columns, adding hidden ones as needed
+        hidden: list[tuple[str, ex.Expr]] = []
+        order_keys: list[tuple[str, bool]] = []
+        for o in sel.order_by:
+            if o.expr in expr_names:
+                order_keys.append((expr_names[o.expr], o.desc))
+            elif isinstance(o.expr, P.NumLit):
+                order_keys.append((items[int(o.expr.value) - 1][0], o.desc))
+            elif (isinstance(o.expr, P.Ident)
+                  and o.expr.name in {n for n, _ in items}):
+                order_keys.append((o.expr.name, o.desc))
+            elif (isinstance(o.expr, P.Ident)
+                  and o.expr.name in rel.schema.names):
+                hn = self._uniq(o.expr.name, used)
+                hidden.append((hn, ex.ColRef(rel.idx(o.expr.name))))
+                order_keys.append((hn, o.desc))
+            else:
+                raise BindError(f"cannot order by {o.expr}")
+        proj = rel.project(items + hidden)
+        proj._visible = len(items)  # order_limit projects hidden cols away
+        proj._order_keys = order_keys
+        return proj
+
+    def _aggregate(self, sel: P.Select, rel: Rel) -> Rel:
+        # 1. collect aggregate calls across SELECT + HAVING + ORDER BY
+        aggs: dict[P.FuncCall, str] = {}
+
+        def collect(e: P.Node):
+            for x in _walk(e):
+                if isinstance(x, P.FuncCall) and x.name in AGG_FUNCS:
+                    if x not in aggs:
+                        aggs[x] = f"_agg{len(aggs)}"
+
+        for it in sel.items:
+            collect(it.expr)
+        if sel.having is not None:
+            collect(sel.having)
+        for o in sel.order_by:
+            collect(o.expr)
+
+        # 2. group keys: group_by exprs; give names. A bare name that is a
+        # select alias (and not an input column) refers to that expression
+        alias_map = {it.alias: it.expr for it in sel.items if it.alias}
+        group_items: list[tuple[str, P.Node]] = []
+        for g in sel.group_by:
+            if (isinstance(g, P.Ident) and g.table is None
+                    and g.name not in rel.schema.names
+                    and g.name in alias_map):
+                group_items.append((g.name, alias_map[g.name]))
+            elif isinstance(g, P.Ident):
+                group_items.append((g.name, g))
+            else:
+                # find a select alias with the same expression
+                alias = None
+                for it in sel.items:
+                    if it.expr == g and it.alias:
+                        alias = it.alias
+                if alias is None:
+                    alias = f"_g{len(group_items)}"
+                group_items.append((alias, g))
+
+        # 3. pre-projection: group keys + agg inputs
+        lower = ExprLowerer(rel)
+        pre: list[tuple[str, ex.Expr]] = []
+        for name, g in group_items:
+            pre.append((name, lower.lower(g)))
+        agg_specs: list[tuple[str, str, str | None]] = []
+        for fc, name in aggs.items():
+            func = fc.name
+            if func == "count" and (
+                not fc.args or isinstance(fc.args[0], P.Star)
+            ):
+                agg_specs.append((name, "count_rows", None))
+                continue
+            if fc.distinct:
+                raise BindError("DISTINCT aggregates not supported yet")
+            in_name = f"{name}_in"
+            pre.append((in_name, lower.lower(fc.args[0])))
+            agg_specs.append((name, func, in_name))
+        rel2 = rel.project(pre)
+        if group_items:
+            g = rel2.groupby([n for n, _ in group_items], agg_specs)
+        else:
+            g = rel2.scalar_agg(agg_specs)
+
+        # 4. HAVING
+        if sel.having is not None:
+            g = g.filter(self._lower_agg_expr(g, sel.having, aggs, group_items))
+
+        # 5. post-projection for the SELECT list
+        post: list[tuple[str, ex.Expr]] = []
+        expr_names: dict[P.Node, str] = {}
+        used: set[str] = set()
+        gnames = {n for n, _ in group_items}
+        for it in sel.items:
+            name = self._uniq(
+                it.alias or self._default_name(it.expr, len(post)), used
+            )
+            if name in gnames:  # aliased group key: already a groupby column
+                post.append((name, ex.ColRef(g.idx(name))))
+            else:
+                post.append((name, self._lower_agg_expr(
+                    g, it.expr, aggs, group_items)))
+            expr_names[it.expr] = name
+        out_names = {n for n, _ in post}
+        hidden: list[tuple[str, ex.Expr]] = []
+        order_keys: list[tuple[str, bool]] = []
+        for o in sel.order_by:
+            if o.expr in expr_names:
+                order_keys.append((expr_names[o.expr], o.desc))
+            elif isinstance(o.expr, P.NumLit):
+                order_keys.append((post[int(o.expr.value) - 1][0], o.desc))
+            elif isinstance(o.expr, P.Ident) and o.expr.name in out_names:
+                order_keys.append((o.expr.name, o.desc))
+            elif (isinstance(o.expr, P.Ident)
+                  and o.expr.name in g.schema.names):
+                hn = self._uniq(o.expr.name, used)
+                hidden.append((hn, ex.ColRef(g.idx(o.expr.name))))
+                order_keys.append((hn, o.desc))
+            elif isinstance(o.expr, P.FuncCall) and o.expr in aggs:
+                # an aggregate ordered by but not selected: hidden column
+                nm = self._uniq(aggs[o.expr], used)
+                hidden.append((nm, ex.ColRef(g.idx(aggs[o.expr]))))
+                order_keys.append((nm, o.desc))
+            else:
+                raise BindError(f"cannot order by {o.expr}")
+        proj = g.project(post + hidden)
+        proj._visible = len(post)
+        proj._order_keys = order_keys
+        return proj
+
+    def _lower_agg_expr(self, g: Rel, e: P.Node, aggs, group_items,
+                        name_ok: bool = False) -> ex.Expr:
+        """Lower an expression over the groupby output: aggregate calls become
+        references to their output columns."""
+        e = _fold(e)
+        if isinstance(e, P.FuncCall) and e.name in AGG_FUNCS:
+            return ex.ColRef(g.idx(aggs[e]))
+        if isinstance(e, P.Ident):
+            return ex.ColRef(g.idx(e.name))
+        if isinstance(e, P.Bin) and e.op in ("and", "or"):
+            return ex.BoolOp(e.op, (
+                self._lower_agg_expr(g, e.left, aggs, group_items),
+                self._lower_agg_expr(g, e.right, aggs, group_items),
+            ))
+        if isinstance(e, P.Bin):
+            return ex.BinOp(e.op,
+                            self._lower_agg_expr(g, e.left, aggs, group_items),
+                            self._lower_agg_expr(g, e.right, aggs, group_items))
+        if isinstance(e, P.Cmp):
+            return ex.Cmp(e.op,
+                          self._lower_agg_expr(g, e.left, aggs, group_items),
+                          self._lower_agg_expr(g, e.right, aggs, group_items))
+        if isinstance(e, P.NumLit):
+            if isinstance(e.value, int):
+                return ex.lit(int(e.value))
+            return ex.Const(float(e.value), FLOAT64)
+        # fall back to plain lowering over the groupby schema (strings etc.)
+        return ExprLowerer(g).lower(e)
+
+    def _default_name(self, e: P.Node, i: int) -> str:
+        if isinstance(e, P.Ident):
+            return e.name
+        if isinstance(e, P.FuncCall):
+            return e.name
+        return f"col{i}"
+
+    @staticmethod
+    def _uniq(name: str, used: set[str]) -> str:
+        out = name
+        k = 1
+        while out in used:
+            out = f"{name}_{k}"
+            k += 1
+        used.add(out)
+        return out
+
+    def _order_limit(self, sel: P.Select, rel: Rel) -> Rel:
+        visible = getattr(rel, "_visible", None)
+        order_keys = getattr(rel, "_order_keys", None)
+        if sel.order_by:
+            if order_keys is None:  # e.g. DISTINCT re-wrapped the projection
+                order_keys = []
+                for o in sel.order_by:
+                    if (isinstance(o.expr, P.Ident)
+                            and o.expr.name in rel.schema.names):
+                        order_keys.append((o.expr.name, o.desc))
+                    elif isinstance(o.expr, P.NumLit):
+                        order_keys.append(
+                            (rel.schema.names[int(o.expr.value) - 1], o.desc))
+                    else:
+                        raise BindError(f"cannot order by {o.expr}")
+            rel = rel.sort(order_keys)
+        if sel.limit is not None or sel.offset:
+            # OFFSET without LIMIT: a sentinel that stays inside the int32
+            # row-position arithmetic of the limit operator
+            limit = sel.limit if sel.limit is not None else (1 << 30)
+            rel = rel.limit(limit, sel.offset)
+        if visible is not None and visible < len(rel.schema):
+            rel = rel.select(*rel.schema.names[:visible])
+        return rel
+
+
+@dataclass
+class BoundQuery:
+    rel: Rel
+    sources: dict[int, Source]
+
+
+def sql(catalog: Catalog, text: str) -> Rel:
+    """Parse + bind a SELECT statement into an executable Rel."""
+    return Binder(catalog).bind(P.parse(text))

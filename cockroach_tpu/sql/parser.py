@@ -1,0 +1,607 @@
+"""SQL parser — the pkg/sql/parser analog (reference grammar: sql.y).
+
+A hand-written recursive-descent parser for the SELECT dialect the engine
+executes (TPC-H coverage: implicit and explicit joins, GROUP BY/HAVING,
+ORDER BY/LIMIT, CASE, EXTRACT, CAST, BETWEEN, IN lists and subqueries,
+EXISTS, LIKE, date/interval literal arithmetic, scalar subqueries). The
+reference uses a goyacc grammar producing sem/tree ASTs; here the AST is a
+small dataclass tree lowered to relational plans by sql/binder.py, the
+optbuilder analog.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+# ---------------------------------------------------------------------------
+# Tokens
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>--[^\n]*)
+  | (?P<num>\d+\.\d+|\.\d+|\d+)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><=|>=|<>|!=|\|\||[-+*/%(),.;<>=])
+    """,
+    re.VERBOSE,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "in", "exists", "between", "like",
+    "is", "null", "case", "when", "then", "else", "end", "cast", "extract",
+    "year", "month", "day", "date", "interval", "join", "inner", "left",
+    "right", "outer", "on", "asc", "desc", "distinct", "all", "union",
+    "substring", "for", "true", "false", "any", "some",
+}
+
+
+@dataclass
+class Token:
+    kind: str  # name | kw | num | str | op | eof
+    value: str
+    pos: int
+
+
+def tokenize(text: str) -> list[Token]:
+    out = []
+    i = 0
+    while i < len(text):
+        m = _TOKEN_RE.match(text, i)
+        if not m:
+            raise SyntaxError(f"cannot tokenize at {text[i:i+20]!r}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind in ("ws", "comment"):
+            continue
+        v = m.group()
+        if kind == "name":
+            low = v.lower()
+            if low in KEYWORDS:
+                out.append(Token("kw", low, m.start()))
+            else:
+                out.append(Token("name", v.lower(), m.start()))
+        elif kind == "str":
+            out.append(Token("str", v[1:-1].replace("''", "'"), m.start()))
+        else:
+            out.append(Token(kind, v, m.start()))
+    out.append(Token("eof", "", len(text)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST
+
+
+class Node:
+    pass
+
+
+@dataclass(frozen=True)
+class Ident(Node):
+    table: Optional[str]  # qualifier or None
+    name: str
+
+
+@dataclass(frozen=True)
+class NumLit(Node):
+    value: float | int
+
+
+@dataclass(frozen=True)
+class StrLit(Node):
+    value: str
+
+
+@dataclass(frozen=True)
+class DateLit(Node):
+    value: str  # YYYY-MM-DD
+
+
+@dataclass(frozen=True)
+class IntervalLit(Node):
+    n: int
+    unit: str  # day | month | year
+
+
+@dataclass(frozen=True)
+class NullLit(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class Star(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class FuncCall(Node):
+    name: str
+    args: tuple[Node, ...]
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class Bin(Node):
+    op: str  # + - * / || and or
+    left: Node
+    right: Node
+
+
+@dataclass(frozen=True)
+class Cmp(Node):
+    op: str  # lt le gt ge eq ne
+    left: Node
+    right: Node
+
+
+@dataclass(frozen=True)
+class Not(Node):
+    arg: Node
+
+
+@dataclass(frozen=True)
+class Between(Node):
+    arg: Node
+    lo: Node
+    hi: Node
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Like(Node):
+    arg: Node
+    pattern: str
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InList(Node):
+    arg: Node
+    items: tuple[Node, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InSelect(Node):
+    arg: Node
+    select: "Select"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Exists(Node):
+    select: "Select"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Node):
+    select: "Select"
+
+
+@dataclass(frozen=True)
+class Case(Node):
+    whens: tuple[tuple[Node, Node], ...]
+    otherwise: Optional[Node]
+
+
+@dataclass(frozen=True)
+class Cast(Node):
+    arg: Node
+    to: str  # type name
+
+
+@dataclass(frozen=True)
+class Extract(Node):
+    part: str  # year | month | day
+    arg: Node
+
+
+@dataclass(frozen=True)
+class IsNull(Node):
+    arg: Node
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str]
+
+
+@dataclass(frozen=True)
+class TableRef(Node):
+    name: str
+    alias: Optional[str]
+
+
+@dataclass(frozen=True)
+class SubqueryRef(Node):
+    select: "Select"
+    alias: str
+
+
+@dataclass(frozen=True)
+class Join(Node):
+    left: Node
+    right: Node
+    kind: str  # inner | left
+    on: Optional[Node]
+
+
+@dataclass(frozen=True)
+class OrderItem(Node):
+    expr: Node
+    desc: bool
+
+
+@dataclass(frozen=True)
+class Select(Node):
+    items: tuple[SelectItem, ...]
+    from_: tuple[Node, ...]  # TableRef | SubqueryRef | Join
+    where: Optional[Node]
+    group_by: tuple[Node, ...]
+    having: Optional[Node]
+    order_by: tuple[OrderItem, ...]
+    limit: Optional[int]
+    offset: int = 0
+    distinct: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Parser
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.toks = tokenize(text)
+        self.i = 0
+
+    # -- plumbing -----------------------------------------------------------
+
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "kw" and t.value in kws
+
+    def eat_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str):
+        if not self.eat_kw(kw):
+            t = self.peek()
+            raise SyntaxError(f"expected {kw!r}, got {t.value!r} at {t.pos}")
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def eat_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.eat_op(op):
+            t = self.peek()
+            raise SyntaxError(f"expected {op!r}, got {t.value!r} at {t.pos}")
+
+    # -- entry --------------------------------------------------------------
+
+    def parse(self) -> Select:
+        s = self.parse_select()
+        self.eat_op(";")
+        if self.peek().kind != "eof":
+            t = self.peek()
+            raise SyntaxError(f"trailing input at {t.pos}: {t.value!r}")
+        return s
+
+    def parse_select(self) -> Select:
+        self.expect_kw("select")
+        distinct = bool(self.eat_kw("distinct"))
+        self.eat_kw("all")
+        items = [self.parse_select_item()]
+        while self.eat_op(","):
+            items.append(self.parse_select_item())
+        self.expect_kw("from")
+        from_ = [self.parse_table_expr()]
+        while self.eat_op(","):
+            from_.append(self.parse_table_expr())
+        where = self.parse_expr() if self.eat_kw("where") else None
+        group_by: list[Node] = []
+        if self.eat_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.parse_expr())
+            while self.eat_op(","):
+                group_by.append(self.parse_expr())
+        having = self.parse_expr() if self.eat_kw("having") else None
+        order_by: list[OrderItem] = []
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.parse_order_item())
+            while self.eat_op(","):
+                order_by.append(self.parse_order_item())
+        limit = None
+        offset = 0
+        if self.eat_kw("limit"):
+            limit = int(self.next().value)
+        if self.eat_kw("offset"):
+            offset = int(self.next().value)
+        return Select(
+            items=tuple(items), from_=tuple(from_), where=where,
+            group_by=tuple(group_by), having=having, order_by=tuple(order_by),
+            limit=limit, offset=offset, distinct=distinct,
+        )
+
+    def parse_select_item(self) -> SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return SelectItem(Star(), None)
+        e = self.parse_expr()
+        alias = None
+        if self.eat_kw("as"):
+            alias = self.next().value
+        elif self.peek().kind == "name":
+            alias = self.next().value
+        return SelectItem(e, alias)
+
+    def parse_order_item(self) -> OrderItem:
+        e = self.parse_expr()
+        desc = False
+        if self.eat_kw("desc"):
+            desc = True
+        else:
+            self.eat_kw("asc")
+        return OrderItem(e, desc)
+
+    def parse_table_expr(self) -> Node:
+        left = self.parse_table_primary()
+        while True:
+            kind = None
+            if self.at_kw("join", "inner"):
+                self.eat_kw("inner")
+                self.expect_kw("join")
+                kind = "inner"
+            elif self.at_kw("left"):
+                self.next()
+                self.eat_kw("outer")
+                self.expect_kw("join")
+                kind = "left"
+            else:
+                return left
+            right = self.parse_table_primary()
+            on = None
+            if self.eat_kw("on"):
+                on = self.parse_expr()
+            left = Join(left, right, kind, on)
+
+    def parse_table_primary(self) -> Node:
+        if self.eat_op("("):
+            sub = self.parse_select()
+            self.expect_op(")")
+            self.eat_kw("as")
+            alias = self.next().value
+            return SubqueryRef(sub, alias)
+        name = self.next().value
+        alias = None
+        if self.eat_kw("as"):
+            alias = self.next().value
+        elif self.peek().kind == "name":
+            alias = self.next().value
+        return TableRef(name, alias)
+
+    # -- expressions (precedence climbing) ----------------------------------
+
+    def parse_expr(self) -> Node:
+        return self.parse_or()
+
+    def parse_or(self) -> Node:
+        e = self.parse_and()
+        while self.eat_kw("or"):
+            e = Bin("or", e, self.parse_and())
+        return e
+
+    def parse_and(self) -> Node:
+        e = self.parse_not()
+        while self.eat_kw("and"):
+            e = Bin("and", e, self.parse_not())
+        return e
+
+    def parse_not(self) -> Node:
+        if self.eat_kw("not"):
+            return Not(self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> Node:
+        if self.at_kw("exists"):
+            self.next()
+            self.expect_op("(")
+            sub = self.parse_select()
+            self.expect_op(")")
+            return Exists(sub)
+        e = self.parse_additive()
+        negated = bool(self.eat_kw("not"))
+        if self.eat_kw("between"):
+            lo = self.parse_additive()
+            self.expect_kw("and")
+            hi = self.parse_additive()
+            return Between(e, lo, hi, negated)
+        if self.eat_kw("like"):
+            pat = self.next()
+            if pat.kind != "str":
+                raise SyntaxError("LIKE pattern must be a string literal")
+            return Like(e, pat.value, negated)
+        if self.eat_kw("in"):
+            self.expect_op("(")
+            if self.at_kw("select"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return InSelect(e, sub, negated)
+            items = [self.parse_expr()]
+            while self.eat_op(","):
+                items.append(self.parse_expr())
+            self.expect_op(")")
+            return InList(e, tuple(items), negated)
+        if negated:
+            raise SyntaxError("dangling NOT")
+        if self.eat_kw("is"):
+            neg = bool(self.eat_kw("not"))
+            self.expect_kw("null")
+            return IsNull(e, neg)
+        ops = {"<": "lt", "<=": "le", ">": "gt", ">=": "ge", "=": "eq",
+               "<>": "ne", "!=": "ne"}
+        t = self.peek()
+        if t.kind == "op" and t.value in ops:
+            self.next()
+            rhs = self.parse_additive()
+            return Cmp(ops[t.value], e, rhs)
+        return e
+
+    def parse_additive(self) -> Node:
+        e = self.parse_multiplicative()
+        while True:
+            if self.at_op("+", "-"):
+                op = self.next().value
+                e = Bin(op, e, self.parse_multiplicative())
+            elif self.at_op("||"):
+                self.next()
+                e = Bin("||", e, self.parse_multiplicative())
+            else:
+                return e
+
+    def parse_multiplicative(self) -> Node:
+        e = self.parse_unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().value
+            e = Bin(op, e, self.parse_unary())
+        return e
+
+    def parse_unary(self) -> Node:
+        if self.eat_op("-"):
+            return Bin("-", NumLit(0), self.parse_unary())
+        if self.eat_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> Node:
+        t = self.peek()
+        if t.kind == "num":
+            self.next()
+            v = float(t.value) if "." in t.value else int(t.value)
+            return NumLit(v)
+        if t.kind == "str":
+            self.next()
+            return StrLit(t.value)
+        if self.at_kw("null"):
+            self.next()
+            return NullLit()
+        if self.at_kw("true"):
+            self.next()
+            return NumLit(1)
+        if self.at_kw("false"):
+            self.next()
+            return NumLit(0)
+        if self.at_kw("date"):
+            self.next()
+            lit = self.next()
+            if lit.kind != "str":
+                raise SyntaxError("date literal must be a string")
+            return DateLit(lit.value)
+        if self.at_kw("interval"):
+            self.next()
+            n = self.next()
+            unit = self.next().value.rstrip("s")
+            return IntervalLit(int(n.value), unit)
+        if self.at_kw("case"):
+            return self.parse_case()
+        if self.at_kw("cast"):
+            self.next()
+            self.expect_op("(")
+            arg = self.parse_expr()
+            self.expect_kw("as")
+            to = self.next().value
+            # consume optional (p[,s]) type parameters
+            if self.eat_op("("):
+                while not self.eat_op(")"):
+                    self.next()
+            self.expect_op(")")
+            return Cast(arg, to)
+        if self.at_kw("extract"):
+            self.next()
+            self.expect_op("(")
+            part = self.next().value
+            self.expect_kw("from")
+            arg = self.parse_expr()
+            self.expect_op(")")
+            return Extract(part, arg)
+        if self.at_kw("substring"):
+            self.next()
+            self.expect_op("(")
+            arg = self.parse_expr()
+            self.expect_kw("from")
+            start = int(self.next().value)
+            self.expect_kw("for")
+            ln = int(self.next().value)
+            self.expect_op(")")
+            return FuncCall("substring", (arg, NumLit(start), NumLit(ln)))
+        if self.eat_op("("):
+            if self.at_kw("select"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return ScalarSubquery(sub)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "name" or t.kind == "kw":
+            self.next()
+            name = t.value
+            if self.at_op("("):  # function call
+                self.next()
+                distinct = bool(self.eat_kw("distinct"))
+                args: list[Node] = []
+                if self.at_op("*"):
+                    self.next()
+                    args.append(Star())
+                elif not self.at_op(")"):
+                    args.append(self.parse_expr())
+                    while self.eat_op(","):
+                        args.append(self.parse_expr())
+                self.expect_op(")")
+                return FuncCall(name, tuple(args), distinct)
+            if self.eat_op("."):
+                col = self.next().value
+                return Ident(name, col)
+            return Ident(None, name)
+        raise SyntaxError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def parse_case(self) -> Case:
+        self.expect_kw("case")
+        whens = []
+        while self.eat_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            val = self.parse_expr()
+            whens.append((cond, val))
+        otherwise = self.parse_expr() if self.eat_kw("else") else None
+        self.expect_kw("end")
+        return Case(tuple(whens), otherwise)
+
+
+def parse(text: str) -> Select:
+    return Parser(text).parse()

@@ -1,0 +1,323 @@
+"""SQL front-end tests: parse + bind TPC-H SQL and diff against the
+hand-built Rel plans (the reference's logictest analog — behavior parity
+between the SQL surface and the engine; pkg/sql/parser + optbuilder roles)."""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.bench import queries as Q
+from cockroach_tpu.bench import tpch
+from cockroach_tpu.sql import sql
+from cockroach_tpu.sql.parser import parse
+
+
+@pytest.fixture(scope="module")
+def cat():
+    return tpch.gen_tpch(sf=0.005, seed=7)
+
+
+# ---------------------------------------------------------------------------
+# parser unit tests
+
+
+def test_parse_simple():
+    s = parse("select a, b as bb from t where a > 3 order by bb desc limit 5")
+    assert len(s.items) == 2
+    assert s.items[1].alias == "bb"
+    assert s.limit == 5
+    assert s.order_by[0].desc
+
+
+def test_parse_join_group():
+    s = parse("""
+        select x, count(*) from t1 join t2 on t1.a = t2.b
+        where c between 1 and 2 group by x having count(*) > 1
+    """)
+    assert s.group_by and s.having is not None
+
+
+def test_parse_case_extract():
+    s = parse("""
+        select case when a = 1 then 2 else 3 end,
+               extract(year from d) from t
+    """)
+    assert len(s.items) == 2
+
+
+def test_parse_date_interval():
+    s = parse("select a from t where d < date '1995-03-15' + interval '3' month")
+    assert s.where is not None
+
+
+def test_parse_errors():
+    with pytest.raises(SyntaxError):
+        parse("select from t")
+    with pytest.raises(SyntaxError):
+        parse("select a t where")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: TPC-H SQL == hand-built plans
+
+TPCH_SQL = {
+    "q1": """
+        select l_returnflag, l_linestatus,
+               sum(l_quantity) as sum_qty,
+               sum(l_extendedprice) as sum_base_price,
+               sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+               sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+               avg(l_quantity) as avg_qty,
+               avg(l_extendedprice) as avg_price,
+               avg(l_discount) as avg_disc,
+               count(*) as count_order
+        from lineitem
+        where l_shipdate <= date '1998-12-01' - 90
+        group by l_returnflag, l_linestatus
+        order by l_returnflag, l_linestatus
+    """,
+    "q3": """
+        select l_orderkey,
+               sum(l_extendedprice * (1 - l_discount)) as revenue,
+               o_orderdate, o_shippriority
+        from customer, orders, lineitem
+        where c_mktsegment = 'BUILDING'
+          and c_custkey = o_custkey and l_orderkey = o_orderkey
+          and o_orderdate < date '1995-03-15'
+          and l_shipdate > date '1995-03-15'
+        group by l_orderkey, o_orderdate, o_shippriority
+        order by revenue desc, o_orderdate
+        limit 10
+    """,
+    "q4": """
+        select o_orderpriority, count(*) as order_count
+        from orders
+        where o_orderdate >= date '1993-07-01'
+          and o_orderdate < date '1993-07-01' + interval '3' month
+          and exists (
+            select * from lineitem
+            where l_orderkey = o_orderkey and l_commitdate < l_receiptdate
+          )
+        group by o_orderpriority
+        order by o_orderpriority
+    """,
+    "q6": """
+        select sum(l_extendedprice * l_discount) as revenue
+        from lineitem
+        where l_shipdate >= date '1994-01-01'
+          and l_shipdate < date '1994-01-01' + interval '1' year
+          and l_discount between 0.05 and 0.07
+          and l_quantity < 24
+    """,
+    "q10": """
+        select c_custkey, c_name,
+               sum(l_extendedprice * (1 - l_discount)) as revenue,
+               c_acctbal, n_name, c_address, c_phone, c_comment
+        from customer, orders, lineitem, nation
+        where c_custkey = o_custkey and l_orderkey = o_orderkey
+          and o_orderdate >= date '1993-10-01'
+          and o_orderdate < date '1993-10-01' + interval '3' month
+          and l_returnflag = 'R' and c_nationkey = n_nationkey
+        group by c_custkey, c_name, c_acctbal, c_phone, n_name, c_address,
+                 c_comment
+        order by revenue desc, c_custkey
+        limit 20
+    """,
+    "q5": """
+        select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+        from customer, orders, lineitem, supplier, nation, region
+        where c_custkey = o_custkey and l_orderkey = o_orderkey
+          and l_suppkey = s_suppkey and c_nationkey = s_nationkey
+          and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+          and r_name = 'ASIA'
+          and o_orderdate >= date '1994-01-01'
+          and o_orderdate < date '1994-01-01' + interval '1' year
+        group by n_name
+        order by revenue desc
+    """,
+    "q9": """
+        select n_name as nation,
+               extract(year from o_orderdate) as o_year,
+               sum(l_extendedprice * (1 - l_discount)
+                   - ps_supplycost * l_quantity) as sum_profit
+        from part, supplier, lineitem, partsupp, orders, nation
+        where s_suppkey = l_suppkey and ps_suppkey = l_suppkey
+          and ps_partkey = l_partkey and p_partkey = l_partkey
+          and o_orderkey = l_orderkey and s_nationkey = n_nationkey
+          and p_name like '%green%'
+        group by nation, o_year
+        order by nation, o_year desc
+    """,
+    "q14": """
+        select 100.00 * sum(case when p_type like 'PROMO%'
+                                 then l_extendedprice * (1 - l_discount)
+                                 else 0.0 end)
+               / sum(l_extendedprice * (1 - l_discount)) as promo_revenue
+        from lineitem, part
+        where l_partkey = p_partkey
+          and l_shipdate >= date '1995-09-01'
+          and l_shipdate < date '1995-10-01'
+    """,
+    "q18": """
+        select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+               sum(l_quantity) as sum_qty
+        from customer, orders, lineitem
+        where o_orderkey in (
+            select l_orderkey from lineitem
+            group by l_orderkey having sum(l_quantity) > 300
+          )
+          and c_custkey = o_custkey and o_orderkey = l_orderkey
+        group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+        order by o_totalprice desc, o_orderdate
+        limit 100
+    """,
+    "q12": """
+        select l_shipmode,
+               sum(case when o_orderpriority = '1-URGENT'
+                         or o_orderpriority = '2-HIGH'
+                        then 1 else 0 end) as high_line_count,
+               sum(case when o_orderpriority <> '1-URGENT'
+                        and o_orderpriority <> '2-HIGH'
+                        then 1 else 0 end) as low_line_count
+        from orders, lineitem
+        where o_orderkey = l_orderkey
+          and l_shipmode in ('MAIL', 'SHIP')
+          and l_commitdate < l_receiptdate
+          and l_shipdate < l_commitdate
+          and l_receiptdate >= date '1994-01-01'
+          and l_receiptdate < date '1994-01-01' + interval '1' year
+        group by l_shipmode
+        order by l_shipmode
+    """,
+}
+
+
+@pytest.mark.parametrize("qname", sorted(TPCH_SQL))
+def test_tpch_sql_matches_handbuilt(cat, qname):
+    got = sql(cat, TPCH_SQL[qname]).run()
+    want = Q.QUERIES[qname](cat).run()
+    assert set(got) >= set(want), f"missing columns: {set(want) - set(got)}"
+    for col in want:
+        w = want[col]
+        g = got[col]
+        assert len(g) == len(w), f"{col}: {len(g)} vs {len(w)} rows"
+        if w.dtype.kind == "f" or g.dtype.kind == "f":
+            np.testing.assert_allclose(
+                g.astype(np.float64), w.astype(np.float64), rtol=1e-9,
+                err_msg=col,
+            )
+        else:
+            np.testing.assert_array_equal(g, w, err_msg=col)
+
+
+def test_sql_scalar_subquery(cat):
+    got = sql(cat, """
+        select count(*) as n from lineitem
+        where l_extendedprice > (select avg(l_extendedprice) from lineitem)
+    """).run()
+    df = tpch.to_pandas(cat, "lineitem")
+    want = int((df.l_extendedprice > df.l_extendedprice.mean()).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_sql_in_select_semi(cat):
+    got = sql(cat, """
+        select count(*) as n from orders
+        where o_orderkey in (select l_orderkey from lineitem
+                             where l_quantity > 49)
+    """).run()
+    li = tpch.to_pandas(cat, "lineitem")
+    o = tpch.to_pandas(cat, "orders")
+    big = li[li.l_quantity > 49].l_orderkey.unique()
+    want = int(o.o_orderkey.isin(big).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_sql_not_in_select_anti(cat):
+    got = sql(cat, """
+        select count(*) as n from customer
+        where c_custkey not in (select o_custkey from orders)
+    """).run()
+    c = tpch.to_pandas(cat, "customer")
+    o = tpch.to_pandas(cat, "orders")
+    want = int((~c.c_custkey.isin(o.o_custkey)).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_sql_distinct_and_like(cat):
+    got = sql(cat, """
+        select distinct p_mfgr from part where p_name like '%green%'
+        order by p_mfgr
+    """).run()
+    p = tpch.to_pandas(cat, "part")
+    want = np.sort(p[p.p_name.str.contains("green")].p_mfgr.unique())
+    np.testing.assert_array_equal(got["p_mfgr"], want)
+
+
+def test_sql_duplicate_agg_names_and_order(cat):
+    got = sql(cat, """
+        select l_returnflag, sum(l_quantity), sum(l_extendedprice)
+        from lineitem group by l_returnflag
+        order by sum(l_extendedprice) desc
+    """).run()
+    li = tpch.to_pandas(cat, "lineitem")
+    w = (li.groupby("l_returnflag")
+         .agg(q=("l_quantity", "sum"), e=("l_extendedprice", "sum"))
+         .reset_index().sort_values("e", ascending=False))
+    assert "sum" in got and "sum_1" in got  # both aggregates survive
+    np.testing.assert_array_equal(got["l_returnflag"], w.l_returnflag)
+    np.testing.assert_allclose(got["sum"].astype(np.float64), w.q, rtol=1e-9)
+    np.testing.assert_allclose(got["sum_1"].astype(np.float64), w.e, rtol=1e-9)
+
+
+def test_sql_double_negated_in(cat):
+    got = sql(cat, """
+        select count(*) as n from customer
+        where not (c_custkey not in (select o_custkey from orders))
+    """).run()
+    c = tpch.to_pandas(cat, "customer")
+    o = tpch.to_pandas(cat, "orders")
+    want = int(c.c_custkey.isin(o.o_custkey).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_sql_offset_without_limit(cat):
+    got = sql(cat, """
+        select n_nationkey from nation order by n_nationkey offset 5
+    """).run()
+    np.testing.assert_array_equal(got["n_nationkey"], np.arange(5, 25))
+
+
+def test_sql_correlated_nonequality_rejected(cat):
+    from cockroach_tpu.sql import BindError
+
+    with pytest.raises(BindError):
+        sql(cat, """
+            select count(*) from lineitem l1
+            where exists (
+              select * from lineitem l2
+              where l2.l_orderkey = l1.l_orderkey
+                and l2.l_suppkey <> l1.l_suppkey
+            )
+        """)
+
+
+def test_sql_subquery_in_from(cat):
+    got = sql(cat, """
+        select n_name, total from (
+            select n_name, sum(s_acctbal) as total
+            from supplier, nation
+            where s_nationkey = n_nationkey
+            group by n_name
+        ) as t
+        where total > 0
+        order by total desc
+    """).run()
+    s = tpch.to_pandas(cat, "supplier")
+    n = tpch.to_pandas(cat, "nation")
+    j = s.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    w = j.groupby("n_name").s_acctbal.sum().reset_index()
+    w = w[w.s_acctbal > 0].sort_values("s_acctbal", ascending=False)
+    np.testing.assert_array_equal(got["n_name"], w.n_name)
+    np.testing.assert_allclose(
+        got["total"].astype(np.float64), w.s_acctbal, rtol=1e-9
+    )
