@@ -13,8 +13,28 @@ columnar string representation).
 
 from __future__ import annotations
 
+import time
+
+import numpy as np
+
 from ..coldata.batch import Batch, Dictionary
 from ..coldata.types import Schema
+
+
+class ComponentStats:
+    """Per-operator execution stats — the execinfrapb.ComponentStats analog
+    (execinfrapb/component_stats.proto), folded into EXPLAIN ANALYZE by
+    plan/explain.py (the execstats/traceanalyzer.go role)."""
+
+    __slots__ = ("batches", "rows", "time_s")
+
+    def __init__(self):
+        self.batches = 0
+        self.rows = 0
+        self.time_s = 0.0  # inclusive wall time in next_batch (incl. children)
+
+    def exclusive(self, children: list["Operator"]) -> float:
+        return self.time_s - sum(c.stats.time_s for c in children)
 
 
 class Operator:
@@ -27,6 +47,8 @@ class Operator:
     def __init__(self):
         self.dictionaries = {}
         self._initialized = False
+        self.stats = ComponentStats()
+        self._collect = False
 
     def init(self) -> None:
         """Init(ctx) analog — called once before the first next_batch."""
@@ -35,7 +57,27 @@ class Operator:
     def next_batch(self) -> Batch | None:
         if not self._initialized:
             self.init()
-        return self._next()
+        if not self._collect:
+            return self._next()
+        t0 = time.perf_counter()
+        b = self._next()
+        if b is not None:
+            # row counting forces a device sync, so exact per-operator times
+            # and rows are an EXPLAIN ANALYZE-only cost (like the reference's
+            # stats collection wrappers in colflow/stats.go)
+            self.stats.rows += int(np.asarray(b.mask).sum())
+            self.stats.batches += 1
+        self.stats.time_s += time.perf_counter() - t0
+        return b
+
+    def children(self) -> list["Operator"]:
+        return []
+
+    def collect_stats(self, enabled: bool = True) -> None:
+        self._collect = enabled
+        self.stats = ComponentStats()
+        for c in self.children():
+            c.collect_stats(enabled)
 
     def _next(self) -> Batch | None:
         raise NotImplementedError
@@ -57,6 +99,9 @@ class OneInputOperator(Operator):
     def init(self) -> None:
         self.child.init()
         super().init()
+
+    def children(self) -> list[Operator]:
+        return [self.child]
 
     def close(self) -> None:
         self.child.close()

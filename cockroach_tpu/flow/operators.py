@@ -65,7 +65,9 @@ class ScanOp(SourceOperator):
 
     def init(self):
         self._batch = self.table.device_batch(self.output_schema.names)
-        if self.tile is None:
+        if self.tile is None or self._batch.capacity % self.tile != 0:
+            # tiles must divide the padded capacity exactly or the clamped
+            # dynamic_slice at the tail would re-emit rows
             self.tile = self._batch.capacity
         if not hasattr(self, "_slice"):
             tile = self.tile
@@ -598,6 +600,9 @@ class HashJoinOp(OneInputOperator):
                 tuple(tiles), cap=_next_pow2(total)
             )
         self._built = True
+
+    def children(self):
+        return [self.child, self.build]
 
     def _next(self):
         self._ensure_built()

@@ -33,4 +33,13 @@ def run_operator(root) -> dict[str, np.ndarray]:
 
 
 def run_plan(plan: PlanNode, catalog: Catalog) -> dict[str, np.ndarray]:
-    return run_operator(plan_builder.build(plan, catalog))
+    from ..utils import settings, tracing
+
+    root = plan_builder.build(plan, catalog)
+    if settings.get("sql.stats.collect_execution_stats"):
+        root.collect_stats(True)
+        with tracing.span("query") as sp:
+            res = run_operator(root)
+            sp.record(root.stats)
+        return res
+    return run_operator(root)

@@ -8,12 +8,16 @@ from __future__ import annotations
 from ..catalog import Catalog
 from ..flow import operators as ops
 from ..flow.operator import Operator
+from ..utils import settings
 from . import spec as S
 
 
 def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
     if isinstance(plan, S.TableScan):
-        return ops.ScanOp(catalog.get(plan.table), plan.columns)
+        return ops.ScanOp(
+            catalog.get(plan.table), plan.columns,
+            tile=settings.get("sql.distsql.tile_size"),
+        )
     if isinstance(plan, S.Filter):
         return ops.FilterOp(build(plan.input, catalog), plan.predicate)
     if isinstance(plan, S.Project):

@@ -1,0 +1,92 @@
+"""EXPLAIN / EXPLAIN ANALYZE — plan pretty-printing + ComponentStats folding.
+
+Reference: EXPLAIN renders the optimizer plan tree; EXPLAIN ANALYZE runs the
+query with per-processor ComponentStats collection and folds the stats into
+the rendered tree (pkg/sql/execstats/traceanalyzer.go over
+execinfrapb/component_stats.proto). Here the operator tree mirrors the plan
+tree one-to-one, so stats attach directly to plan lines.
+"""
+
+from __future__ import annotations
+
+from . import spec as S
+
+
+def _node_label(n: S.PlanNode) -> str:
+    if isinstance(n, S.TableScan):
+        cols = f" columns={list(n.columns)}" if n.columns else ""
+        return f"scan {n.table}{cols}"
+    if isinstance(n, S.Filter):
+        return f"filter {n.predicate}"
+    if isinstance(n, S.Project):
+        return f"project {list(n.names)}"
+    if isinstance(n, S.Aggregate):
+        aggs = [f"{a.func}({a.col if a.col is not None else '*'})"
+                for a in n.aggs]
+        mode = f" mode={n.mode}" if n.mode != "complete" else ""
+        dense = " dense" if n.key_sizes else ""
+        return f"group-by keys={list(n.group_cols)} aggs={aggs}{mode}{dense}"
+    if isinstance(n, S.ScalarAggregate):
+        aggs = [f"{a.func}({a.col if a.col is not None else '*'})"
+                for a in n.aggs]
+        return f"scalar-group-by aggs={aggs}"
+    if isinstance(n, S.HashJoin):
+        u = " (unique build)" if n.spec.build_unique else ""
+        return (f"hash-join ({n.spec.join_type}) "
+                f"probe={list(n.probe_keys)} build={list(n.build_keys)}{u}")
+    if isinstance(n, S.Sort):
+        keys = [f"{k.col}{' desc' if k.desc else ''}" for k in n.keys]
+        return f"sort keys={keys}"
+    if isinstance(n, S.Limit):
+        off = f" offset={n.offset}" if n.offset else ""
+        return f"limit {n.limit}{off}"
+    if isinstance(n, S.Distinct):
+        return f"distinct on={list(n.cols) if n.cols else 'all'}"
+    if isinstance(n, S.Exchange):
+        return f"exchange (all-to-all) keys={list(n.keys)}"
+    return type(n).__name__
+
+
+def _children(n: S.PlanNode) -> list[S.PlanNode]:
+    if isinstance(n, S.HashJoin):
+        return [n.probe, n.build]
+    if hasattr(n, "input"):
+        return [n.input]
+    return []
+
+
+def explain_plan(plan: S.PlanNode) -> str:
+    """Render the plan tree (EXPLAIN)."""
+    lines: list[str] = []
+
+    def walk(n: S.PlanNode, depth: int):
+        lines.append("  " * depth + "-> " + _node_label(n))
+        for c in _children(n):
+            walk(c, depth + 1)
+
+    walk(plan, 0)
+    return "\n".join(lines)
+
+
+def explain_analyze(plan: S.PlanNode, root_op) -> str:
+    """Render the plan tree with executed ComponentStats (EXPLAIN ANALYZE).
+    `root_op` must have been run with collect_stats(True)."""
+    lines: list[str] = []
+
+    def walk(n: S.PlanNode, op, depth: int):
+        if isinstance(n, S.Exchange):
+            # single-device builds elide the exchange operator
+            walk(n.input, op, depth)
+            return
+        st = op.stats
+        excl = st.exclusive(op.children())
+        lines.append(
+            "  " * depth + "-> " + _node_label(n)
+            + f"  [rows={st.rows} batches={st.batches} "
+            f"time={st.time_s*1e3:.1f}ms self={excl*1e3:.1f}ms]"
+        )
+        for c, co in zip(_children(n), op.children()):
+            walk(c, co, depth + 1)
+
+    walk(plan, root_op, 0)
+    return "\n".join(lines)

@@ -5,4 +5,23 @@ SELECT into an executable Rel."""
 from .binder import BindError, sql
 from .rel import Rel
 
-__all__ = ["BindError", "Rel", "sql"]
+
+def explain(catalog, text: str) -> str:
+    """EXPLAIN / EXPLAIN ANALYZE over SQL text. Accepts the statement with or
+    without the leading EXPLAIN keywords."""
+    t = text.strip()
+    low = t.lower()
+    analyze = False
+    if low.startswith("explain"):
+        t = t[len("explain"):].lstrip()
+        if t.lower().startswith("analyze"):
+            analyze = True
+            t = t[len("analyze"):].lstrip()
+    rel = sql(catalog, t)
+    if analyze:
+        rendered, _ = rel.explain_analyze()
+        return rendered
+    return rel.explain()
+
+
+__all__ = ["BindError", "Rel", "explain", "sql"]

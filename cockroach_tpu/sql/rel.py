@@ -125,8 +125,11 @@ class Rel:
             for name, f, cn in aggs
         )
         # dense-state path: all keys dictionary-coded with small product
+        from ..utils import settings as _settings
+
         key_sizes = None
-        if gcols and all(i in self.dicts for i in gcols):
+        if (gcols and all(i in self.dicts for i in gcols)
+                and _settings.get("sql.distsql.dense_agg.enabled")):
             sizes = tuple(len(self.dicts[i]) for i in gcols)
             prod = 1
             for s in sizes:
@@ -216,3 +219,23 @@ class Rel:
 
     def run(self) -> dict[str, np.ndarray]:
         return run_plan(self.plan, self.catalog)
+
+    def explain(self) -> str:
+        from ..plan.explain import explain_plan
+
+        return explain_plan(self.plan)
+
+    def explain_analyze(self) -> tuple[str, dict[str, np.ndarray]]:
+        """Run with ComponentStats collection; returns (rendered tree,
+        results) — the EXPLAIN ANALYZE surface."""
+        from ..plan import builder as plan_builder
+        from ..plan.explain import explain_analyze
+        from ..flow.runtime import run_operator
+        from ..utils import tracing
+
+        root = plan_builder.build(self.plan, self.catalog)
+        root.collect_stats(True)
+        with tracing.span("explain-analyze") as sp:
+            res = run_operator(root)
+            sp.record(root.stats)
+        return explain_analyze(self.plan, root), res

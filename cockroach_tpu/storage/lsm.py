@@ -92,14 +92,20 @@ class Engine:
         self,
         key_width: int = K.DEFAULT_KEY_WIDTH,
         val_width: int = 16,
-        l0_trigger: int = 4,
+        l0_trigger: int | None = None,
         memtable_size: int = 4096,
         gc_ts: int = 0,
     ):
         assert key_width % 8 == 0
+        from ..utils import settings
+
         self.key_width = key_width
         self.val_width = val_width
-        self.l0_trigger = l0_trigger  # DefaultPebbleOptions L0CompactionThreshold
+        # DefaultPebbleOptions L0CompactionThreshold (pebble.go:363)
+        self.l0_trigger = (
+            l0_trigger if l0_trigger is not None
+            else settings.get("storage.l0_compaction_threshold")
+        )
         self.memtable_size = memtable_size
         self.gc_ts = gc_ts
         self.mem = _Memtable()

@@ -1,0 +1,161 @@
+"""Cluster settings registry — the pkg/settings analog.
+
+Reference: pkg/settings/registry.go holds typed, documented, SQL-updatable
+settings (RegisterBoolSetting bool.go:138 etc.); test builds randomize
+"metamorphic constants" (pkg/util/metamorphic/constants.go:82) such as
+coldata-batch-size so unit tests sweep the tuning space. Here settings are
+process-local (single-process framework; gossip distribution is the control
+plane's job when multi-host arrives), typed, validated, resettable, and
+metamorphically randomizable for tests.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass
+class Setting:
+    name: str
+    default: Any
+    kind: str  # bool | int | float | string | enum
+    desc: str
+    choices: tuple | None = None
+    lo: float | None = None
+    hi: float | None = None
+    # metamorphic: (lo, hi) or choices to randomize within for test builds
+    metamorphic: bool = False
+    value: Any = None
+
+    def get(self):
+        return self.default if self.value is None else self.value
+
+
+_REGISTRY: dict[str, Setting] = {}
+
+
+def _register(s: Setting) -> Setting:
+    if s.name in _REGISTRY:
+        raise ValueError(f"duplicate setting {s.name}")
+    _REGISTRY[s.name] = s
+    return s
+
+
+def register_bool(name: str, default: bool, desc: str,
+                  metamorphic: bool = False) -> Setting:
+    return _register(Setting(name, default, "bool", desc,
+                             metamorphic=metamorphic))
+
+
+def register_int(name: str, default: int, desc: str, lo: int | None = None,
+                 hi: int | None = None, metamorphic: bool = False) -> Setting:
+    return _register(Setting(name, default, "int", desc, lo=lo, hi=hi,
+                             metamorphic=metamorphic))
+
+
+def register_float(name: str, default: float, desc: str,
+                   lo: float | None = None, hi: float | None = None) -> Setting:
+    return _register(Setting(name, default, "float", desc, lo=lo, hi=hi))
+
+
+def register_enum(name: str, default: str, desc: str,
+                  choices: tuple[str, ...],
+                  metamorphic: bool = False) -> Setting:
+    return _register(Setting(name, default, "enum", desc, choices=choices,
+                             metamorphic=metamorphic))
+
+
+def register_string(name: str, default: str, desc: str) -> Setting:
+    return _register(Setting(name, default, "string", desc))
+
+
+def get(name: str):
+    return _REGISTRY[name].get()
+
+
+def set(name: str, value) -> None:  # noqa: A001 - SQL SET semantics
+    s = _REGISTRY[name]
+    if s.kind == "bool":
+        if not isinstance(value, bool):
+            raise TypeError(f"{name} wants bool, got {value!r}")
+    elif s.kind == "int":
+        value = int(value)
+        if s.lo is not None and value < s.lo:
+            raise ValueError(f"{name}: {value} < min {s.lo}")
+        if s.hi is not None and value > s.hi:
+            raise ValueError(f"{name}: {value} > max {s.hi}")
+    elif s.kind == "float":
+        value = float(value)
+        if s.lo is not None and value < s.lo:
+            raise ValueError(f"{name}: {value} < min {s.lo}")
+        if s.hi is not None and value > s.hi:
+            raise ValueError(f"{name}: {value} > max {s.hi}")
+    elif s.kind == "enum":
+        if value not in s.choices:
+            raise ValueError(f"{name}: {value!r} not in {s.choices}")
+    s.value = value
+
+
+def reset(name: str | None = None) -> None:
+    if name is None:
+        for s in _REGISTRY.values():
+            s.value = None
+    else:
+        _REGISTRY[name].value = None
+
+
+def all_settings() -> dict[str, Setting]:
+    return dict(_REGISTRY)
+
+
+def randomize_metamorphic(rng) -> dict[str, Any]:
+    """Randomize metamorphic settings (test builds only) — the
+    metamorphic-constants analog. Returns what was chosen."""
+    chosen = {}
+    for s in _REGISTRY.values():
+        if not s.metamorphic:
+            continue
+        if s.kind == "int":
+            lo = int(s.lo if s.lo is not None else 1)
+            hi = int(s.hi if s.hi is not None else 4096)
+            # bias to powers of two (tile sizes)
+            pows = [p for p in (256, 512, 1024, 2048, 4096) if lo <= p <= hi]
+            v = int(rng.choice(pows)) if pows else int(rng.integers(lo, hi + 1))
+        elif s.kind == "bool":
+            v = bool(rng.integers(0, 2))
+        elif s.kind == "enum":
+            v = s.choices[int(rng.integers(len(s.choices)))]
+        else:
+            continue
+        set(s.name, v)
+        chosen[s.name] = v
+    return chosen
+
+
+# ---------------------------------------------------------------------------
+# The framework's own settings (the ~700-setting registry's seed)
+
+TILE_SIZE = register_int(
+    "sql.distsql.tile_size", 4096,
+    "static tile capacity for scan batches (coldata batch size analog)",
+    lo=128, hi=65536, metamorphic=True,
+)
+L0_COMPACTION = register_int(
+    "storage.l0_compaction_threshold", 4,
+    "number of L0 runs that triggers a compaction "
+    "(DefaultPebbleOptions L0CompactionThreshold analog)",
+    lo=1, hi=64,
+)
+DENSE_AGG = register_bool(
+    "sql.distsql.dense_agg.enabled", True,
+    "allow the dense-code small-group aggregation specialization "
+    "(falls back to the general sort-groupby path when off)",
+    metamorphic=True,
+)
+COLLECT_STATS = register_bool(
+    "sql.stats.collect_execution_stats", False,
+    "collect per-operator ComponentStats on every query; stats are recorded "
+    "on the active tracing span (EXPLAIN ANALYZE always collects)",
+)

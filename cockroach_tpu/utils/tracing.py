@@ -1,0 +1,79 @@
+"""Tracing — the pkg/util/tracing analog (Tracer tracer.go:289, Span
+span.go:46): always-cheap structured spans forming a tree per operation,
+with structured payloads. DistSQL propagates spans through flows and folds
+per-processor ComponentStats into EXPLAIN ANALYZE via
+execstats/traceanalyzer.go; here the flow runtime opens a span per query and
+operators attach their stats to it.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class Span:
+    name: str
+    start: float = 0.0
+    duration: float = 0.0
+    tags: dict[str, Any] = field(default_factory=dict)
+    records: list[Any] = field(default_factory=list)
+    children: list["Span"] = field(default_factory=list)
+
+    def record(self, payload: Any) -> None:
+        """Attach a structured payload (ComponentStats etc.)."""
+        self.records.append(payload)
+
+    def tree(self, indent: int = 0) -> str:
+        out = [f"{'  ' * indent}{self.name}: {self.duration*1e3:.2f}ms"
+               + (f" {self.tags}" if self.tags else "")]
+        for c in self.children:
+            out.append(c.tree(indent + 1))
+        return "\n".join(out)
+
+
+MAX_FINISHED = 64  # ring of recent root spans (the span registry's cap)
+
+
+class Tracer:
+    """Per-process tracer; spans nest via a stack (single-threaded flows;
+    the pull loop is sequential by design). Finished root spans are kept in
+    a bounded ring so a long-lived process doesn't accumulate them."""
+
+    def __init__(self):
+        self._stack: list[Span] = []
+        self.finished: list[Span] = []
+
+    @contextmanager
+    def span(self, name: str, **tags):
+        s = Span(name=name, start=time.perf_counter(), tags=dict(tags))
+        if self._stack:
+            self._stack[-1].children.append(s)
+        self._stack.append(s)
+        try:
+            yield s
+        finally:
+            s.duration = time.perf_counter() - s.start
+            self._stack.pop()
+            if not self._stack:
+                self.finished.append(s)
+                if len(self.finished) > MAX_FINISHED:
+                    del self.finished[: -MAX_FINISHED]
+
+    def current(self) -> Span | None:
+        return self._stack[-1] if self._stack else None
+
+
+# process-global default tracer (the reference hangs one off every Server)
+DEFAULT = Tracer()
+
+
+def span(name: str, **tags):
+    return DEFAULT.span(name, **tags)
+
+
+def current() -> Span | None:
+    return DEFAULT.current()

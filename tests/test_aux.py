@@ -1,0 +1,93 @@
+"""Aux subsystem tests: settings registry, tracing, EXPLAIN (ANALYZE),
+metamorphic tile-size randomization (SURVEY.md §5 parity: pkg/settings,
+pkg/util/tracing, execstats, pkg/util/metamorphic)."""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.bench import queries as Q
+from cockroach_tpu.bench import tpch
+from cockroach_tpu.sql import explain, sql
+from cockroach_tpu.utils import settings, tracing
+
+
+@pytest.fixture(scope="module")
+def cat():
+    return tpch.gen_tpch(sf=0.002, seed=11)
+
+
+@pytest.fixture(autouse=True)
+def _reset_settings():
+    yield
+    settings.reset()
+
+
+def test_settings_registry():
+    assert settings.get("sql.distsql.tile_size") == 4096
+    settings.set("sql.distsql.tile_size", 1024)
+    assert settings.get("sql.distsql.tile_size") == 1024
+    with pytest.raises(ValueError):
+        settings.set("sql.distsql.tile_size", 1)  # below min
+    with pytest.raises(TypeError):
+        settings.set("sql.distsql.dense_agg.enabled", "sideways")
+    settings.reset("sql.distsql.tile_size")
+    assert settings.get("sql.distsql.tile_size") == 4096
+    assert "storage.l0_compaction_threshold" in settings.all_settings()
+
+
+def test_tracing_spans():
+    tr = tracing.Tracer()
+    with tr.span("root", query="q1") as root:
+        with tr.span("child"):
+            pass
+        with tr.span("child2") as c2:
+            c2.record({"rows": 5})
+    assert len(tr.finished) == 1
+    s = tr.finished[0]
+    assert s.name == "root" and len(s.children) == 2
+    assert s.children[1].records == [{"rows": 5}]
+    assert "root" in s.tree()
+
+
+def test_explain_plan(cat):
+    txt = Q.q3(cat).explain()
+    assert "hash-join" in txt and "scan lineitem" in txt
+    assert "limit 10" in txt and "group-by" in txt
+
+
+def test_explain_analyze(cat):
+    txt, res = Q.q1(cat).explain_analyze()
+    assert "rows=" in txt and "self=" in txt
+    # the scan line reports at least as many rows as the final output
+    assert len(res["l_returnflag"]) > 0
+    first = txt.splitlines()[0]
+    assert "sort" in first
+
+
+def test_explain_sql(cat):
+    txt = explain(cat, "explain select count(*) as n from lineitem")
+    assert "scalar-group-by" in txt and "scan lineitem" in txt
+    txt = explain(
+        cat, "explain analyze select count(*) as n from lineitem"
+    )
+    assert "rows=1" in txt
+
+
+def test_metamorphic_tile_size(cat, rng):
+    """q1 result must be invariant under randomized scan tile size — the
+    coldata-batch-size metamorphic constant (coldata/batch.go:86)."""
+    base = Q.q1(cat).run()
+    chosen = settings.randomize_metamorphic(rng)
+    assert "sql.distsql.tile_size" in chosen
+    got = Q.q1(cat).run()
+    for k in base:
+        np.testing.assert_array_equal(np.asarray(base[k]), np.asarray(got[k]),
+                                      err_msg=f"{k} under {chosen}")
+
+
+def test_engine_uses_l0_setting():
+    from cockroach_tpu.storage import Engine
+
+    settings.set("storage.l0_compaction_threshold", 2)
+    eng = Engine(val_width=8, memtable_size=2)
+    assert eng.l0_trigger == 2
