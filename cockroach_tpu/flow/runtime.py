@@ -32,14 +32,23 @@ def run_operator(root) -> dict[str, np.ndarray]:
     }
 
 
-def run_plan(plan: PlanNode, catalog: Catalog) -> dict[str, np.ndarray]:
-    from ..utils import settings, tracing
+def run_plan_with_stats(plan: PlanNode, catalog: Catalog):
+    """Run with ComponentStats collection; returns (results, root operator).
+    The stats land on the active tracing span."""
+    from ..utils import tracing
 
     root = plan_builder.build(plan, catalog)
+    root.collect_stats(True)
+    with tracing.span("query") as sp:
+        res = run_operator(root)
+        sp.record(root.stats)
+    return res, root
+
+
+def run_plan(plan: PlanNode, catalog: Catalog) -> dict[str, np.ndarray]:
+    from ..utils import settings
+
     if settings.get("sql.stats.collect_execution_stats"):
-        root.collect_stats(True)
-        with tracing.span("query") as sp:
-            res = run_operator(root)
-            sp.record(root.stats)
+        res, _ = run_plan_with_stats(plan, catalog)
         return res
-    return run_operator(root)
+    return run_operator(plan_builder.build(plan, catalog))

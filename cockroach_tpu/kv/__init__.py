@@ -1,0 +1,10 @@
+"""KV layer — transactional key-value API over the MVCC LSM engine
+(pkg/kv analog: kv.DB, kv.Txn, retries, intents, refresh validation)."""
+
+from .hlc import Clock, ManualClock
+from .txn import DB, TransactionAbortedError, TransactionRetryError, Txn
+
+__all__ = [
+    "Clock", "ManualClock", "DB", "Txn",
+    "TransactionAbortedError", "TransactionRetryError",
+]

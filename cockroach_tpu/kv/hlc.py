@@ -1,0 +1,57 @@
+"""Hybrid logical clock — the pkg/util/hlc analog.
+
+Reference: hlc.Clock issues timestamps (walltime, logical) that are totally
+ordered, monotone per node, and close to wall time; readings advance on
+message receipt (clock.Update). Here the pair packs into one int64
+(wall micros << 20 | logical), matching the storage layer's single-int64
+version timestamps.
+"""
+
+from __future__ import annotations
+
+import time
+
+LOGICAL_BITS = 20
+LOGICAL_MASK = (1 << LOGICAL_BITS) - 1
+
+
+def pack(wall_us: int, logical: int) -> int:
+    return (wall_us << LOGICAL_BITS) | logical
+
+
+def unpack(ts: int) -> tuple[int, int]:
+    return ts >> LOGICAL_BITS, ts & LOGICAL_MASK
+
+
+class Clock:
+    """Monotone hybrid clock. now() never returns the same or a smaller
+    timestamp twice; update(ts) ratchets past a remote observation."""
+
+    def __init__(self, wall_us=None):
+        self._wall_us = wall_us or (lambda: int(time.time() * 1e6))
+        self._last = 0
+
+    def now(self) -> int:
+        wall = self._wall_us()
+        ts = pack(wall, 0)
+        if ts <= self._last:
+            ts = self._last + 1
+        self._last = ts
+        return ts
+
+    def update(self, observed: int) -> int:
+        """Advance past an observed remote timestamp (clock.Update)."""
+        if observed > self._last:
+            self._last = observed
+        return self.now()
+
+
+class ManualClock(Clock):
+    """Deterministic clock for tests (the reference's timeutil manual time)."""
+
+    def __init__(self, start_us: int = 1):
+        super().__init__(wall_us=lambda: self._manual)
+        self._manual = start_us
+
+    def advance(self, us: int = 1) -> None:
+        self._manual += us

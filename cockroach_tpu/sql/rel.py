@@ -228,14 +228,8 @@ class Rel:
     def explain_analyze(self) -> tuple[str, dict[str, np.ndarray]]:
         """Run with ComponentStats collection; returns (rendered tree,
         results) — the EXPLAIN ANALYZE surface."""
-        from ..plan import builder as plan_builder
+        from ..flow.runtime import run_plan_with_stats
         from ..plan.explain import explain_analyze
-        from ..flow.runtime import run_operator
-        from ..utils import tracing
 
-        root = plan_builder.build(self.plan, self.catalog)
-        root.collect_stats(True)
-        with tracing.span("explain-analyze") as sp:
-            res = run_operator(root)
-            sp.record(root.stats)
+        res, root = run_plan_with_stats(self.plan, self.catalog)
         return explain_analyze(self.plan, root), res

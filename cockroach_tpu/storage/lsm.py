@@ -77,6 +77,7 @@ class MVCCStats:
 class _Memtable:
     keys: list[bytes] = field(default_factory=list)
     ts: list[int] = field(default_factory=list)
+    seq: list[int] = field(default_factory=list)
     txn: list[int] = field(default_factory=list)
     tomb: list[bool] = field(default_factory=list)
     value: list[bytes] = field(default_factory=list)
@@ -111,6 +112,8 @@ class Engine:
         self.mem = _Memtable()
         self.runs: list[mvcc.KVBlock] = []  # sorted device runs, newest first
         self.stats = MVCCStats()
+        self._seq = 0  # global write sequence: same-(key, ts) writes resolve
+        # newest-sequence-wins (intent rewrites within a txn, TxnSeq analog)
 
     # -- writes -------------------------------------------------------------
 
@@ -127,8 +130,10 @@ class Engine:
             raise ValueError(f"key too long ({len(b)} > {self.key_width})")
         if len(v) > self.val_width:
             raise ValueError(f"value too long ({len(v)} > {self.val_width})")
+        self._seq += 1
         self.mem.keys.append(b)
         self.mem.ts.append(int(ts))
+        self.mem.seq.append(self._seq)
         self.mem.txn.append(int(txn))
         self.mem.tomb.append(bool(tomb))
         self.mem.value.append(v)
@@ -155,6 +160,7 @@ class Engine:
             vals,
             vlen,
             cap=_pad(n),
+            seq=np.asarray(self.mem.seq),
         )
 
     def flush(self):
@@ -178,8 +184,9 @@ class Engine:
         merged = mvcc.merge_blocks(tuple(self.runs), cap=_pad(total))
         keep = mvcc.mvcc_gc_filter(merged, jnp.int64(self.gc_ts), bottom)
         merged = mvcc.KVBlock(
-            key=merged.key, ts=merged.ts, txn=merged.txn, tomb=merged.tomb,
-            value=merged.value, vlen=merged.vlen, mask=merged.mask & keep,
+            key=merged.key, ts=merged.ts, seq=merged.seq, txn=merged.txn,
+            tomb=merged.tomb, value=merged.value, vlen=merged.vlen,
+            mask=merged.mask & keep,
         )
         self.runs = [_shrink(mvcc.sort_block(merged))]
         self.stats.compactions += 1
@@ -281,6 +288,67 @@ class Engine:
             for r in self.runs
         ]
 
+    def has_committed_writes_in(
+        self, start: bytes | None, end: bytes | None, ts_lo: int, ts_hi: int,
+        point: bool = False,
+    ) -> bool:
+        """Any committed version in (ts_lo, ts_hi] within [start, end)?
+        The read-refresh check (kvcoord txn_interceptor_span_refresher
+        semantics: a txn's reads stay valid iff nothing committed under its
+        read spans between read_ts and commit_ts). ``point=True`` checks
+        exactly the key `start` (successor end bound, like get)."""
+        view = self._merged_view()
+        if view is None:
+            return False
+        words = K.key_words(view.key)
+        sw = K.encode_bound(start, self.key_width)
+        ew = K.bound_next(sw) if point else K.encode_bound(end, self.key_width)
+        in_range = view.mask & K.words_in_range(
+            words,
+            None if sw is None else jnp.asarray(sw),
+            None if ew is None else jnp.asarray(ew),
+        )
+        hit = (
+            in_range & (view.txn == 0)
+            & (view.ts > ts_lo) & (view.ts <= ts_hi)
+        )
+        return bool(np.asarray(jnp.any(hit)))
+
+    def other_intent(self, key: bytes, txn: int) -> int | None:
+        """Txn id of another transaction's intent on `key`, if any —
+        the lock-table point lookup the write path does before laying an
+        intent (concurrency_manager.SequenceReq's lock check)."""
+        view = self._merged_view()
+        if view is None:
+            return None
+        sw = K.encode_bound(key, self.key_width)
+        ew = K.bound_next(sw)
+        words = K.key_words(view.key)
+        hit = (
+            view.mask
+            & K.words_in_range(words, jnp.asarray(sw), jnp.asarray(ew))
+            & (view.txn != 0) & (view.txn != txn)
+        )
+        idx = np.nonzero(np.asarray(hit))[0]
+        return int(np.asarray(view.txn)[idx[0]]) if len(idx) else None
+
+    def newest_committed_ts(self, key: bytes) -> int:
+        """Timestamp of the newest committed version of `key` (0 if none) —
+        powers the WriteTooOld check."""
+        view = self._merged_view()
+        if view is None:
+            return 0
+        sw = K.encode_bound(key, self.key_width)
+        ew = K.bound_next(sw)
+        words = K.key_words(view.key)
+        hit = (
+            view.mask
+            & K.words_in_range(words, jnp.asarray(sw), jnp.asarray(ew))
+            & (view.txn == 0)
+        )
+        ts = jnp.where(hit, view.ts, 0)
+        return int(np.asarray(jnp.max(ts)))
+
     def intent_keys(self, txn: int) -> list[bytes]:
         view = self._merged_view()
         if view is None:
@@ -315,6 +383,7 @@ class Engine:
             np.savez(
                 os.path.join(path, f"run{i:04d}.npz"),
                 key=np.asarray(r.key), ts=np.asarray(r.ts),
+                seq=np.asarray(r.seq),
                 txn=np.asarray(r.txn), tomb=np.asarray(r.tomb),
                 value=np.asarray(r.value), vlen=np.asarray(r.vlen),
                 mask=np.asarray(r.mask),
@@ -332,10 +401,17 @@ class Engine:
             eng.runs.append(
                 mvcc.KVBlock(
                     key=jnp.asarray(z["key"]), ts=jnp.asarray(z["ts"]),
+                    seq=jnp.asarray(z["seq"]),
                     txn=jnp.asarray(z["txn"]), tomb=jnp.asarray(z["tomb"]),
                     value=jnp.asarray(z["value"]), vlen=jnp.asarray(z["vlen"]),
                     mask=jnp.asarray(z["mask"]),
                 )
             )
         eng.stats.runs = len(eng.runs)
+        # restore the write-sequence high-water mark so post-restore writes
+        # keep winning same-(key, ts) tie-breaks over persisted rows
+        for r in eng.runs:
+            m = np.asarray(r.mask)
+            if m.any():
+                eng._seq = max(eng._seq, int(np.asarray(r.seq)[m].max()))
         return eng

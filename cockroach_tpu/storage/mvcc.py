@@ -41,6 +41,9 @@ class KVBlock:
 
     key   : [cap, KW] uint8 zero-padded key bytes
     ts    : [cap] int64 version timestamp (HLC collapsed to one int64)
+    seq   : [cap] int64 write sequence; breaks ties among same-(key, ts)
+            writes, newest-sequence-wins (the reference's intent sequence
+            numbers, enginepb.TxnSeq)
     txn   : [cap] int64 intent owner txn id; 0 = committed
     tomb  : [cap] bool deletion tombstone
     value : [cap, VW] uint8 fixed-width value payload
@@ -50,6 +53,7 @@ class KVBlock:
 
     key: jax.Array
     ts: jax.Array
+    seq: jax.Array
     txn: jax.Array
     tomb: jax.Array
     value: jax.Array
@@ -65,6 +69,7 @@ def empty_block(cap: int, key_width: int, val_width: int) -> KVBlock:
     return KVBlock(
         key=jnp.zeros((cap, key_width), jnp.uint8),
         ts=jnp.zeros((cap,), jnp.int64),
+        seq=jnp.zeros((cap,), jnp.int64),
         txn=jnp.zeros((cap,), jnp.int64),
         tomb=jnp.zeros((cap,), jnp.bool_),
         value=jnp.zeros((cap, val_width), jnp.uint8),
@@ -81,13 +86,17 @@ def block_from_host(
     value: np.ndarray,
     vlen: np.ndarray,
     cap: int | None = None,
+    seq: np.ndarray | None = None,
 ) -> KVBlock:
     n = len(ts)
     cap = cap or max(1, n)
+    if seq is None:
+        seq = np.zeros(n, dtype=np.int64)
     b = empty_block(cap, keys.shape[1], value.shape[1])
     return KVBlock(
         key=b.key.at[:n].set(jnp.asarray(keys)),
         ts=b.ts.at[:n].set(jnp.asarray(ts, dtype=jnp.int64)),
+        seq=b.seq.at[:n].set(jnp.asarray(seq, dtype=jnp.int64)),
         txn=b.txn.at[:n].set(jnp.asarray(txn, dtype=jnp.int64)),
         tomb=b.tomb.at[:n].set(jnp.asarray(tomb, dtype=jnp.bool_)),
         value=b.value.at[:n].set(jnp.asarray(value)),
@@ -108,8 +117,9 @@ def sort_block(block: KVBlock) -> KVBlock:
     cap = block.capacity
     operands = [~block.mask]
     operands += [words[:, i] for i in range(words.shape[1])]
-    # ts desc: flip sign bit of the int64 bit pattern, then invert
+    # ts desc, then seq desc: flip sign bit of the int64 pattern, invert
     operands.append(~(block.ts.astype(jnp.uint64) ^ np.uint64(1 << 63)))
+    operands.append(~(block.seq.astype(jnp.uint64) ^ np.uint64(1 << 63)))
     perm = jnp.arange(cap, dtype=jnp.int32)
     res = jax.lax.sort(operands + [perm], num_keys=len(operands), is_stable=True)
     p = res[-1]
@@ -250,6 +260,7 @@ def resolve_intents(
         return KVBlock(
             key=block.key,
             ts=jnp.where(is_intent, commit_ts, block.ts),
+            seq=block.seq,
             txn=jnp.where(is_intent, 0, block.txn),
             tomb=block.tomb,
             value=block.value,
@@ -259,6 +270,7 @@ def resolve_intents(
     return KVBlock(
         key=block.key,
         ts=block.ts,
+        seq=block.seq,
         txn=block.txn,
         tomb=block.tomb,
         value=block.value,

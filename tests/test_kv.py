@@ -1,0 +1,222 @@
+"""KV txn layer tests — isolation, conflicts, retries, and a kvnemesis-style
+randomized serializability check (reference: pkg/kv tests + kvnemesis)."""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.kv import (
+    DB, ManualClock, TransactionAbortedError, TransactionRetryError,
+)
+from cockroach_tpu.storage import Engine
+
+
+def mkdb():
+    return DB(Engine(val_width=16), ManualClock())
+
+
+def test_hlc_monotone():
+    from cockroach_tpu.kv import hlc
+
+    c = ManualClock()
+    a, b = c.now(), c.now()
+    assert b > a  # same wall time -> logical bump
+    c.advance(10)
+    d = c.now()
+    assert d > b
+    wall, logical = hlc.unpack(d)
+    assert wall == 11 and logical == 0
+    e = c.update(hlc.pack(99, 5))
+    assert e > hlc.pack(99, 5)
+
+
+def test_db_basic():
+    db = mkdb()
+    ts1 = db.put(b"a", b"1")
+    db.put(b"a", b"2")
+    assert db.get(b"a") == b"2"
+    assert db.get(b"a", ts=ts1) == b"1"
+    db.delete(b"a")
+    assert db.get(b"a") is None
+    db.put(b"b", b"x")
+    db.put(b"c", b"y")
+    assert db.scan(b"a", b"z") == [(b"b", b"x"), (b"c", b"y")]
+
+
+def test_txn_commit_visibility():
+    db = mkdb()
+    db.put(b"k", b"base")
+    t = db.new_txn()
+    t.put(b"k", b"txn")
+    # uncommitted write invisible to non-transactional reads below intent ts,
+    # and a conflict at-or-above it
+    assert db.get(b"k", ts=t.read_ts - 1) == b"base"
+    assert t.get(b"k") == b"txn"  # own write visible
+    t.commit()
+    assert db.get(b"k") == b"txn"
+
+
+def test_txn_rollback():
+    db = mkdb()
+    db.put(b"k", b"base")
+    t = db.new_txn()
+    t.put(b"k", b"gone")
+    t.rollback()
+    assert db.get(b"k") == b"base"
+    with pytest.raises(TransactionAbortedError):
+        t.put(b"k", b"zombie")
+
+
+def test_txn_write_write_conflict():
+    db = mkdb()
+    t1 = db.new_txn()
+    t2 = db.new_txn()
+    t1.put(b"k", b"one")
+    with pytest.raises(TransactionRetryError):
+        t2.put(b"k", b"two")
+    t1.commit()
+
+
+def test_txn_write_too_old():
+    db = mkdb()
+    t1 = db.new_txn()
+    db.put(b"k", b"newer")  # commits above t1.read_ts
+    with pytest.raises(TransactionRetryError):
+        t1.put(b"k", b"stale")
+
+
+def test_txn_read_refresh_invalidation():
+    db = mkdb()
+    db.put(b"k", b"v0")
+    t = db.new_txn()
+    assert t.get(b"k") == b"v0"
+    db.put(b"k", b"v1")  # invalidates t's read before commit
+    t.put(b"other", b"x")
+    with pytest.raises(TransactionRetryError):
+        t.commit()
+    assert db.get(b"other") is None  # rolled back
+
+
+def test_txn_closure_retries():
+    db = mkdb()
+    db.put(b"counter", b"0")
+    calls = {"n": 0}
+
+    def incr(t):
+        calls["n"] += 1
+        v = int(t.get(b"counter") or b"0")
+        if calls["n"] == 1:
+            # sneak in a conflicting commit mid-txn on first attempt
+            db.put(b"counter", str(v + 10).encode())
+        t.put(b"counter", str(v + 1).encode())
+
+    db.txn(incr)
+    # first attempt fails refresh (or write-too-old) and retries cleanly
+    assert calls["n"] >= 2
+    assert db.get(b"counter") == b"11"
+
+
+def test_txn_rewrite_last_write_wins():
+    """A txn rewriting its own key sees and commits the latest write —
+    intent sequence numbers (enginepb.TxnSeq analog)."""
+    db = mkdb()
+
+    def rw(t):
+        t.put(b"rw", b"first")
+        assert t.get(b"rw") == b"first"
+        t.put(b"rw", b"second")
+        assert t.get(b"rw") == b"second"
+        t.delete(b"rw")
+        assert t.get(b"rw") is None
+        t.put(b"rw", b"final")
+
+    db.txn(rw)
+    assert db.get(b"rw") == b"final"
+
+
+def test_bank_transfer_invariant():
+    """Total balance is conserved across random transfer txns."""
+    db = mkdb()
+    rng = np.random.default_rng(3)
+    n = 10
+    for i in range(n):
+        db.put(f"acct{i}".encode(), b"100")
+    for _ in range(60):
+        a, b = rng.integers(0, n, 2)
+        if a == b:
+            continue
+        amt = int(rng.integers(1, 20))
+
+        def xfer(t, a=a, b=b, amt=amt):
+            va = int(t.get(f"acct{a}".encode()))
+            vb = int(t.get(f"acct{b}".encode()))
+            t.put(f"acct{a}".encode(), str(va - amt).encode())
+            t.put(f"acct{b}".encode(), str(vb + amt).encode())
+
+        db.txn(xfer)
+    total = sum(int(v) for _, v in db.scan(None, None))
+    assert total == n * 100
+
+
+def test_kvnemesis_lite():
+    """Randomized serial-equivalence: run sequential txns doing random
+    read-modify-writes over a small keyspace against a python dict model."""
+    db = mkdb()
+    rng = np.random.default_rng(5)
+    model: dict[bytes, bytes] = {}
+    ctr_keys = [f"c{i}".encode() for i in range(6)]  # int-valued RMW keys
+    str_keys = [f"k{i}".encode() for i in range(6)]  # blind put/del keys
+    for step in range(120):
+        kind = rng.random()
+        if kind < 0.5:
+            k1 = ctr_keys[rng.integers(len(ctr_keys))]
+            k2 = ctr_keys[rng.integers(len(ctr_keys))]
+        else:
+            k1 = str_keys[rng.integers(len(str_keys))]
+            k2 = k1
+
+        def op(t, k1=k1, k2=k2, kind=kind, step=step):
+            if kind < 0.5:  # transfer-style RMW over two keys
+                a = int(t.get(k1) or b"0")
+                b = int(t.get(k2) or b"0")
+                t.put(k1, str(a + 1).encode())
+                t.put(k2, str(b + 2).encode())
+                return ("rmw",)
+            if kind < 0.75:
+                t.put(k1, f"s{step}".encode())
+                return ("put",)
+            t.delete(k1)
+            return ("del",)
+
+        res = db.txn(op)
+        # apply the same op to the model (sequentially — txns are serial here)
+        if res[0] == "rmw":
+            a = int(model.get(k1, b"0"))
+            b = int(model.get(k2, b"0"))
+            model[k1] = str(a + 1).encode()
+            model[k2] = str(b + 2).encode()
+        elif res[0] == "put":
+            model[k1] = f"s{step}".encode()
+        else:
+            model.pop(k1, None)
+    got = dict(db.scan(None, None))
+    assert got == model
+
+
+def test_interleaved_serializability():
+    """Two interleaved txns cannot both commit if they cross-read/write the
+    same keys (write skew prevented by the refresh check)."""
+    db = mkdb()
+    db.put(b"x", b"0")
+    db.put(b"y", b"0")
+    t1 = db.new_txn()
+    t2 = db.new_txn()
+    # t1 reads x writes y; t2 reads y writes x — classic write skew
+    assert t1.get(b"x") == b"0"
+    assert t2.get(b"y") == b"0"
+    t1.put(b"y", b"1")
+    t2.put(b"x", b"1")  # allowed: x carries no intent and no newer commit
+    t1.commit()         # commits y=1
+    with pytest.raises(TransactionRetryError):
+        t2.commit()     # must fail: its read of y was invalidated
+    assert db.get(b"y") == b"1"
+    assert db.get(b"x") == b"0"  # t2 rolled back
