@@ -624,6 +624,202 @@ class HashJoinOp(OneInputOperator):
         self.build.close()
 
 
+class WindowOp(OneInputOperator):
+    """Buffering window-function operator (colexecwindow analog): spool all
+    tiles, one sorted segmented-scan pass appends the window columns."""
+
+    def __init__(self, child: Operator, partition_cols: tuple[int, ...],
+                 order_keys, specs):
+        from ..ops import window as win_ops
+
+        super().__init__(child)
+        self.partition_cols = partition_cols
+        self.order_keys = tuple(order_keys)
+        self.specs = tuple(specs)
+        self.output_schema = win_ops.window_output_schema(
+            child.output_schema, self.specs
+        )
+        self.dictionaries = dict(child.dictionaries)
+        # string-valued window outputs (lag/lead/min/max/first/last over a
+        # STRING column) carry the source column's dictionary
+        base_len = len(child.output_schema)
+        for i, sp in enumerate(self.specs):
+            if (sp.col is not None and sp.col in child.dictionaries
+                    and sp.func in ("lag", "lead", "min", "max",
+                                    "first_value", "last_value")):
+                self.dictionaries[base_len + i] = child.dictionaries[sp.col]
+        self._emitted = False
+
+    def init(self):
+        super().init()
+        self._emitted = False
+        if hasattr(self, "_fn"):
+            return
+        from ..ops import window as win_ops
+
+        schema = self.child.output_schema
+        # rank tables for every STRING column the kernel sorts or reduces:
+        # order keys, partition keys, and min/max inputs
+        need = {k.col for k in self.order_keys}
+        need.update(self.partition_cols)
+        need.update(
+            sp.col for sp in self.specs
+            if sp.col is not None and sp.func in ("min", "max")
+        )
+        rank_tables = {
+            c: self.child.dictionaries[c].ranks
+            for c in need
+            if c in self.child.dictionaries
+        }
+        pcols = self.partition_cols
+        okeys = self.order_keys
+        specs = self.specs
+
+        @functools.partial(jax.jit, static_argnames=("cap",))
+        def fn(batches, cap):
+            big = concat(list(batches), capacity=cap)
+            return win_ops.compute_windows(
+                big, schema, pcols, okeys, specs, rank_tables
+            )
+
+        self._fn = fn
+
+    def _next(self):
+        if self._emitted:
+            return None
+        tiles = []
+        total = 0
+        while True:
+            b = self.child.next_batch()
+            if b is None:
+                break
+            tiles.append(b)
+            total += b.capacity
+        self._emitted = True
+        if not tiles:
+            return None
+        return self._fn(tuple(tiles), cap=_next_pow2(total))
+
+
+class MergeJoinOp(OneInputOperator):
+    """Single-key merge join: spool+sort the build side by exact key order,
+    stream probe tiles through vectorized binary search (mergejoiner.go
+    analog; no hash, no collision loop)."""
+
+    def __init__(self, probe: Operator, build: Operator, probe_key: int,
+                 build_key: int, spec):
+        from ..ops import join as join_ops
+
+        super().__init__(probe)
+        self.build = build
+        self.probe_key = probe_key
+        self.build_key = build_key
+        self.spec = spec
+        self.output_schema = join_ops.join_output_schema(
+            probe.output_schema, build.output_schema, spec
+        )
+        self.dictionaries = dict(probe.dictionaries)
+        if spec.join_type not in ("semi", "anti"):
+            off = len(probe.output_schema)
+            for i, d in build.dictionaries.items():
+                self.dictionaries[off + i] = d
+        # STRING keys need a shared rank space: remap build codes into the
+        # probe dictionary's rank table
+        self.probe_rank = None
+        self.build_rank = None
+        pt = probe.output_schema.types[probe_key]
+        if pt.family is Family.STRING:
+            pd = probe.dictionaries[probe_key]
+            bd = build.dictionaries[build_key]
+            self.probe_rank = pd.ranks
+            ranks = []
+            for i, v in enumerate(bd.values):
+                code = pd.code_of(str(v))
+                ranks.append(pd.ranks[code] if code >= 0
+                             else len(pd.values) + i)
+            self.build_rank = np.array(ranks, dtype=np.int32)
+        self._built = False
+
+    def children(self):
+        return [self.child, self.build]
+
+    def init(self):
+        self.build.init()
+        super().init()
+        self._built = False
+        if hasattr(self, "_probe_fn"):
+            return
+        from ..ops import merge_join as mj_ops
+
+        bschema = self.build.output_schema
+        bkey = self.build_key
+        brank = self.build_rank
+
+        @functools.partial(jax.jit, static_argnames=("cap",))
+        def build_fn(tiles, cap):
+            big = concat(list(tiles), capacity=cap)
+            return big, mj_ops.build_merge_index(big, bschema, bkey, brank)
+
+        self._build_fn = build_fn
+        pschema = self.child.output_schema
+        pkey = self.probe_key
+        prank = self.probe_rank
+        spec = self.spec
+
+        @functools.partial(jax.jit, static_argnames=("out_cap",))
+        def probe_fn(p, build, index, out_cap):
+            return mj_ops.merge_join(
+                p, pschema, pkey, build, bschema, bkey, spec, out_cap,
+                prank, brank, build_index=index,
+            )
+
+        self._probe_fn = probe_fn
+        self._out_cap = 4096
+
+    def _ensure_built(self):
+        if self._built:
+            return
+        tiles = []
+        total = 0
+        while True:
+            b = self.build.next_batch()
+            if b is None:
+                break
+            tiles.append(b)
+            total += b.capacity
+        if not tiles:
+            from ..coldata.batch import empty_batch
+            from ..ops import merge_join as mj_ops
+
+            self._build_batch = empty_batch(self.build.output_schema, 1024)
+            self._index = mj_ops.build_merge_index(
+                self._build_batch, self.build.output_schema, self.build_key,
+                self.build_rank,
+            )
+        else:
+            self._build_batch, self._index = self._build_fn(
+                tuple(tiles), cap=_next_pow2(total)
+            )
+        self._built = True
+
+    def _next(self):
+        self._ensure_built()
+        p = self.child.next_batch()
+        if p is None:
+            return None
+        while True:
+            out, total = self._probe_fn(
+                p, self._build_batch, self._index, out_cap=self._out_cap
+            )
+            if int(total) <= self._out_cap:
+                return out
+            self._out_cap = _next_pow2(int(total))
+
+    def close(self):
+        super().close()
+        self.build.close()
+
+
 class SmallGroupAggregateOp(OneInputOperator):
     """Dense-code aggregation for planner-known small group cardinality —
     the MXU/VPU-friendly hashAggregator specialization (e.g. TPC-H Q1's

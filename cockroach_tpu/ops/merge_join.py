@@ -1,0 +1,163 @@
+"""Merge join — the colexecjoin mergejoiner analog.
+
+Reference: pkg/sql/colexec/colexecjoin/mergejoiner.go streams two inputs
+sorted on the join key, advancing two cursors (per-join-type generated
+variants). On TPU the cursor walk becomes vectorized binary search over
+order-preserving uint64 key lanes (sort_ops.order_keys): with EXACT keys
+(not hashes) there are no collisions, so each probe row's match run is just
+[searchsorted left, searchsorted right) in the build tile — no advance loop
+at all. Duplicate handling reuses the count+emit pattern of the hash join.
+
+Single-key joins only (the composite-key case routes to the hash join; the
+reference's merge joiner is likewise used when the plan's interesting order
+covers the join key).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..coldata.batch import Batch, Column
+from ..coldata.types import Schema
+from .join import JoinSpec
+
+_SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _u64_key(batch: Batch, key: int, schema: Schema, rank_table=None):
+    """Order-preserving uint64 of one key column; NULL/dead -> sentinel
+    (never matches, matching SQL NULL != NULL)."""
+    from ..coldata.types import Family
+
+    c = batch.cols[key]
+    t = schema.types[key]
+    if t.family is Family.STRING:
+        assert rank_table is not None, "STRING merge join needs a rank table"
+        table = jnp.asarray(rank_table)
+        codes = jnp.clip(c.data, 0, table.shape[0] - 1)
+        payload = table[codes].astype(jnp.int64).astype(jnp.uint64) ^ np.uint64(
+            1 << 63
+        )
+    elif t.family is Family.FLOAT:
+        # IEEE total-order trick composed from 32-bit lanes — the TPU X64
+        # rewriter rejects 64-bit bitcasts, 32-bit ones are fine. Canonical
+        # -0.0 == 0.0 and NaN == NaN (Postgres float equality semantics).
+        f = c.data.astype(jnp.float64)
+        f = jnp.where(f == 0.0, 0.0, f)
+        f = jnp.where(jnp.isnan(f), jnp.float64(jnp.nan), f)
+        parts = jax.lax.bitcast_convert_type(f, jnp.uint32)  # [..., 2]
+        u = (parts[..., 1].astype(jnp.uint64) << np.uint64(32)) | parts[
+            ..., 0
+        ].astype(jnp.uint64)
+        neg = (u >> np.uint64(63)) != 0
+        payload = jnp.where(neg, ~u, u | np.uint64(1 << 63))
+    elif t.family is Family.BOOL:
+        payload = c.data.astype(jnp.uint64)
+    else:
+        payload = c.data.astype(jnp.int64).astype(jnp.uint64) ^ np.uint64(
+            1 << 63
+        )
+    active = batch.mask & c.valid
+    return jnp.where(active, payload, _SENTINEL), active
+
+
+def merge_join(
+    probe: Batch,
+    probe_schema: Schema,
+    probe_key: int,
+    build: Batch,
+    build_schema: Schema,
+    build_key: int,
+    spec: JoinSpec,
+    out_capacity: int,
+    probe_rank_table=None,
+    build_rank_table=None,
+    build_index=None,
+):
+    """Returns (out_batch, total_rows); retry with a bigger tile if
+    total_rows > out_capacity (same capacity-bucketing contract as
+    hash_join_general). `build_index` caches the build-side sorted keys."""
+    cap = probe.capacity
+    bcap = build.capacity
+    if build_index is None:
+        build_index = build_merge_index(
+            build, build_schema, build_key, build_rank_table
+        )
+    sk, order, prefix = build_index
+    pk, p_active = _u64_key(probe, probe_key, probe_schema, probe_rank_table)
+
+    lo = jnp.searchsorted(sk, pk, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(sk, pk, side="right").astype(jnp.int32)
+    # count only ACTIVE build rows in the run (dead/NULL rows share the key
+    # lanes of inactive rows and sort to the run's tail)
+    cnt = jnp.where(p_active, prefix[hi] - prefix[lo], 0)
+    max_run = jnp.max(cnt)
+
+    if spec.join_type == "semi":
+        return probe.with_mask(probe.mask & (cnt > 0)), jnp.sum(cnt > 0)
+    if spec.join_type == "anti":
+        return probe.with_mask(probe.mask & (cnt == 0)), jnp.sum(cnt == 0)
+
+    left = spec.join_type == "left"
+    out_rows = jnp.where(left & probe.mask, jnp.maximum(cnt, 1), cnt)
+    base = jnp.cumsum(out_rows) - out_rows
+    total = jnp.sum(out_rows)
+
+    OC = out_capacity
+    out_pidx = jnp.zeros((OC,), jnp.int32)
+    out_bidx = jnp.zeros((OC,), jnp.int32)
+    out_found = jnp.zeros((OC,), jnp.bool_)
+    out_live = jnp.zeros((OC,), jnp.bool_)
+    if left:
+        unmatched = probe.mask & (cnt == 0)
+        dest0 = jnp.where(unmatched, base.astype(jnp.int32), OC)
+        out_pidx = out_pidx.at[dest0].set(
+            jnp.arange(cap, dtype=jnp.int32), mode="drop")
+        out_live = out_live.at[dest0].set(True, mode="drop")
+
+    def emit_body(state):
+        k, op, ob, of, ol = state
+        m = k < cnt
+        posc = jnp.clip(lo + k, 0, bcap - 1)
+        bidx = order[posc]
+        dest = jnp.where(m, (base + k).astype(jnp.int32), OC)
+        op = op.at[dest].set(jnp.arange(cap, dtype=jnp.int32), mode="drop")
+        ob = ob.at[dest].set(bidx, mode="drop")
+        of = of.at[dest].set(True, mode="drop")
+        ol = ol.at[dest].set(True, mode="drop")
+        return k + 1, op, ob, of, ol
+
+    _, out_pidx, out_bidx, out_found, out_live = jax.lax.while_loop(
+        lambda s: s[0] < max_run,
+        emit_body,
+        (jnp.int32(0), out_pidx, out_bidx, out_found, out_live),
+    )
+
+    pcols = tuple(
+        Column(data=c.data[out_pidx], valid=c.valid[out_pidx] & out_live)
+        for c in probe.cols
+    )
+    bcols = tuple(
+        Column(data=c.data[out_bidx], valid=c.valid[out_bidx] & out_found)
+        for c in build.cols
+    )
+    return Batch(cols=pcols + bcols, mask=out_live), total
+
+
+def build_merge_index(build: Batch, schema: Schema, key: int, rank_table=None):
+    """Sort build rows by exact key order -> (sorted_keys, orig_index,
+    active_prefix). Inactive (dead/NULL-key) rows sort AFTER actives within
+    an equal-key run, and active_prefix[i] counts active rows before sorted
+    position i — so a probe run [lo, hi) has its active matches contiguous
+    at [lo, lo + prefix[hi] - prefix[lo])."""
+    bk, active = _u64_key(build, key, schema, rank_table)
+    perm = jnp.arange(build.capacity, dtype=jnp.int32)
+    sk, _, order = jax.lax.sort([bk, ~active, perm], num_keys=2)
+    sorted_active = active[order]
+    prefix = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(sorted_active.astype(jnp.int32)),
+    ])
+    return sk, order, prefix

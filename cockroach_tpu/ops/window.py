@@ -1,0 +1,265 @@
+"""Window function kernels — the colexecwindow analog.
+
+Reference: pkg/sql/colexec/colexecwindow implements rank/row_number/lead/lag
+and aggregates-as-window over partitioned, ordered buffers (one generated
+variant per frame/type). The TPU redesign runs every window function as a
+segmented scan over ONE sorted tile:
+
+- sort by (partition keys, order keys) — XLA lane-parallel sort;
+- partition boundaries -> segment ids (same trick as the MVCC scan filter);
+- row_number / rank / dense_rank = position arithmetic over boundaries;
+- running (unbounded-preceding..current-row) aggregates = cumsum minus the
+  segment's prefix; whole-partition aggregates = segment_sum gathered back;
+- lead/lag = shifted gathers with partition-edge NULLs.
+
+NULL ordering and peer semantics follow SQL: ORDER BY peers (ties) share
+rank; rank counts peers, dense_rank doesn't skip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..coldata.batch import Batch, Column
+from ..coldata.types import FLOAT64, INT64, Family, Schema, SQLType
+from . import sort as sort_ops
+
+WINDOW_FUNCS = (
+    "row_number", "rank", "dense_rank", "lag", "lead",
+    "sum", "count", "min", "max", "avg", "first_value", "last_value",
+)
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    """One window function: func over column `col` (None for rank family),
+    `offset` for lead/lag, `running` selects the cumulative frame
+    (rows unbounded preceding..current row) vs whole-partition."""
+
+    func: str
+    col: int | None = None
+    name: str | None = None
+    offset: int = 1
+    running: bool = False
+
+
+def window_output_type(spec: WindowSpec, schema: Schema) -> SQLType:
+    if spec.func in ("row_number", "rank", "dense_rank", "count"):
+        return INT64
+    if spec.func == "avg":
+        return FLOAT64
+    return schema.types[spec.col]
+
+
+def _partition_segments(batch: Batch, schema: Schema, part_cols, rank_tables):
+    """Segment id per row from partition-key change boundaries; requires the
+    batch sorted by partition keys (dead rows last)."""
+    cap = batch.capacity
+    if not part_cols:
+        return jnp.zeros((cap,), jnp.int32)
+    same = batch.mask[1:] & batch.mask[:-1]
+    for c in part_cols:
+        col = batch.cols[c]
+        if col.data.ndim == 2:
+            eqd = jnp.all(col.data[1:] == col.data[:-1], axis=-1)
+        else:
+            eqd = col.data[1:] == col.data[:-1]
+        # equal non-NULLs, or both NULL (NULLs are peers in PARTITION BY)
+        eq = (eqd & col.valid[1:] & col.valid[:-1]) | (
+            ~col.valid[1:] & ~col.valid[:-1]
+        )
+        same = same & eq
+    boundary = jnp.concatenate([jnp.ones((1,), jnp.bool_), ~same])
+    return jnp.cumsum(boundary.astype(jnp.int32)) - 1
+
+
+def _order_peers(batch: Batch, schema: Schema, order_keys, rank_tables, seg):
+    """Boundary where (segment, order keys) change — peers share ranks."""
+    cap = batch.capacity
+    if not order_keys:
+        return jnp.ones((cap,), jnp.bool_)
+    same = seg[1:] == seg[:-1]
+    for k in order_keys:
+        col = batch.cols[k.col]
+        eq = (col.data[1:] == col.data[:-1]) | (~col.valid[1:] & ~col.valid[:-1])
+        same = same & eq & (col.valid[1:] == col.valid[:-1])
+    return jnp.concatenate([jnp.ones((1,), jnp.bool_), ~same])
+
+
+def compute_windows(
+    batch: Batch,
+    schema: Schema,
+    part_cols: tuple[int, ...],
+    order_keys: tuple[sort_ops.SortKey, ...],
+    specs: tuple[WindowSpec, ...],
+    rank_tables=None,
+) -> Batch:
+    """Sort by (partition, order) and append one column per WindowSpec."""
+    rank_tables = rank_tables or {}
+    sort_keys = tuple(
+        sort_ops.SortKey(c) for c in part_cols
+    ) + tuple(order_keys)
+    b = sort_ops.sort_batch(batch, schema, sort_keys, rank_tables)
+    cap = b.capacity
+    pos = jnp.arange(cap, dtype=jnp.int32)
+
+    seg = _partition_segments(b, schema, part_cols, rank_tables)
+    seg_start = jax.ops.segment_min(
+        jnp.where(b.mask, pos, cap), seg, num_segments=cap
+    )  # first row position of each segment
+    start_of = seg_start[seg]  # per-row segment start position
+    peer_boundary = _order_peers(b, schema, order_keys, rank_tables, seg)
+
+    new_cols = list(b.cols)
+    for spec in specs:
+        out_t = window_output_type(spec, schema)
+        if spec.func == "row_number":
+            d = (pos - start_of + 1).astype(jnp.int64)
+            v = b.mask
+        elif spec.func in ("rank", "dense_rank"):
+            # rank: position of the peer-group head within the partition
+            head_pos = jnp.where(peer_boundary, pos, 0)
+            head = jax.lax.associative_scan(jnp.maximum, head_pos)
+            if spec.func == "rank":
+                d = (head - start_of + 1).astype(jnp.int64)
+            else:
+                # dense: count of peer boundaries in the partition so far
+                pb = jnp.cumsum(peer_boundary.astype(jnp.int64))
+                d = pb - pb[start_of] + 1
+            v = b.mask
+        elif spec.func in ("lag", "lead"):
+            col = b.cols[spec.col]
+            off = spec.offset if spec.func == "lag" else -spec.offset
+            src = pos - off
+            inb = (src >= 0) & (src < cap)
+            srcc = jnp.clip(src, 0, cap - 1)
+            same_seg = inb & (seg[srcc] == seg)
+            d = jnp.where(same_seg, col.data[srcc], 0).astype(col.data.dtype)
+            v = same_seg & col.valid[srcc] & b.mask
+        elif spec.func == "count" and spec.col is None:
+            # count(*) over the frame
+            vals = b.mask.astype(jnp.int64)
+            c = jnp.cumsum(vals)
+            if spec.running:
+                run = c - jnp.where(start_of > 0, c[start_of - 1], 0)
+            else:
+                run = jax.ops.segment_sum(vals, seg, num_segments=cap)[seg]
+            d, v = run.astype(jnp.int64), b.mask
+        elif spec.func in ("sum", "count", "min", "max", "avg",
+                           "first_value", "last_value"):
+            col = b.cols[spec.col]
+            t = schema.types[spec.col]
+            m = b.mask & col.valid
+            if spec.func == "count":
+                vals = m.astype(jnp.int64)
+            elif spec.func == "avg" or t.family is Family.FLOAT:
+                vals = jnp.where(m, col.data.astype(jnp.float64), 0.0)
+            else:
+                vals = jnp.where(m, col.data.astype(jnp.int64), 0)
+            if spec.func in ("sum", "count", "avg"):
+                c = jnp.cumsum(vals)
+                if spec.running:
+                    run = c - jnp.where(start_of > 0, c[start_of - 1], 0)
+                else:
+                    seg_tot = jax.ops.segment_sum(vals, seg, num_segments=cap)
+                    run = seg_tot[seg]
+                if spec.func == "count":
+                    d, v = run.astype(jnp.int64), b.mask
+                elif spec.func == "avg":
+                    cm = jnp.cumsum(m.astype(jnp.int64))
+                    if spec.running:
+                        n = cm - jnp.where(start_of > 0, cm[start_of - 1], 0)
+                    else:
+                        n = jax.ops.segment_sum(
+                            m.astype(jnp.int64), seg, num_segments=cap)[seg]
+                    d = run.astype(jnp.float64) / jnp.where(n > 0, n, 1)
+                    if t.family is Family.DECIMAL:
+                        d = d / (10.0**t.scale)
+                    v = b.mask & (n > 0)
+                else:
+                    d = run.astype(out_t.dtype)
+                    if t.family is Family.FLOAT:
+                        d = run
+                    n = jax.ops.segment_sum(
+                        m.astype(jnp.int64), seg, num_segments=cap)[seg]
+                    v = b.mask & (n > 0)
+            elif spec.func in ("min", "max"):
+                from .aggregation import _minmax_sentinel
+
+                is_min = spec.func == "min"
+                data = col.data
+                inv_rank = None
+                if t.family is Family.STRING:
+                    # reduce byte-order ranks, not insertion-order codes
+                    table = jnp.asarray(rank_tables[spec.col])
+                    data = table[jnp.clip(col.data, 0, table.shape[0] - 1)]
+                    inv = np.empty(len(rank_tables[spec.col]), dtype=np.int32)
+                    inv[np.asarray(rank_tables[spec.col])] = np.arange(
+                        len(inv), dtype=np.int32
+                    )
+                    inv_rank = jnp.asarray(inv)
+                sent = _minmax_sentinel(data.dtype, is_min)
+                vv = jnp.where(m, data, sent)
+                if spec.running:
+                    # segmented cumulative min/max: boundary-resetting scan
+                    op = jnp.minimum if is_min else jnp.maximum
+                    boundary = jnp.concatenate(
+                        [jnp.ones((1,), jnp.bool_), seg[1:] != seg[:-1]]
+                    )
+
+                    def comb(a, bb):
+                        af, av = a
+                        bf, bv = bb
+                        return bf | af, jnp.where(bf, bv, op(av, bv))
+
+                    _, red_run = jax.lax.associative_scan(
+                        comb, (boundary, vv)
+                    )
+                    red_rows = red_run
+                    n = jnp.cumsum(m.astype(jnp.int64))
+                    nb = n - jnp.where(start_of > 0, n[start_of - 1], 0)
+                else:
+                    red = (jax.ops.segment_min if is_min
+                           else jax.ops.segment_max)(vv, seg, num_segments=cap)
+                    red_rows = red[seg]
+                    nb = jax.ops.segment_sum(
+                        m.astype(jnp.int64), seg, num_segments=cap)[seg]
+                if inv_rank is not None:
+                    red_rows = inv_rank[
+                        jnp.clip(red_rows, 0, inv_rank.shape[0] - 1)
+                    ]
+                d = red_rows.astype(col.data.dtype)
+                v = b.mask & (nb > 0)
+            else:  # first_value / last_value over the partition or frame
+                last = spec.func == "last_value"
+                if spec.running and last:
+                    # running last_value is the current row
+                    d, v = col.data, b.mask & col.valid
+                else:
+                    # running first_value == partition first_value
+                    cand = jnp.where(b.mask, pos, -1 if last else cap)
+                    idx = (jax.ops.segment_max if last
+                           else jax.ops.segment_min)(cand, seg,
+                                                     num_segments=cap)
+                    srcc = jnp.clip(idx[seg], 0, cap - 1)
+                    d = col.data[srcc]
+                    v = b.mask & col.valid[srcc]
+        else:
+            raise ValueError(f"unknown window function {spec.func}")
+        new_cols.append(Column(data=d, valid=v & b.mask))
+    return Batch(cols=tuple(new_cols), mask=b.mask)
+
+
+def window_output_schema(
+    schema: Schema, specs: tuple[WindowSpec, ...]
+) -> Schema:
+    names = list(schema.names)
+    types = list(schema.types)
+    for s in specs:
+        names.append(s.name or s.func)
+        types.append(window_output_type(s, schema))
+    return Schema(tuple(names), tuple(types))

@@ -37,6 +37,19 @@ def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
         return ops.LimitOp(build(plan.input, catalog), plan.limit, plan.offset)
     if isinstance(plan, S.Distinct):
         return ops.DistinctOp(build(plan.input, catalog), plan.cols)
+    if isinstance(plan, S.Window):
+        return ops.WindowOp(
+            build(plan.input, catalog), plan.partition_cols,
+            plan.order_keys, plan.specs,
+        )
+    if isinstance(plan, S.MergeJoin):
+        return ops.MergeJoinOp(
+            build(plan.probe, catalog),
+            build(plan.build, catalog),
+            plan.probe_key,
+            plan.build_key,
+            plan.spec,
+        )
     if isinstance(plan, S.HashJoin):
         return ops.HashJoinOp(
             build(plan.probe, catalog),

@@ -101,3 +101,26 @@ class ScalarAggregate(PlanNode):
     input: PlanNode
     aggs: tuple[AggSpec, ...]
     mode: str = "complete"
+
+
+@dataclass(frozen=True)
+class Window(PlanNode):
+    """Window functions over (partition, order) — colexecwindow analog.
+    specs are ops.window.WindowSpec; output appends one column per spec."""
+
+    input: PlanNode
+    partition_cols: tuple[int, ...]
+    order_keys: tuple[SortKey, ...]
+    specs: tuple = ()
+
+
+@dataclass(frozen=True)
+class MergeJoin(PlanNode):
+    """Single-key merge join over order-preserving key lanes
+    (mergejoiner.go analog; composite keys route to HashJoin)."""
+
+    probe: PlanNode
+    build: PlanNode
+    probe_key: int
+    build_key: int
+    spec: JoinSpec = JoinSpec()

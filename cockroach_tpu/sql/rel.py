@@ -199,6 +199,54 @@ class Rel:
         }
         return Rel(self.catalog, S.Distinct(self.plan, idxs), schema, dicts)
 
+    def window(self, partition_by: list[str], order_by: list[tuple[str, bool]],
+               funcs: list[tuple[str, str, str | None]],
+               running: bool = False) -> "Rel":
+        """funcs: (output name, window func, input col name or None).
+        running=True selects the cumulative frame for aggregates."""
+        from ..ops import sort as sort_ops
+        from ..ops import window as win_ops
+
+        pcols = tuple(self.idx(n) for n in partition_by)
+        okeys = tuple(sort_ops.SortKey(self.idx(n), desc=d)
+                      for n, d in order_by)
+        specs = tuple(
+            win_ops.WindowSpec(
+                f, None if cn is None else self.idx(cn), name,
+                running=running,
+            )
+            for name, f, cn in funcs
+        )
+        node = S.Window(self.plan, pcols, okeys, specs)
+        schema = win_ops.window_output_schema(self.schema, specs)
+        dicts = dict(self.dicts)
+        base = len(self.schema)
+        for i, sp in enumerate(specs):  # string-valued window outputs
+            if (sp.col is not None and sp.col in self.dicts
+                    and sp.func in ("lag", "lead", "min", "max",
+                                    "first_value", "last_value")):
+                dicts[base + i] = self.dicts[sp.col]
+        return Rel(self.catalog, node, schema, dicts)
+
+    def merge_join(self, build: "Rel", on: tuple[str, str],
+                   how: str = "inner") -> "Rel":
+        """Single-key merge join (sorted-key binary search, no hashing)."""
+        from ..ops import join as join_ops
+
+        pk = self.idx(on[0])
+        bk = build.idx(on[1])
+        spec = join_ops.JoinSpec(how, build_unique=False)
+        node = S.MergeJoin(self.plan, build.plan, pk, bk, spec)
+        if how in ("semi", "anti"):
+            schema, dicts = self.schema, dict(self.dicts)
+        else:
+            schema = self.schema.concat(build.schema)
+            dicts = dict(self.dicts)
+            off = len(self.schema)
+            for i, d in build.dicts.items():
+                dicts[off + i] = d
+        return Rel(self.catalog, node, schema, dicts)
+
     def join(self, build: "Rel", on: list[tuple[str, str]],
              how: str = "inner", build_unique: bool = True) -> "Rel":
         pkeys = tuple(self.idx(l) for l, _ in on)

@@ -1,0 +1,232 @@
+"""Window function + merge join tests vs pandas oracles
+(colexecwindow / mergejoiner analogs)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cockroach_tpu.bench import tpch
+from cockroach_tpu.sql.rel import Rel
+
+
+@pytest.fixture(scope="module")
+def cat():
+    return tpch.gen_tpch(sf=0.002, seed=13)
+
+
+@pytest.fixture(scope="module")
+def li(cat):
+    return tpch.to_pandas(cat, "lineitem")
+
+
+def _window_rel(cat, funcs, running=False):
+    r = Rel.scan(cat, "lineitem",
+                 ("l_orderkey", "l_linenumber", "l_quantity", "l_partkey"))
+    return r.window(["l_orderkey"], [("l_linenumber", False)], funcs,
+                    running=running).run()
+
+
+def test_row_number_rank(cat, li):
+    res = _window_rel(cat, [("rn", "row_number", None)])
+    df = pd.DataFrame({k: res[k] for k in
+                       ("l_orderkey", "l_linenumber", "rn")})
+    want = (
+        li.sort_values(["l_orderkey", "l_linenumber"])
+        .groupby("l_orderkey").cumcount() + 1
+    )
+    got = df.sort_values(["l_orderkey", "l_linenumber"]).rn
+    np.testing.assert_array_equal(got.to_numpy(), want.to_numpy())
+
+
+def test_rank_with_ties(cat):
+    # rank over l_quantity (has ties within an order)
+    r = Rel.scan(cat, "lineitem", ("l_orderkey", "l_quantity"))
+    res = r.window(["l_orderkey"], [("l_quantity", False)],
+                   [("rk", "rank", None), ("drk", "dense_rank", None)]).run()
+    df = pd.DataFrame({k: res[k] for k in ("l_orderkey", "l_quantity",
+                                           "rk", "drk")})
+    g = df.sort_values(["l_orderkey", "l_quantity"])
+    want_rk = (
+        g.groupby("l_orderkey").l_quantity.rank(method="min").astype(int)
+    )
+    want_drk = (
+        g.groupby("l_orderkey").l_quantity.rank(method="dense").astype(int)
+    )
+    np.testing.assert_array_equal(g.rk.to_numpy(), want_rk.to_numpy())
+    np.testing.assert_array_equal(g.drk.to_numpy(), want_drk.to_numpy())
+
+
+def test_lag_lead(cat, li):
+    res = _window_rel(cat, [("prev_q", "lag", "l_quantity"),
+                            ("next_q", "lead", "l_quantity")])
+    df = pd.DataFrame({
+        "l_orderkey": res["l_orderkey"],
+        "l_linenumber": res["l_linenumber"],
+        "prev_q": res["prev_q"], "next_q": res["next_q"],
+    }).sort_values(["l_orderkey", "l_linenumber"])
+    s = li.sort_values(["l_orderkey", "l_linenumber"])
+    want_prev = s.groupby("l_orderkey").l_quantity.shift(1)
+    want_next = s.groupby("l_orderkey").l_quantity.shift(-1)
+    got_prev = pd.to_numeric(df.prev_q, errors="coerce") / 1  # None -> NaN
+    got_next = pd.to_numeric(df.next_q, errors="coerce")
+    np.testing.assert_allclose(
+        np.where(np.isnan(got_prev), -1, got_prev),
+        np.where(want_prev.isna(), -1, want_prev), rtol=1e-12)
+    np.testing.assert_allclose(
+        np.where(np.isnan(got_next), -1, got_next),
+        np.where(want_next.isna(), -1, want_next), rtol=1e-12)
+
+
+def test_window_partition_sum_and_running(cat, li):
+    res = _window_rel(cat, [("tot", "sum", "l_quantity"),
+                            ("cnt", "count", "l_quantity")])
+    df = pd.DataFrame({
+        "l_orderkey": res["l_orderkey"],
+        "l_linenumber": res["l_linenumber"],
+        "tot": np.asarray(res["tot"], dtype=np.float64),
+        "cnt": res["cnt"],
+    }).sort_values(["l_orderkey", "l_linenumber"])
+    s = li.sort_values(["l_orderkey", "l_linenumber"])
+    want_tot = s.groupby("l_orderkey").l_quantity.transform("sum")
+    want_cnt = s.groupby("l_orderkey").l_quantity.transform("count")
+    np.testing.assert_allclose(df.tot.to_numpy(), want_tot, rtol=1e-12)
+    np.testing.assert_array_equal(df.cnt.to_numpy(), want_cnt)
+
+    run = _window_rel(cat, [("rsum", "sum", "l_quantity")], running=True)
+    df2 = pd.DataFrame({
+        "l_orderkey": run["l_orderkey"],
+        "l_linenumber": run["l_linenumber"],
+        "rsum": np.asarray(run["rsum"], dtype=np.float64),
+    }).sort_values(["l_orderkey", "l_linenumber"])
+    want_rsum = s.groupby("l_orderkey").l_quantity.cumsum()
+    np.testing.assert_allclose(df2.rsum.to_numpy(), want_rsum, rtol=1e-12)
+
+
+def test_window_min_max_first_last(cat, li):
+    res = _window_rel(cat, [
+        ("mn", "min", "l_quantity"), ("mx", "max", "l_quantity"),
+        ("fv", "first_value", "l_quantity"),
+        ("lv", "last_value", "l_quantity"),
+    ])
+    df = pd.DataFrame({
+        "l_orderkey": res["l_orderkey"],
+        "l_linenumber": res["l_linenumber"],
+        "mn": np.asarray(res["mn"], np.float64),
+        "mx": np.asarray(res["mx"], np.float64),
+        "fv": np.asarray(res["fv"], np.float64),
+        "lv": np.asarray(res["lv"], np.float64),
+    }).sort_values(["l_orderkey", "l_linenumber"])
+    s = li.sort_values(["l_orderkey", "l_linenumber"])
+    g = s.groupby("l_orderkey").l_quantity
+    np.testing.assert_allclose(df.mn.to_numpy(), g.transform("min"), rtol=1e-12)
+    np.testing.assert_allclose(df.mx.to_numpy(), g.transform("max"), rtol=1e-12)
+    np.testing.assert_allclose(df.fv.to_numpy(), g.transform("first"), rtol=1e-12)
+    np.testing.assert_allclose(df.lv.to_numpy(), g.transform("last"), rtol=1e-12)
+
+
+def test_window_string_partition_and_minmax(cat):
+    """PARTITION BY a STRING column; min/max over a STRING column must
+    reduce byte order (ranks), not dictionary codes."""
+    r = Rel.scan(cat, "lineitem", ("l_returnflag", "l_shipmode",
+                                   "l_quantity"))
+    res = r.window(["l_returnflag"], [("l_quantity", False)], [
+        ("n", "count", None),
+        ("min_mode", "min", "l_shipmode"),
+        ("first_mode", "first_value", "l_shipmode"),
+    ]).run()
+    df = pd.DataFrame({k: res[k] for k in ("l_returnflag", "min_mode", "n")})
+    li2 = tpch.to_pandas(cat, "lineitem")
+    want_min = li2.groupby("l_returnflag").l_shipmode.min()
+    for rf, grp in df.groupby("l_returnflag"):
+        assert set(grp.min_mode) == {want_min[rf]}, rf
+        assert set(grp.n) == {int((li2.l_returnflag == rf).sum())}
+    # string outputs decode to strings, not codes
+    assert isinstance(res["first_mode"][0], str)
+
+
+def test_window_running_min(cat):
+    import cockroach_tpu.catalog as catalog_mod
+    from cockroach_tpu.coldata.types import INT64, Schema
+
+    c2 = catalog_mod.Catalog()
+    c2.add(catalog_mod.Table.from_strings(
+        "t", Schema.of(g=INT64, o=INT64, v=INT64),
+        {"g": np.array([1, 1, 1, 2, 2]), "o": np.arange(5),
+         "v": np.array([3, 1, 2, 5, 4])},
+    ))
+    res = Rel.scan(c2, "t").window(
+        ["g"], [("o", False)], [("rm", "min", "v")], running=True
+    ).run()
+    df = pd.DataFrame(res).sort_values(["g", "o"])
+    np.testing.assert_array_equal(df.rm, [3, 1, 1, 5, 4])
+
+
+# ---------------------------------------------------------------------------
+# merge join
+
+
+def test_merge_join_matches_hash_join(cat):
+    li = Rel.scan(cat, "lineitem", ("l_orderkey", "l_quantity"))
+    orders = Rel.scan(cat, "orders", ("o_orderkey", "o_totalprice"))
+    mj = li.merge_join(orders, ("l_orderkey", "o_orderkey")).run()
+    hj = li.join(orders, on=[("l_orderkey", "o_orderkey")],
+                 build_unique=False).run()
+    for k in mj:
+        a = np.sort(np.asarray(mj[k], dtype=np.float64))
+        b = np.sort(np.asarray(hj[k], dtype=np.float64))
+        np.testing.assert_allclose(a, b, rtol=1e-12, err_msg=k)
+
+
+def test_merge_join_duplicates_and_types():
+    import cockroach_tpu.catalog as catalog_mod
+    from cockroach_tpu.coldata.types import FLOAT64, INT64, Schema
+
+    cat = catalog_mod.Catalog()
+    cat.add(catalog_mod.Table.from_strings(
+        "t1", Schema.of(a=INT64, x=INT64),
+        {"a": np.array([1, 2, 2, 3, 9]), "x": np.arange(5)},
+    ))
+    cat.add(catalog_mod.Table.from_strings(
+        "t2", Schema.of(b=INT64, y=INT64),
+        {"b": np.array([2, 2, 3, 4]), "y": np.arange(4) * 10},
+    ))
+    t1 = Rel.scan(cat, "t1")
+    t2 = Rel.scan(cat, "t2")
+    res = t1.merge_join(t2, ("a", "b")).run()
+    df = pd.DataFrame(res).sort_values(["a", "x", "y"]).reset_index(drop=True)
+    p1 = pd.DataFrame({"a": [1, 2, 2, 3, 9], "x": np.arange(5)})
+    p2 = pd.DataFrame({"b": [2, 2, 3, 4], "y": np.arange(4) * 10})
+    want = p1.merge(p2, left_on="a", right_on="b").sort_values(
+        ["a", "x", "y"]).reset_index(drop=True)
+    assert len(df) == len(want) == 5  # 2x2 dup matches + one single
+    np.testing.assert_array_equal(df.a, want.a)
+    np.testing.assert_array_equal(df.y, want.y)
+    # semi / anti
+    semi = t1.merge_join(t2, ("a", "b"), how="semi").run()
+    assert sorted(semi["a"]) == [2, 2, 3]
+    anti = t1.merge_join(t2, ("a", "b"), how="anti").run()
+    assert sorted(anti["a"]) == [1, 9]
+    # left join null-extends
+    left = t1.merge_join(t2, ("a", "b"), how="left").run()
+    assert len(left["a"]) == 7
+    assert sum(1 for v in left["y"] if v is None) == 2
+
+
+def test_merge_join_int64_extremes():
+    """Keys at int64 max must not collide with the NULL/dead sentinel."""
+    import cockroach_tpu.catalog as catalog_mod
+    from cockroach_tpu.coldata.types import INT64, Schema
+
+    mx = np.iinfo(np.int64).max
+    cat = catalog_mod.Catalog()
+    cat.add(catalog_mod.Table.from_strings(
+        "t1", Schema.of(a=INT64, x=INT64),
+        {"a": np.array([mx, 5]), "x": np.array([1, 2])},
+    ))
+    cat.add(catalog_mod.Table.from_strings(
+        "t2", Schema.of(b=INT64, y=INT64),
+        {"b": np.array([mx, 7]), "y": np.array([10, 20])},
+    ))
+    res = Rel.scan(cat, "t1").merge_join(
+        Rel.scan(cat, "t2"), ("a", "b")).run()
+    assert list(res["a"]) == [mx] and list(res["y"]) == [10]
