@@ -432,6 +432,7 @@ class SortOp(OneInputOperator):
     def init(self):
         super().init()
         self._emitted = False
+        self._external = None
         if hasattr(self, "_fn"):
             return
         rank_tables = {
@@ -450,16 +451,33 @@ class SortOp(OneInputOperator):
         self._fn = fn
 
     def _next(self):
+        from ..utils import settings
+
         if self._emitted:
             return None
+        if getattr(self, "_external", None) is not None:
+            return self._external.next_batch()
         tiles = []
         total = 0
+        budget = settings.get("sql.distsql.workmem_rows")
         while True:
             b = self.child.next_batch()
             if b is None:
                 break
             tiles.append(b)
             total += b.capacity
+            if total > budget:
+                # spill: hand the spooled tiles + the rest of the input to
+                # the external range-partitioned sort (disk_spiller swap)
+                from .external import ChainOp, ExternalSortOp
+
+                chain = ChainOp(tiles, self.output_schema,
+                                self.child.dictionaries, self.child)
+                self._external = ExternalSortOp(
+                    chain, self.keys, budget_rows=budget
+                )
+                self._external.init()
+                return self._external.next_batch()
         self._emitted = True
         if not tiles:
             return None

@@ -148,6 +148,13 @@ L0_COMPACTION = register_int(
     "(DefaultPebbleOptions L0CompactionThreshold analog)",
     lo=1, hi=64,
 )
+WORKMEM_ROWS = register_int(
+    "sql.distsql.workmem_rows", 1 << 21,
+    "device-tile row budget for buffering operators; exceeding it swaps in "
+    "the external (host-partitioned) variant — the workmem/disk-spill "
+    "threshold (disk_spiller.go:103 analog)",
+    lo=1024,
+)
 DENSE_AGG = register_bool(
     "sql.distsql.dense_agg.enabled", True,
     "allow the dense-code small-group aggregation specialization "
