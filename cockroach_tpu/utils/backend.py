@@ -1,0 +1,48 @@
+"""JAX backend defense shared by driver scripts and tests.
+
+The environment injects a TPU PJRT plugin (sitecustomize on PYTHONPATH) that
+opens a hardware tunnel even under JAX_PLATFORMS=cpu, adding ~100s startup and
+hanging forever when the tunnel is wedged. Backend init is lazy, so before
+anything touches a device we can force the cpu platform and drop every other
+backend factory. Used by tests/conftest.py, __graft_entry__.py, and bench.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend(n_devices: int | None = None) -> None:
+    """Force jax onto the CPU backend, with an optional virtual device count.
+
+    Safe to call whether or not jax is already imported; also evicts any
+    already-initialized backend so the switch takes effect even after a
+    device touch.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+
+    import jax
+
+    # jax may already be imported (sitecustomize), freezing jax_platforms at
+    # the env value — override the live config, not just the env var.
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        for name in list(getattr(_xb, "_backend_factories", {})):
+            if name not in ("cpu",):
+                _xb._backend_factories.pop(name, None)
+    except Exception:  # pragma: no cover - defensive: jax internals moved
+        pass
+    # evict any backend initialized before the scrub (config updates and
+    # factory pops do not touch the cache)
+    try:
+        jax.extend.backend.clear_backends()
+    except Exception:  # pragma: no cover
+        pass

@@ -10,29 +10,11 @@ tests hostage to tunnel health. Backend init is lazy, so at conftest time we
 can still drop the plugin's backend factory before anything initializes.
 """
 
-import os
+from cockroach_tpu.utils.backend import force_cpu_backend
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+force_cpu_backend(8)
 
 import jax  # noqa: E402
-
-# sitecustomize imports jax before conftest, freezing jax_platforms at the
-# env value ("axon") — override the live config, not just the env var.
-jax.config.update("jax_platforms", "cpu")
-
-try:
-    from jax._src import xla_bridge as _xb
-
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name not in ("cpu",):
-            _xb._backend_factories.pop(_name, None)
-except Exception:  # pragma: no cover - defensive: jax internals moved
-    pass
 
 assert jax.devices()[0].platform == "cpu"
 assert len(jax.devices()) == 8, "virtual 8-device CPU mesh required"
