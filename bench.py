@@ -79,6 +79,12 @@ def _init_backend():
         _scrub_to_cpu()
     import jax
 
+    # the probe only proves a THROWAWAY subprocess could init the backend;
+    # the tunnel can still wedge the in-process init, which except can't
+    # catch — a watchdog guarantees the one-JSON-line contract regardless
+    watchdog = _start_watchdog(
+        timeout_s * 1.5, "in-process backend init hung"
+    )
     try:
         # the probe subprocess validated this backend; init in-process
         platform = jax.devices()[0].platform
@@ -89,7 +95,27 @@ def _init_backend():
               file=sys.stderr, flush=True)
         _scrub_to_cpu()
         platform = jax.devices()[0].platform
+    finally:
+        watchdog.cancel()
     return jax, platform
+
+
+def _start_watchdog(timeout_s: float, what: str):
+    """If not cancelled within timeout_s, emit the error JSON line and hard-
+    exit (a wedged PJRT init cannot be interrupted from Python)."""
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": "tpch_bench_failed", "value": 0, "unit": "rows/sec",
+            "vs_baseline": 0.0, "error": f"watchdog: {what}",
+        }), flush=True)
+        os._exit(0)
+
+    t = threading.Timer(timeout_s, fire)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def _pandas_baseline(qname, cat, res) -> float:
@@ -260,7 +286,9 @@ def main() -> None:
     sf = float(os.environ.get("TPCH_SF", "1.0"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
     # north-star ladder (BASELINE.md): Q3/Q9/Q18 + the Q1 single-table base
-    qnames = os.environ.get("BENCH_QUERY", "q1,q3,q9,q18").split(",")
+    qnames = [q.strip() for q in
+              os.environ.get("BENCH_QUERY", "q1,q3,q9,q18").split(",")
+              if q.strip()]
 
     jax, platform = _init_backend()
 

@@ -32,6 +32,23 @@ from .types import BYTES, Family, Schema, SQLType, zeros_like_type
 DEFAULT_CAPACITY = 4096  # coldata.MaxBatchSize (pkg/col/coldata/batch.go:102)
 
 
+def pack_be_words(data: jax.Array) -> jax.Array:
+    """[N, W] uint8 -> [N, ceil(W/8)] big-endian uint64 word lanes.
+
+    Tuple order over the word lanes equals bytewise lexicographic order of
+    the rows; widths not a multiple of 8 are zero-padded on the right
+    (order-preserving for the zero-padded fixed-width representation).
+    The single canonical byte->word packing — storage key encoding and
+    BYTES sort keys both ride this."""
+    n, w = data.shape
+    if w % 8:
+        data = jnp.pad(data, ((0, 0), (0, 8 - w % 8)))
+        w = data.shape[1]
+    groups = data.reshape(n, w // 8, 8).astype(jnp.uint64)
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint64) * jnp.uint64(8)
+    return jnp.sum(groups << shifts, axis=-1, dtype=jnp.uint64)
+
+
 @jax.tree_util.register_dataclass
 @dataclass(frozen=True)
 class Column:

@@ -1,10 +1,11 @@
 """KV layer — transactional key-value API over the MVCC LSM engine
 (pkg/kv analog: kv.DB, kv.Txn, retries, intents, refresh validation)."""
 
+from ..storage.lsm import WriteIntentError
 from .hlc import Clock, ManualClock
 from .txn import DB, TransactionAbortedError, TransactionRetryError, Txn
 
 __all__ = [
     "Clock", "ManualClock", "DB", "Txn",
-    "TransactionAbortedError", "TransactionRetryError",
+    "TransactionAbortedError", "TransactionRetryError", "WriteIntentError",
 ]

@@ -3,8 +3,9 @@
 Reference: hlc.Clock issues timestamps (walltime, logical) that are totally
 ordered, monotone per node, and close to wall time; readings advance on
 message receipt (clock.Update). Here the pair packs into one int64
-(wall micros << 20 | logical), matching the storage layer's single-int64
-version timestamps.
+(wall millis << 20 | logical), matching the storage layer's single-int64
+version timestamps. Milliseconds (not the reference's nanos) so the packed
+value stays inside int64 until ~year 2248 with 2^20 logical ticks per ms.
 """
 
 from __future__ import annotations
@@ -15,8 +16,10 @@ LOGICAL_BITS = 20
 LOGICAL_MASK = (1 << LOGICAL_BITS) - 1
 
 
-def pack(wall_us: int, logical: int) -> int:
-    return (wall_us << LOGICAL_BITS) | logical
+def pack(wall_ms: int, logical: int) -> int:
+    ts = (wall_ms << LOGICAL_BITS) | logical
+    assert ts < (1 << 63), f"hlc wall component overflows int64: {wall_ms}"
+    return ts
 
 
 def unpack(ts: int) -> tuple[int, int]:
@@ -27,12 +30,12 @@ class Clock:
     """Monotone hybrid clock. now() never returns the same or a smaller
     timestamp twice; update(ts) ratchets past a remote observation."""
 
-    def __init__(self, wall_us=None):
-        self._wall_us = wall_us or (lambda: int(time.time() * 1e6))
+    def __init__(self, wall_fn=None):
+        self._wall_fn = wall_fn or (lambda: int(time.time() * 1e3))
         self._last = 0
 
     def now(self) -> int:
-        wall = self._wall_us()
+        wall = self._wall_fn()
         ts = pack(wall, 0)
         if ts <= self._last:
             ts = self._last + 1
@@ -49,9 +52,9 @@ class Clock:
 class ManualClock(Clock):
     """Deterministic clock for tests (the reference's timeutil manual time)."""
 
-    def __init__(self, start_us: int = 1):
-        super().__init__(wall_us=lambda: self._manual)
-        self._manual = start_us
+    def __init__(self, start: int = 1):
+        super().__init__(wall_fn=lambda: self._manual)
+        self._manual = start
 
-    def advance(self, us: int = 1) -> None:
-        self._manual += us
+    def advance(self, ticks: int = 1) -> None:
+        self._manual += ticks

@@ -147,16 +147,29 @@ class DB:
         self.engine = engine or Engine()
         self.clock = clock or hlc.Clock()
 
-    # non-transactional (auto-committed) ops
+    # non-transactional (auto-committed) ops. Like the reference, non-txn
+    # requests still sequence through concurrency control: a write under
+    # another txn's intent conflicts (WriteIntentError) instead of silently
+    # laying a committed version beneath the intent; non-txn reads surface
+    # the same WriteIntentError (callers retry after the owner resolves).
     def put(self, key, value) -> int:
+        k = _b(key)
+        self._check_lock(k)
         ts = self.clock.now()
-        self.engine.put(_b(key), value, ts=ts)
+        self.engine.put(k, value, ts=ts)
         return ts
 
     def delete(self, key) -> int:
+        k = _b(key)
+        self._check_lock(k)
         ts = self.clock.now()
-        self.engine.delete(_b(key), ts=ts)
+        self.engine.delete(k, ts=ts)
         return ts
+
+    def _check_lock(self, key: bytes) -> None:
+        other = self.engine.other_intent(key, 0)
+        if other is not None:
+            raise WriteIntentError([key], [other])
 
     def get(self, key, ts: int | None = None) -> bytes | None:
         return self.engine.get(_b(key), ts=ts if ts is not None

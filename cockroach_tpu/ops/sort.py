@@ -66,6 +66,17 @@ def order_keys(
     if t.family is Family.BOOL:
         key = data
         return [null_key, key != k.desc]
+    if t.family is Family.BYTES:
+        # lexicographic byte order == unsigned order of big-endian-packed
+        # uint64 words (zero padding ranks shorter strings first, matching
+        # the engine's zero-padded fixed-width representation)
+        from ..coldata.batch import pack_be_words
+
+        words = pack_be_words(data)
+        return [null_key] + [
+            ~words[:, i] if k.desc else words[:, i]
+            for i in range(words.shape[1])
+        ]
     u = data.astype(jnp.int64).astype(jnp.uint64) ^ np.uint64(0x8000000000000000)
     if k.desc:
         u = ~u

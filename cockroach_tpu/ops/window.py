@@ -85,7 +85,11 @@ def _order_peers(batch: Batch, schema: Schema, order_keys, rank_tables, seg):
     same = seg[1:] == seg[:-1]
     for k in order_keys:
         col = batch.cols[k.col]
-        eq = (col.data[1:] == col.data[:-1]) | (~col.valid[1:] & ~col.valid[:-1])
+        if col.data.ndim == 2:  # BYTES: rows are equal iff all lanes equal
+            eqd = jnp.all(col.data[1:] == col.data[:-1], axis=-1)
+        else:
+            eqd = col.data[1:] == col.data[:-1]
+        eq = eqd | (~col.valid[1:] & ~col.valid[:-1])
         same = same & eq & (col.valid[1:] == col.valid[:-1])
     return jnp.concatenate([jnp.ones((1,), jnp.bool_), ~same])
 

@@ -44,11 +44,18 @@ def _node_label(n: S.PlanNode) -> str:
         return f"distinct on={list(n.cols) if n.cols else 'all'}"
     if isinstance(n, S.Exchange):
         return f"exchange (all-to-all) keys={list(n.keys)}"
+    if isinstance(n, S.MergeJoin):
+        return (f"merge-join ({n.spec.join_type}) "
+                f"probe={n.probe_key} build={n.build_key}")
+    if isinstance(n, S.Window):
+        fns = [s.func for s in n.specs]
+        return (f"window {fns} partition={list(n.partition_cols)} "
+                f"order={[k.col for k in n.order_keys]}")
     return type(n).__name__
 
 
 def _children(n: S.PlanNode) -> list[S.PlanNode]:
-    if isinstance(n, S.HashJoin):
+    if isinstance(n, (S.HashJoin, S.MergeJoin)):
         return [n.probe, n.build]
     if hasattr(n, "input"):
         return [n.input]

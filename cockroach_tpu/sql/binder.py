@@ -136,6 +136,9 @@ class Source:
     # base-table cardinality, captured before filter pushdown (join ordering
     # still sees the true relative sizes); subqueries get a large default
     base_rows: int = 1 << 30
+    # base-table provenance (None for subquery sources); lets bind-time
+    # checks prove column non-nullability from the catalog's valid bitmaps
+    table: str | None = None
 
 
 class Scope:
@@ -424,7 +427,8 @@ class Binder:
                 rel = Rel.scan(self.catalog, it.name)
                 sources.append(
                     Source(it.alias or it.name, rel, rel.schema.names,
-                           base_rows=self.catalog.get(it.name).num_rows)
+                           base_rows=self.catalog.get(it.name).num_rows,
+                           table=it.name)
                 )
             elif isinstance(it, P.SubqueryRef):
                 rel = self.bind(it.select)
@@ -483,6 +487,15 @@ class Binder:
             arg = node.arg
             if not isinstance(arg, P.Ident):
                 raise BindError("IN (SELECT) argument must be a column")
+            if how == "anti":
+                # NOT IN is only a plain anti join when neither side can be
+                # NULL (a NULL in the subquery result empties the output; a
+                # NULL probe key is never returned under three-valued
+                # logic, but an anti join returns it). Prove non-nullability
+                # at bind time or refuse, as the reference's optbuilder adds
+                # NULL checks before using anti join.
+                self._require_non_nullable(arg, scope, "NOT IN argument")
+                self._require_inner_non_nullable(node.select)
             outer_col = arg.name
             inner_col = sub.schema.names[0]
             joined.rel = joined.rel.join(
@@ -505,6 +518,41 @@ class Binder:
         if len(rel.schema) != 1:
             raise BindError("IN subquery must produce one column")
         return rel
+
+    def _base_col_non_nullable(self, table: str, col: str) -> bool:
+        """Whether a base-table column provably holds no NULLs. Tables are
+        static preloaded data, so inspecting the valid bitmap is sound."""
+        v = self.catalog.get(table).valids.get(col)
+        return v is None or bool(np.asarray(v).all())
+
+    def _require_non_nullable(self, ident: P.Ident, scope, what: str) -> None:
+        i, name = scope.resolve(ident)
+        src = scope.sources[i]
+        if src.table is None or not self._base_col_non_nullable(
+            src.table, name
+        ):
+            raise BindError(
+                f"{what} {ident.name} may be NULL; NOT IN over nullable "
+                "columns is not supported (three-valued NOT IN semantics)"
+            )
+
+    def _require_inner_non_nullable(self, sel: P.Select) -> None:
+        """Prove the single output column of a NOT IN subquery non-nullable:
+        a plain column of a single base table with an all-valid bitmap."""
+        items = sel.from_
+        ok = (
+            len(items) == 1 and isinstance(items[0], P.TableRef)
+            and len(sel.items) == 1
+            and isinstance(sel.items[0].expr, P.Ident)
+            and self._base_col_non_nullable(
+                items[0].name, sel.items[0].expr.name
+            )
+        )
+        if not ok:
+            raise BindError(
+                "NOT IN subquery column may be NULL; NOT IN over nullable "
+                "columns is not supported (three-valued NOT IN semantics)"
+            )
 
     def _bind_correlated(self, sel: P.Select, joined: "BoundQuery"):
         """Bind an EXISTS subquery: conjuncts of its WHERE that are

@@ -52,11 +52,10 @@ def key_words(key: jax.Array) -> jax.Array:
 
     Tuple order over the word lanes equals bytewise lexicographic order.
     """
-    n, kw = key.shape
-    assert kw % 8 == 0, "key width must be a multiple of 8"
-    groups = key.reshape(n, kw // 8, 8).astype(jnp.uint64)
-    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint64) * jnp.uint64(8)
-    return jnp.sum(groups << shifts, axis=-1, dtype=jnp.uint64)
+    assert key.shape[1] % 8 == 0, "key width must be a multiple of 8"
+    from ..coldata.batch import pack_be_words
+
+    return pack_be_words(key)
 
 
 def words_cmp_lt(a: jax.Array, b: jax.Array) -> jax.Array:

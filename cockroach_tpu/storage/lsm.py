@@ -114,6 +114,10 @@ class Engine:
         self.stats = MVCCStats()
         self._seq = 0  # global write sequence: same-(key, ts) writes resolve
         # newest-sequence-wins (intent rewrites within a txn, TxnSeq analog)
+        # host-side lock table (concurrency/lock_table.go analog): key ->
+        # txn id holding an intent. Kept in sync by _append/resolve_intents
+        # so lock checks are O(1) host lookups, never device merges.
+        self._locks: dict[bytes, int] = {}
 
     # -- writes -------------------------------------------------------------
 
@@ -126,11 +130,17 @@ class Engine:
     def _append(self, key, value, ts: int, txn: int, tomb: bool):
         b = key.encode() if isinstance(key, str) else bytes(key)
         v = value.encode() if isinstance(value, str) else bytes(value)
+        if b"\x00" in b:
+            # zero-padded fixed-width encoding makes b"a" and b"a\x00"
+            # indistinguishable (keys.py precondition) — enforce it here
+            raise ValueError(f"key must not contain 0x00 bytes: {b!r}")
         if len(b) > self.key_width:
             raise ValueError(f"key too long ({len(b)} > {self.key_width})")
         if len(v) > self.val_width:
             raise ValueError(f"value too long ({len(v)} > {self.val_width})")
         self._seq += 1
+        if txn != 0:
+            self._locks[b] = int(txn)
         self.mem.keys.append(b)
         self.mem.ts.append(int(ts))
         self.mem.seq.append(self._seq)
@@ -278,6 +288,7 @@ class Engine:
 
     def resolve_intents(self, txn: int, commit_ts: int, commit: bool):
         """Commit or abort all of txn's intents across memtable + runs."""
+        self._locks = {k: t for k, t in self._locks.items() if t != txn}
         self.flush_mem_only()
         self.runs = [
             mvcc.sort_block(
@@ -317,20 +328,11 @@ class Engine:
     def other_intent(self, key: bytes, txn: int) -> int | None:
         """Txn id of another transaction's intent on `key`, if any —
         the lock-table point lookup the write path does before laying an
-        intent (concurrency_manager.SequenceReq's lock check)."""
-        view = self._merged_view()
-        if view is None:
-            return None
-        sw = K.encode_bound(key, self.key_width)
-        ew = K.bound_next(sw)
-        words = K.key_words(view.key)
-        hit = (
-            view.mask
-            & K.words_in_range(words, jnp.asarray(sw), jnp.asarray(ew))
-            & (view.txn != 0) & (view.txn != txn)
-        )
-        idx = np.nonzero(np.asarray(hit))[0]
-        return int(np.asarray(view.txn)[idx[0]]) if len(idx) else None
+        intent (concurrency_manager.SequenceReq's lock check). A pure host
+        dict lookup: no device work on the write hot path."""
+        b = key.encode() if isinstance(key, str) else bytes(key)
+        holder = self._locks.get(b)
+        return holder if holder is not None and holder != txn else None
 
     def newest_committed_ts(self, key: bytes) -> int:
         """Timestamp of the newest committed version of `key` (0 if none) —
@@ -350,11 +352,7 @@ class Engine:
         return int(np.asarray(jnp.max(ts)))
 
     def intent_keys(self, txn: int) -> list[bytes]:
-        view = self._merged_view()
-        if view is None:
-            return []
-        m = np.asarray(view.mask & (view.txn == txn))
-        return K.decode_keys(np.asarray(view.key)[np.nonzero(m)[0]])
+        return sorted(k for k, t in self._locks.items() if t == txn)
 
     # -- stats / checkpoint -------------------------------------------------
 
@@ -409,9 +407,16 @@ class Engine:
             )
         eng.stats.runs = len(eng.runs)
         # restore the write-sequence high-water mark so post-restore writes
-        # keep winning same-(key, ts) tie-breaks over persisted rows
+        # keep winning same-(key, ts) tie-breaks over persisted rows, and
+        # rebuild the host lock table from persisted intents
         for r in eng.runs:
             m = np.asarray(r.mask)
             if m.any():
                 eng._seq = max(eng._seq, int(np.asarray(r.seq)[m].max()))
+            im = m & (np.asarray(r.txn) != 0)
+            if im.any():
+                ks = K.decode_keys(np.asarray(r.key)[np.nonzero(im)[0]])
+                ts = np.asarray(r.txn)[np.nonzero(im)[0]]
+                for kk, tt in zip(ks, ts):
+                    eng._locks[kk] = int(tt)
         return eng

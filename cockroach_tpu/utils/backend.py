@@ -21,11 +21,17 @@ def force_cpu_backend(n_devices: int | None = None) -> None:
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
     if n_devices is not None:
+        import re
+
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={n_devices}"
-            ).strip()
+        # replace any pre-existing count (don't silently keep it: backends
+        # are evicted below, so the requested mesh size must win)
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "", flags
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
 
     import jax
 

@@ -91,3 +91,19 @@ def test_engine_uses_l0_setting():
     settings.set("storage.l0_compaction_threshold", 2)
     eng = Engine(val_width=8, memtable_size=2)
     assert eng.l0_trigger == 2
+
+
+def test_explain_merge_join_children(cat):
+    """EXPLAIN renders MergeJoin with both input subtrees (regression:
+    _children treated it as a leaf), and explain_analyze carries stats."""
+    from cockroach_tpu.sql.rel import Rel
+
+    li = Rel.scan(cat, "lineitem", ("l_orderkey", "l_quantity"))
+    orders = Rel.scan(cat, "orders", ("o_orderkey", "o_totalprice"))
+    j = li.merge_join(orders, ("l_orderkey", "o_orderkey"))
+    txt = j.explain()
+    assert "merge-join" in txt
+    assert txt.count("scan") == 2  # both children rendered
+    txt2, _ = j.explain_analyze()
+    assert "merge-join" in txt2 and txt2.count("scan") == 2
+    assert "rows=" in txt2

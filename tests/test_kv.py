@@ -220,3 +220,22 @@ def test_interleaved_serializability():
         t2.commit()     # must fail: its read of y was invalidated
     assert db.get(b"y") == b"1"
     assert db.get(b"x") == b"0"  # t2 rolled back
+
+
+def test_non_txn_write_respects_intents():
+    """Non-txn DB.put/delete sequence through the lock check: writing under
+    another txn's intent raises WriteIntentError instead of silently laying
+    a committed version beneath the intent."""
+    from cockroach_tpu.kv import DB, WriteIntentError
+
+    db = DB()
+    t = db.new_txn()
+    t.put("k", "txnval")
+    with pytest.raises(WriteIntentError):
+        db.put("k", "sneaky")
+    with pytest.raises(WriteIntentError):
+        db.delete("k")
+    t.commit()
+    assert db.get("k") == b"txnval"
+    db.put("k", "after")  # lock released by commit
+    assert db.get("k") == b"after"

@@ -321,3 +321,36 @@ def test_sql_subquery_in_from(cat):
     np.testing.assert_allclose(
         got["total"].astype(np.float64), w.s_acctbal, rtol=1e-9
     )
+
+
+def test_sql_not_in_nullable_rejected():
+    """NOT IN over a nullable subquery column is rejected at bind time: a
+    plain anti join diverges from three-valued NOT IN semantics when the
+    subquery result can contain NULL."""
+    import cockroach_tpu.catalog as catalog_mod
+    from cockroach_tpu.coldata.types import INT64, Schema
+    from cockroach_tpu.sql.binder import BindError
+
+    c2 = catalog_mod.Catalog()
+    c2.add(catalog_mod.Table.from_strings(
+        "t", Schema.of(a=INT64), {"a": np.arange(5)}))
+    c2.add(catalog_mod.Table.from_strings(
+        "u", Schema.of(b=INT64, c=INT64),
+        {"b": np.arange(3), "c": np.arange(100, 103)},
+        valids={"b": np.array([True, False, True])}))
+    # nullable subquery column rejected
+    with pytest.raises(BindError, match="NULL"):
+        sql(c2, "select count(*) as n from t "
+                "where a not in (select b from u)")
+    # nullable outer argument rejected
+    with pytest.raises(BindError, match="NULL"):
+        sql(c2, "select count(*) as n from u "
+                "where b not in (select a from t)")
+    # IN (not negated) over the same nullable column is fine
+    got = sql(c2, "select count(*) as n from t "
+                  "where a in (select b from u)").run()
+    assert int(got["n"][0]) >= 1
+    # and NOT IN over provably non-null columns still binds
+    got = sql(c2, "select count(*) as n from t "
+                  "where a not in (select c from u)").run()
+    assert int(got["n"][0]) == 5

@@ -319,3 +319,15 @@ def test_engine_random_oracle(rng):
                 f"divergence at step {step} read_ts {read_ts}"
             )
     assert eng.scan(None, None, ts=ts) == model.scan(ts)
+
+
+def test_engine_rejects_nul_keys():
+    """Zero-padded fixed-width key encoding cannot represent keys containing
+    0x00 (b"a" == b"a\\x00" after padding) — the engine must reject them."""
+    from cockroach_tpu.storage.lsm import Engine
+
+    eng = Engine()
+    with pytest.raises(ValueError, match="0x00"):
+        eng.put(b"a\x00b", b"v", ts=1)
+    eng.put(b"ab", b"v", ts=1)  # NUL-free keys still fine
+    assert eng.get(b"ab", ts=2) == b"v"

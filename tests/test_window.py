@@ -230,3 +230,36 @@ def test_merge_join_int64_extremes():
     res = Rel.scan(cat, "t1").merge_join(
         Rel.scan(cat, "t2"), ("a", "b")).run()
     assert list(res["a"]) == [mx] and list(res["y"]) == [10]
+
+
+def test_window_order_by_bytes_column():
+    """ORDER BY over a BYTES (2-D) column: peers must compare all lanes
+    (regression: _order_peers lacked the 2-D branch and crashed)."""
+    import jax.numpy as jnp
+
+    from cockroach_tpu.coldata import batch as cb
+    from cockroach_tpu.coldata.types import BYTES, INT64, Schema
+    from cockroach_tpu.ops import window as W
+    from cockroach_tpu.ops.sort import SortKey
+
+    schema = Schema.of(g=INT64, k=BYTES(4), v=INT64)
+    keys = np.zeros((6, 4), dtype=np.uint8)
+    for i, s in enumerate([b"aa", b"ab", b"ab", b"ba", b"ba", b"bb"]):
+        keys[i, : len(s)] = np.frombuffer(s, dtype=np.uint8)
+    b = cb.from_host(
+        schema,
+        {"g": np.array([1, 1, 1, 1, 1, 1]), "k": keys,
+         "v": np.arange(6)},
+        capacity=8,
+    )
+    out = W.compute_windows(
+        b, schema, (0,), (SortKey(1),),
+        (W.WindowSpec("rank", None, "rk"),
+         W.WindowSpec("dense_rank", None, "drk")),
+    )
+    mask = np.asarray(out.mask)
+    rk = np.asarray(out.cols[3].data)[mask]
+    drk = np.asarray(out.cols[4].data)[mask]
+    # ties on "ab" and "ba" share ranks
+    np.testing.assert_array_equal(np.sort(rk), [1, 2, 2, 4, 4, 6])
+    np.testing.assert_array_equal(np.sort(drk), [1, 2, 2, 3, 3, 4])
