@@ -277,14 +277,27 @@ def _primary_u64(batch: Batch, schema: Schema, key: sort_ops.SortKey,
     c = batch.cols[key.col]
     ops = sort_ops.order_keys(c.data, c.valid, key, schema.types[key.col],
                               rank_table)
-    # combine [null_key(bool), (nan_key?), payload] into one u64:
-    # top bits: null ordering, then nan ordering, then payload scaled down
+    # order_keys returns leading 1-bit bool bands (null ordering, NaN
+    # ordering) followed by the payload word(s). Fold the bands into the top
+    # bits and range-partition on the FIRST payload word only — for
+    # multi-word keys (BYTES wider than 8) this is order-preserving at
+    # partition granularity: rows equal in the leading word stay in one
+    # bucket, and the within-bucket sort uses the full key list.
+    bands, payload = [], None
+    for op in ops:
+        if op.dtype == jnp.bool_:
+            bands.append(op)
+        else:
+            payload = op
+            break
+    if payload is None:  # BOOL key: its one bool band IS the payload —
+        # promote the bit to the top so the band right-shift below keeps it
+        payload = bands.pop().astype(jnp.uint64) << np.uint64(63)
     u = jnp.zeros((batch.capacity,), jnp.uint64)
     shift = np.uint64(62)
-    for op in ops[:-1]:
+    for op in bands:
         u = u | (op.astype(jnp.uint64) << shift)
         shift -= np.uint64(1)
-    payload = ops[-1]
     if payload.dtype in (jnp.float64, jnp.float32):
         f = payload.astype(jnp.float64)
         parts = jax.lax.bitcast_convert_type(f, jnp.uint32)

@@ -228,18 +228,10 @@ class AggregateOp(OneInputOperator):
         self._emitted = False
 
     def _final_schema(self, base: Schema) -> Schema:
-        names = [base.names[i] for i in self.group_cols]
-        types = [base.types[i] for i in self.group_cols]
-        if self.mode == "final":
-            names = list(self.state_schema.names[: self.num_keys])
-            types = list(self.state_schema.types[: self.num_keys])
-        for spec, fm in zip(self.aggs, self.final_map):
-            names.append(spec.name or spec.func)
-            if fm[0] == "avg":
-                types.append(FLOAT64)
-            else:
-                types.append(agg_ops.agg_output_type(spec, self.base_schema))
-        return Schema(tuple(names), tuple(types))
+        return agg_ops.agg_output_schema(
+            base, self.group_cols, self.aggs,
+            "final" if self.mode == "final" else "complete",
+        )
 
     def init(self):
         super().init()
@@ -325,55 +317,12 @@ class ScalarAggregateOp(OneInputOperator):
             )
         self.output_schema = Schema(tuple(names), tuple(types))
         self.dictionaries = {}
-
-        def tile_states(b: Batch):
-            out = []
-            for spec in aggs:
-                if spec.func == "count_rows":
-                    out.append((jnp.sum(b.mask, dtype=jnp.int64), jnp.bool_(True)))
-                    continue
-                c = b.cols[spec.col]
-                t = base.types[spec.col]
-                m = b.mask & c.valid
-                cnt = jnp.sum(m, dtype=jnp.int64)
-                if spec.func == "count":
-                    out.append((cnt, jnp.bool_(True)))
-                elif spec.func in ("sum", "avg"):
-                    if t.family is Family.FLOAT or spec.func == "avg":
-                        s = jnp.sum(jnp.where(m, c.data.astype(jnp.float64), 0.0))
-                    else:
-                        s = jnp.sum(jnp.where(m, c.data.astype(jnp.int64), 0))
-                    if spec.func == "avg":
-                        out.append(((s, cnt), cnt > 0))
-                    else:
-                        out.append((s, cnt > 0))
-                elif spec.func in ("min", "max"):
-                    is_min = spec.func == "min"
-                    sent = agg_ops._minmax_sentinel(c.data.dtype, is_min)
-                    vals = jnp.where(m, c.data, sent)
-                    red = jnp.min(vals) if is_min else jnp.max(vals)
-                    out.append((red, cnt > 0))
-                else:
-                    raise ValueError(spec.func)
-            return out
-
-        def merge(acc, new):
-            out = []
-            for spec, (a, av), (n, nv) in zip(aggs, acc, new):
-                if spec.func in ("count", "count_rows"):
-                    out.append((a + n, jnp.bool_(True)))
-                elif spec.func == "sum":
-                    out.append((a + n, av | nv))
-                elif spec.func == "avg":
-                    out.append(((a[0] + n[0], a[1] + n[1]), av | nv))
-                elif spec.func == "min":
-                    out.append((jnp.minimum(a, n), av | nv))
-                elif spec.func == "max":
-                    out.append((jnp.maximum(a, n), av | nv))
-            return out
-
-        self._tile_fn = jax.jit(tile_states)
-        self._merge_fn = jax.jit(merge)
+        self._tile_fn = jax.jit(
+            lambda b: agg_ops.scalar_tile_states(b, aggs, base)
+        )
+        self._merge_fn = jax.jit(
+            lambda acc, new: agg_ops.scalar_merge_states(aggs, acc, new)
+        )
         self._emitted = False
 
     def init(self):
@@ -391,29 +340,9 @@ class ScalarAggregateOp(OneInputOperator):
             st = self._tile_fn(b)
             acc = st if acc is None else self._merge_fn(acc, st)
         self._emitted = True
-        acc = list(acc) if acc is not None else None
-        cols = []
-        for spec, t in zip(self.aggs, self.output_schema.types):
-            if acc is None:
-                if spec.func in ("count", "count_rows"):
-                    d, v = jnp.zeros((1,), jnp.int64), jnp.ones((1,), jnp.bool_)
-                else:
-                    d = jnp.zeros((1,), t.dtype)
-                    v = jnp.zeros((1,), jnp.bool_)
-            else:
-                (val, valid) = acc.pop(0)  # states consumed in agg order
-                if spec.func == "avg":
-                    s, c = val
-                    base_t = self.base_schema.types[spec.col]
-                    d = s.astype(jnp.float64) / jnp.where(c > 0, c, 1).astype(jnp.float64)
-                    if base_t.family is Family.DECIMAL:
-                        d = d / (10.0**base_t.scale)
-                    d = d[None]
-                else:
-                    d = val.astype(t.dtype)[None]
-                v = jnp.asarray(valid)[None]
-            cols.append(Column(data=d, valid=v))
-        return Batch(cols=tuple(cols), mask=jnp.ones((1,), jnp.bool_))
+        return agg_ops.scalar_result_batch(
+            self.aggs, self.base_schema, self.output_schema, acc
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -860,26 +789,8 @@ class SmallGroupAggregateOp(OneInputOperator):
         self.partial_specs, _, self.final_map = partial_layout(
             base, group_cols, aggs
         )
-        # one extra code per column for NULL: every NULL combination is a
-        # distinct group, matching SQL GROUP BY
-        eff = tuple(s + 1 for s in key_sizes)
-        G = 1
-        for s in eff:
-            G *= s
-        self.G = G
-        strides = []
-        acc = 1
-        for s in reversed(eff):
-            strides.append(acc)
-            acc *= s
-        self.strides = tuple(reversed(strides))
-        names = [base.names[i] for i in group_cols]
-        types = [base.types[i] for i in group_cols]
-        for spec, fm in zip(aggs, self.final_map):
-            names.append(spec.name or spec.func)
-            types.append(FLOAT64 if fm[0] == "avg"
-                         else agg_ops.agg_output_type(spec, base))
-        self.output_schema = Schema(tuple(names), tuple(types))
+        self.G, self.strides = agg_ops.dense_layout(key_sizes)
+        self.output_schema = agg_ops.agg_output_schema(base, group_cols, aggs)
         self.dictionaries = {
             group_cols.index(gi): d
             for gi, d in child.dictionaries.items()
@@ -900,11 +811,7 @@ class SmallGroupAggregateOp(OneInputOperator):
         pspecs = self.partial_specs
 
         def tile_fn(b: Batch):
-            code = jnp.zeros((b.capacity,), jnp.int32)
-            for gi, st, size in zip(gcols, strides, sizes):
-                c = b.cols[gi]
-                ci = jnp.where(c.valid, c.data.astype(jnp.int32), size)
-                code = code + ci * st
+            code = agg_ops.dense_group_codes(b, gcols, strides, sizes)
             states, rows = agg_ops.smallgroup_partial_states(
                 b, base, code, G, pspecs
             )
@@ -918,22 +825,8 @@ class SmallGroupAggregateOp(OneInputOperator):
 
         def finalize_fn(acc):
             states, rows = acc
-            gid = jnp.arange(G, dtype=jnp.int32)
-            cols = []
-            for gi, st, size in zip(gcols, strides, sizes):
-                code_i = (gid // st) % (size + 1)
-                t = base.types[gi]
-                valid = code_i < size  # code==size means NULL key
-                cols.append(Column(
-                    data=jnp.where(valid, code_i, 0).astype(t.dtype),
-                    valid=valid,
-                ))
-            mask = rows > 0
-            for (d, v) in states:
-                cols.append(Column(data=d, valid=v & mask))
-            state_batch = Batch(cols=tuple(cols), mask=mask)
-            return agg_ops.finalize_states(
-                state_batch, self.final_map, len(gcols)
+            return agg_ops.dense_finalize(
+                base, gcols, strides, sizes, G, self.final_map, states, rows
             )
 
         self._tile_fn = jax.jit(tile_fn)

@@ -331,3 +331,191 @@ def merge_dense_states(specs: tuple[AggSpec, ...], acc, new):
         else:
             raise ValueError(spec.func)
     return out
+
+
+# ---------------------------------------------------------------------------
+# mesh reduction of positionally-aligned states (sharded -> replicated)
+
+
+def psum_dense_states(specs: tuple[AggSpec, ...], states, axis_name: str):
+    """Reduce dense states across a mesh axis with XLA collectives — the
+    all_to_all-free path for positionally-aligned layouts: sums/counts ride
+    psum, min/max ride pmin/pmax, valid flags OR via psum>0. Must run inside
+    shard_map over `axis_name`."""
+    out = []
+    for spec, (d, v) in zip(specs, states):
+        if spec.func in ("sum", "count", "count_rows"):
+            rd = jax.lax.psum(d, axis_name)
+        elif spec.func == "min":
+            rd = jax.lax.pmin(d, axis_name)
+        elif spec.func in ("max", "any_not_null"):
+            rd = jax.lax.pmax(d, axis_name)
+        elif spec.func == "avg":
+            rd = jax.tree_util.tree_map(
+                lambda x: jax.lax.psum(x, axis_name), d
+            )
+        else:
+            raise ValueError(spec.func)
+        rv = jax.lax.psum(v.astype(jnp.int32), axis_name) > 0
+        out.append((rd, rv))
+    return out
+
+
+def dense_layout(key_sizes: tuple[int, ...]) -> tuple[int, tuple[int, ...]]:
+    """(G, strides) for the dense group-code space: one extra code per key
+    column for NULL (every NULL combination is its own group, matching SQL
+    GROUP BY semantics)."""
+    eff = tuple(s + 1 for s in key_sizes)
+    G = 1
+    for s in eff:
+        G *= s
+    strides = []
+    acc = 1
+    for s in reversed(eff):
+        strides.append(acc)
+        acc *= s
+    return G, tuple(reversed(strides))
+
+
+def dense_group_codes(batch: Batch, group_cols, strides, key_sizes):
+    """Per-row dense group code from dictionary-coded key columns (NULL maps
+    to the extra per-column code)."""
+    code = jnp.zeros((batch.capacity,), jnp.int32)
+    for gi, st, size in zip(group_cols, strides, key_sizes):
+        c = batch.cols[gi]
+        ci = jnp.where(c.valid, c.data.astype(jnp.int32), size)
+        code = code + ci * st
+    return code
+
+
+def dense_finalize(base: Schema, group_cols, strides, key_sizes, G,
+                   final_map, states, rows) -> Batch:
+    """Decode dense group codes back into key columns and finalize the
+    aggregate states — shared by SmallGroupAggregateOp and the SPMD path."""
+    gid = jnp.arange(G, dtype=jnp.int32)
+    cols = []
+    for gi, st, size in zip(group_cols, strides, key_sizes):
+        code_i = (gid // st) % (size + 1)
+        t = base.types[gi]
+        valid = code_i < size  # code==size means NULL key
+        cols.append(Column(
+            data=jnp.where(valid, code_i, 0).astype(t.dtype), valid=valid,
+        ))
+    mask = rows > 0
+    for (d, v) in states:
+        cols.append(Column(data=d, valid=v & mask))
+    state_batch = Batch(cols=tuple(cols), mask=mask)
+    return finalize_states(state_batch, final_map, len(group_cols))
+
+
+# ---------------------------------------------------------------------------
+# scalar (no GROUP BY) aggregation states — shared by ScalarAggregateOp and
+# the SPMD planner's psum-merged scalar stage
+
+
+def scalar_tile_states(batch: Batch, aggs: tuple[AggSpec, ...], base: Schema):
+    """Per-tile scalar states: one (value, valid) pair per agg (avg carries
+    (sum, count))."""
+    out = []
+    for spec in aggs:
+        if spec.func == "count_rows":
+            out.append((jnp.sum(batch.mask, dtype=jnp.int64), jnp.bool_(True)))
+            continue
+        c = batch.cols[spec.col]
+        t = base.types[spec.col]
+        m = batch.mask & c.valid
+        cnt = jnp.sum(m, dtype=jnp.int64)
+        if spec.func == "count":
+            out.append((cnt, jnp.bool_(True)))
+        elif spec.func in ("sum", "avg"):
+            if t.family is Family.FLOAT or spec.func == "avg":
+                s = jnp.sum(jnp.where(m, c.data.astype(jnp.float64), 0.0))
+            else:
+                s = jnp.sum(jnp.where(m, c.data.astype(jnp.int64), 0))
+            if spec.func == "avg":
+                out.append(((s, cnt), cnt > 0))
+            else:
+                out.append((s, cnt > 0))
+        elif spec.func in ("min", "max"):
+            is_min = spec.func == "min"
+            sent = _minmax_sentinel(c.data.dtype, is_min)
+            vals = jnp.where(m, c.data, sent)
+            red = jnp.min(vals) if is_min else jnp.max(vals)
+            out.append((red, cnt > 0))
+        else:
+            raise ValueError(spec.func)
+    return out
+
+
+def scalar_merge_states(aggs: tuple[AggSpec, ...], acc, new):
+    out = []
+    for spec, (a, av), (n, nv) in zip(aggs, acc, new):
+        if spec.func in ("count", "count_rows"):
+            out.append((a + n, jnp.bool_(True)))
+        elif spec.func == "sum":
+            out.append((a + n, av | nv))
+        elif spec.func == "avg":
+            out.append(((a[0] + n[0], a[1] + n[1]), av | nv))
+        elif spec.func == "min":
+            out.append((jnp.minimum(a, n), av | nv))
+        elif spec.func == "max":
+            out.append((jnp.maximum(a, n), av | nv))
+        else:
+            raise ValueError(spec.func)
+    return out
+
+
+def scalar_result_batch(aggs: tuple[AggSpec, ...], base: Schema,
+                        out_schema: Schema, acc) -> Batch:
+    """States -> one-row result Batch (acc=None means empty input: counts
+    are 0, everything else NULL — SQL scalar aggregate semantics)."""
+    acc = list(acc) if acc is not None else None
+    cols = []
+    for spec, t in zip(aggs, out_schema.types):
+        if acc is None:
+            if spec.func in ("count", "count_rows"):
+                d, v = jnp.zeros((1,), jnp.int64), jnp.ones((1,), jnp.bool_)
+            else:
+                d = jnp.zeros((1,), t.dtype)
+                v = jnp.zeros((1,), jnp.bool_)
+        else:
+            (val, valid) = acc.pop(0)  # states consumed in agg order
+            if spec.func == "avg":
+                s, c = val
+                base_t = base.types[spec.col]
+                d = s.astype(jnp.float64) / jnp.where(
+                    c > 0, c, 1
+                ).astype(jnp.float64)
+                if base_t.family is Family.DECIMAL:
+                    d = d / (10.0**base_t.scale)
+                d = d[None]
+            else:
+                d = val.astype(t.dtype)[None]
+            v = jnp.asarray(valid)[None]
+        cols.append(Column(data=d, valid=v))
+    return Batch(cols=tuple(cols), mask=jnp.ones((1,), jnp.bool_))
+
+
+def agg_output_schema(
+    base: Schema, group_cols: tuple[int, ...], aggs: tuple[AggSpec, ...],
+    mode: str = "complete",
+) -> Schema:
+    """Output schema of an aggregation stage — the ONE place the group-key
+    + per-agg naming/typing rule lives (avg -> FLOAT64, else
+    agg_output_type), shared by the flow operators, the distribution
+    rewrite, and the SPMD lowering."""
+    _, state_schema, final_map = partial_layout(base, group_cols, aggs)
+    if mode == "partial":
+        return state_schema
+    k = len(group_cols)
+    if mode == "final":
+        names = list(state_schema.names[:k])
+        types = list(state_schema.types[:k])
+    else:
+        names = [base.names[i] for i in group_cols]
+        types = [base.types[i] for i in group_cols]
+    for spec, fm in zip(aggs, final_map):
+        names.append(spec.name or spec.func)
+        types.append(FLOAT64 if fm[0] == "avg"
+                     else agg_output_type(spec, base))
+    return Schema(tuple(names), tuple(types))

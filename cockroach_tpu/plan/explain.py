@@ -44,6 +44,10 @@ def _node_label(n: S.PlanNode) -> str:
         return f"distinct on={list(n.cols) if n.cols else 'all'}"
     if isinstance(n, S.Exchange):
         return f"exchange (all-to-all) keys={list(n.keys)}"
+    if isinstance(n, S.Broadcast):
+        return "broadcast (all-gather)"
+    if isinstance(n, S.Gather):
+        return "gather (all-gather)"
     if isinstance(n, S.MergeJoin):
         return (f"merge-join ({n.spec.join_type}) "
                 f"probe={n.probe_key} build={n.build_key}")

@@ -54,6 +54,10 @@ class Aggregate(PlanNode):
     # planner hint: every group key is a dense code of known cardinality
     # (dictionary size); enables the sort-free dense-state aggregation path
     key_sizes: tuple[int, ...] | None = None
+    # for mode="final": the schema the original aggs/group_cols were written
+    # against (the partial stage's input), needed to recompute the shared
+    # partial-state layout on the far side of an Exchange
+    base_schema: Schema | None = None
 
 
 @dataclass(frozen=True)
@@ -92,6 +96,24 @@ class Exchange(PlanNode):
 
     input: PlanNode
     keys: tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class Broadcast(PlanNode):
+    """Replicate the input on every device (all_gather over the mesh) —
+    the broadcast-join placement the reference's planner picks for small
+    build sides (PhysicalPlan mergeResultStreams to every node)."""
+
+    input: PlanNode
+
+
+@dataclass(frozen=True)
+class Gather(PlanNode):
+    """Collect all partitions onto every device (all_gather) — the
+    final-stage fan-in to the gateway node (DistSQLReceiver role) for
+    globally-ordered operators (Sort/Limit at the plan root)."""
+
+    input: PlanNode
 
 
 @dataclass(frozen=True)

@@ -268,6 +268,32 @@ class Rel:
     def run(self) -> dict[str, np.ndarray]:
         return run_plan(self.plan, self.catalog)
 
+    def run_distributed(self, mesh=None,
+                        broadcast_rows: int | None = None
+                        ) -> dict[str, np.ndarray]:
+        """Execute distributed over the device mesh: the plan is rewritten
+        with Exchange/Broadcast/Gather stages (plan/distribute.py) and
+        lowered into one SPMD program (parallel/planner.py)."""
+        from ..parallel import mesh as mesh_mod
+        from ..parallel.planner import DistributedQuery
+
+        if mesh is None:
+            mesh = mesh_mod.make_mesh()
+        return DistributedQuery(
+            self.plan, self.catalog, mesh, broadcast_rows=broadcast_rows
+        ).run()
+
+    def explain_distributed(self, broadcast_rows: int | None = None) -> str:
+        """EXPLAIN of the distributed plan (Exchange/Broadcast/Gather
+        stages visible). Pass the same broadcast_rows as run_distributed
+        to see the plan that would actually execute."""
+        from ..plan.distribute import distribute
+        from ..plan.explain import explain_plan
+
+        return explain_plan(
+            distribute(self.plan, self.catalog, broadcast_rows)
+        )
+
     def explain(self) -> str:
         from ..plan.explain import explain_plan
 
