@@ -1,0 +1,60 @@
+"""YCSB workload over the MVCC engine — BASELINE config #5 (scan-heavy E).
+
+Reference: pkg/workload/ycsb (workload E: 95% short range scans with
+zipfian-ish starts, 5% inserts). The microbench drives the engine's real
+read path — merged-view + mvcc_scan_filter on device — interleaved with
+writes, so it prices the read-after-write merge cost the LSM design pays.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..storage.lsm import Engine
+
+
+def _key(i: int) -> bytes:
+    return b"user%012d" % i
+
+
+def run_ycsb_e(
+    n_keys: int = 4096,
+    ops: int = 64,
+    scan_len: int = 64,
+    insert_frac: float = 0.05,
+    seed: int = 0,
+) -> dict:
+    """Load n_keys, then run `ops` operations (scan_len-row scans, with an
+    insert_frac share of inserts). Returns ops/sec + rows/sec."""
+    rng = np.random.default_rng(seed)
+    eng = Engine(key_width=16, val_width=16, memtable_size=4096)
+    ts = 1
+    for i in range(n_keys):
+        eng.put(_key(i), b"v%08d" % i, ts=ts)
+        ts += 1
+    eng.flush()
+    # warm the merged view + compile the scan kernel before timing
+    eng.scan(_key(0), None, ts=ts, max_keys=scan_len)
+
+    next_pk = n_keys
+    rows = 0
+    t0 = time.time()
+    for op in range(ops):
+        if rng.random() < insert_frac:
+            eng.put(_key(next_pk), b"v%08d" % next_pk, ts=ts)
+            next_pk += 1
+            ts += 1
+        else:
+            start = int(rng.integers(0, n_keys))
+            got = eng.scan(_key(start), None, ts=ts, max_keys=scan_len)
+            rows += len(got)
+    el = time.time() - t0
+    return {
+        "ops": ops,
+        "ops_per_sec": ops / el,
+        "rows_scanned": rows,
+        "rows_per_sec": rows / el if el > 0 else 0.0,
+        "elapsed_s": el,
+    }

@@ -1,0 +1,166 @@
+"""KV-backed SQL tables — the TableReader path over the MVCC engine.
+
+Reference: SQL reads flow through colfetcher's ColBatchScan -> cFetcher ->
+kv.Txn (pkg/sql/colfetcher/colbatch_scan.go:200), decoding KV pairs into
+coldata.Batch; writes encode rows and go through kv.Txn.Put. Here KVTable
+is both:
+
+- the write surface: ``insert``/``delete_pk`` run inside a kv transaction
+  (intents, refresh validation, retries — kv/txn.py), encoding rows via
+  storage/rowcodec.py;
+- the read surface: ``device_batch`` produces a columnar Batch straight
+  from the engine's device-resident merged view — mvcc_scan_filter picks
+  newest-visible versions, rowcodec.decode_columns unpacks values — the
+  "direct columnar scan" default path (pkg/storage/col_mvcc.go:25-90).
+
+KVTable quacks like catalog.Table (schema / num_rows / dict_by_index /
+device_batch), so ScanOp, the flow engine and sql() work unchanged on
+KV-backed tables. Fixed-width column families only (INT/DECIMAL/DATE/
+TIMESTAMP/INTERVAL/FLOAT/BOOL); STRING/BYTES land with the high-cardinality
+string path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..coldata.batch import Batch
+from ..coldata.types import Family, Schema
+from ..storage import rowcodec
+from ..storage.lsm import Engine, WriteIntentError
+from .txn import DB, Txn
+
+_UNSUPPORTED = (Family.STRING, Family.BYTES, Family.JSON)
+
+
+class KVTable:
+    def __init__(self, db: DB, name: str, schema: Schema, pk: str,
+                 table_id: int):
+        for t in schema.types:
+            if t.family in _UNSUPPORTED:
+                raise TypeError(
+                    f"KV tables support fixed-width columns only, got {t}"
+                )
+        self.db = db
+        self.name = name
+        self.schema = schema
+        self.pk = pk
+        self.pk_idx = schema.index(pk)
+        self.table_id = table_id
+        need = rowcodec.value_width(schema)
+        if db.engine.val_width < need:
+            raise ValueError(
+                f"engine val_width {db.engine.val_width} < row width {need}"
+            )
+        # snapshot timestamp for reads; None = now() at device_batch time
+        self.read_ts: int | None = None
+
+    # -- write surface ------------------------------------------------------
+
+    def insert(self, t: Txn, row: dict) -> None:
+        key = rowcodec.encode_pk(self.table_id, int(row[self.pk]))
+        t.put(key, rowcodec.encode_row(self.schema, row))
+
+    def delete_pk(self, t: Txn, pk: int) -> None:
+        t.delete(rowcodec.encode_pk(self.table_id, int(pk)))
+
+    def get_row(self, pk: int, ts: int | None = None) -> dict | None:
+        v = self.db.get(rowcodec.encode_pk(self.table_id, int(pk)), ts=ts)
+        return None if v is None else rowcodec.decode_row(self.schema, v)
+
+    # -- Table facade (catalog.Table duck type) ------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        """Row-count estimate used only for planning (join ordering,
+        broadcast decisions): a device-side count of newest-visible rows —
+        no host materialization, and intents don't fail planning."""
+        from ..storage import keys as K
+        from ..storage import mvcc
+
+        eng: Engine = self.db.engine
+        view = eng._merged_view()
+        if view is None:
+            return 0
+        start, end = rowcodec.table_span(self.table_id)
+        sel, _ = mvcc.mvcc_scan_filter(
+            view, jnp.int64(self.db.clock.now()), jnp.int64(0),
+            jnp.asarray(K.encode_bound(start, eng.key_width)),
+            jnp.asarray(K.encode_bound(end, eng.key_width)),
+        )
+        return int(np.asarray(jnp.sum(sel)))
+
+    def dict_by_index(self) -> dict:
+        return {}
+
+    @property
+    def dictionaries(self) -> dict:
+        return {}
+
+    @property
+    def valids(self) -> dict:
+        # nullability is data-dependent; report every column maybe-NULL
+        return {n: np.zeros(1, dtype=bool) for n in self.schema.names}
+
+    def device_batch(self, names: tuple[str, ...] | None = None) -> Batch:
+        """Columnar snapshot of the newest-visible rows, decoded on device.
+
+        One mvcc_scan_filter pass over the merged view + the rowcodec
+        decode kernel; raises WriteIntentError on another txn's intent in
+        the span, exactly like the row read path."""
+        from ..storage import keys as K
+        from ..storage import mvcc
+
+        names = names or self.schema.names
+        idxs = tuple(self.schema.index(n) for n in names)
+        ts = self.read_ts if self.read_ts is not None else self.db.clock.now()
+        eng: Engine = self.db.engine
+        view = eng._merged_view()
+        if view is None:
+            from ..coldata.batch import empty_batch
+
+            return empty_batch(self.schema.select(idxs), 1024)
+        start, end = rowcodec.table_span(self.table_id)
+        sw = K.encode_bound(start, eng.key_width)
+        ew = K.encode_bound(end, eng.key_width)
+        sel, conflict = mvcc.mvcc_scan_filter(
+            view, jnp.int64(ts), jnp.int64(0),
+            jnp.asarray(sw), jnp.asarray(ew),
+        )
+        cnp = np.asarray(conflict)
+        if cnp.any():
+            hit = np.nonzero(cnp)[0]
+            raise WriteIntentError(
+                K.decode_keys(np.asarray(view.key)[hit]),
+                [int(x) for x in np.asarray(view.txn)[hit]],
+            )
+        batch = rowcodec.decode_columns(view.value, sel,
+                                        self.schema, idxs)
+        if self.pk_idx in idxs:
+            # the PK also lives in the value payload, but decoding it from
+            # the key exercises/validates the key codec path
+            pk_col = rowcodec.decode_pk_column(view.key)
+            pos = idxs.index(self.pk_idx)
+            from ..coldata.batch import Column
+
+            cols = list(batch.cols)
+            cols[pos] = Column(data=pk_col, valid=sel)
+            batch = Batch(cols=tuple(cols), mask=batch.mask)
+        return batch
+
+
+def create_kv_table(catalog, db: DB, name: str, schema: Schema, pk: str,
+                    table_id: int | None = None) -> KVTable:
+    """Create + register a KV-backed table in the catalog so sql()/Rel
+    scans resolve to it. table_id determines the key-space prefix; ids must
+    be unique per engine or spans would overlap."""
+    used = {t.table_id for t in catalog.tables.values()
+            if isinstance(t, KVTable)}
+    if table_id is None:
+        table_id = max(used, default=0) + 1
+    elif table_id in used:
+        raise ValueError(f"table_id {table_id} already in use")
+    t = KVTable(db, name, schema, pk, table_id)
+    catalog.tables[name] = t
+    return t
