@@ -111,17 +111,20 @@ class FilterOp(OneInputOperator):
 
 class ProjectOp(OneInputOperator):
     def __init__(self, child: Operator, exprs: tuple[ex.Expr, ...],
-                 names: tuple[str, ...]):
+                 names: tuple[str, ...], dict_overrides: tuple = ()):
         super().__init__(child)
         schema = child.output_schema
         types = tuple(ex.expr_type(e, schema) for e in exprs)
         self.output_schema = Schema(tuple(names), types)
-        # dictionaries survive only through bare column references
+        # dictionaries survive through bare column references; host-side
+        # string transforms attach theirs via dict_overrides
         self.dictionaries = {
             i: self.child.dictionaries[e.idx]
             for i, e in enumerate(exprs)
             if isinstance(e, ex.ColRef) and e.idx in self.child.dictionaries
         }
+        for i, d in dict_overrides:
+            self.dictionaries[i] = d
 
         def fn(b: Batch) -> Batch:
             cols = []
@@ -646,6 +649,44 @@ class WindowOp(OneInputOperator):
         if not tiles:
             return None
         return self._fn(tuple(tiles), cap=_next_pow2(total))
+
+
+class UnionOp(Operator):
+    """UNION ALL: pull each input to exhaustion in order (the plan-level
+    unordered fan-in; inputs share one output schema)."""
+
+    def __init__(self, children_ops: tuple[Operator, ...]):
+        super().__init__()
+        assert children_ops, "UNION ALL needs at least one input"
+        self._children = list(children_ops)
+        self.output_schema = children_ops[0].output_schema
+        for c in children_ops[1:]:
+            assert len(c.output_schema) == len(self.output_schema), \
+                "UNION ALL inputs must have equal arity"
+        self.dictionaries = dict(children_ops[0].dictionaries)
+        self._cur = 0
+
+    def children(self):
+        return list(self._children)
+
+    def init(self):
+        for c in self._children:
+            c.init()
+        self._cur = 0
+        self._initialized = True
+
+    def _next(self):
+        while self._cur < len(self._children):
+            b = self._children[self._cur].next_batch()
+            if b is not None:
+                return b
+            self._cur += 1
+        return None
+
+    def close(self):
+        for c in self._children:
+            c.close()
+        super().close()
 
 
 class MergeJoinOp(OneInputOperator):

@@ -42,12 +42,17 @@ class KVTable:
                 raise TypeError(
                     f"KV tables support fixed-width columns only, got {t}"
                 )
+        if not 0 <= table_id <= rowcodec.MAX_TABLE_ID:
+            raise ValueError(
+                f"table_id must be in [0, {rowcodec.MAX_TABLE_ID}]"
+            )
         self.db = db
         self.name = name
         self.schema = schema
         self.pk = pk
         self.pk_idx = schema.index(pk)
         self.table_id = table_id
+        self._count_cache: tuple[int, int] | None = None  # (engine seq, n)
         need = rowcodec.value_width(schema)
         if db.engine.val_width < need:
             raise ValueError(
@@ -75,21 +80,27 @@ class KVTable:
     def num_rows(self) -> int:
         """Row-count estimate used only for planning (join ordering,
         broadcast decisions): a device-side count of newest-visible rows —
-        no host materialization, and intents don't fail planning."""
+        no host materialization, and intents don't fail planning. Cached
+        per engine write sequence so repeated binds don't re-scan."""
         from ..storage import keys as K
         from ..storage import mvcc
 
         eng: Engine = self.db.engine
+        if self._count_cache is not None and self._count_cache[0] == eng._seq:
+            return self._count_cache[1]
         view = eng._merged_view()
         if view is None:
-            return 0
-        start, end = rowcodec.table_span(self.table_id)
-        sel, _ = mvcc.mvcc_scan_filter(
-            view, jnp.int64(self.db.clock.now()), jnp.int64(0),
-            jnp.asarray(K.encode_bound(start, eng.key_width)),
-            jnp.asarray(K.encode_bound(end, eng.key_width)),
-        )
-        return int(np.asarray(jnp.sum(sel)))
+            n = 0
+        else:
+            start, end = rowcodec.table_span(self.table_id)
+            sel, _ = mvcc.mvcc_scan_filter(
+                view, jnp.int64(self.db.clock.now()), jnp.int64(0),
+                jnp.asarray(K.encode_bound(start, eng.key_width)),
+                jnp.asarray(K.encode_bound(end, eng.key_width)),
+            )
+            n = int(np.asarray(jnp.sum(sel)))
+        self._count_cache = (eng._seq, n)
+        return n
 
     def dict_by_index(self) -> dict:
         return {}

@@ -182,8 +182,10 @@ def eval_expr(e: Expr, cols, schema: Schema):
     if isinstance(e, Const):
         n = cols[0].data.shape[0]
         if e.value is None:
+            from ..coldata.types import zeros_like_type
+
             return (
-                jnp.zeros((n,), e.type.dtype),
+                zeros_like_type(e.type, n),  # BYTES needs [n, W]
                 jnp.zeros((n,), jnp.bool_),
             )
         v = e.value

@@ -153,6 +153,8 @@ class _Lowering:
             for i, e in enumerate(plan.exprs)
             if isinstance(e, ex.ColRef) and e.idx in ln.dicts
         }
+        for i, d in plan.dict_overrides:
+            dicts[i] = d
         inner = ln.emit
 
         def emit(env):
@@ -445,6 +447,21 @@ class _Lowering:
             return sort_ops.limit_mask(inner(env), limit, offset)
 
         return _LNode(emit, ln.schema, ln.dicts, ln.replicated, ln.cap)
+
+    def _lower_union(self, plan: S.Union) -> _LNode:
+        from ..coldata.batch import concat
+
+        lns = [self.lower(p) for p in plan.inputs]
+        assert all(ln.replicated == lns[0].replicated for ln in lns), \
+            "distribute() must make Union children uniformly placed"
+        cap = _pow2(sum(ln.cap for ln in lns))
+        emits = [ln.emit for ln in lns]
+
+        def emit(env):
+            return concat([e(env) for e in emits], capacity=cap)
+
+        return _LNode(emit, lns[0].schema, dict(lns[0].dicts),
+                      lns[0].replicated, cap)
 
     def _lower_window(self, plan: S.Window) -> _LNode:
         from ..ops import window as win_ops

@@ -21,7 +21,8 @@ def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
     if isinstance(plan, S.Filter):
         return ops.FilterOp(build(plan.input, catalog), plan.predicate)
     if isinstance(plan, S.Project):
-        return ops.ProjectOp(build(plan.input, catalog), plan.exprs, plan.names)
+        return ops.ProjectOp(build(plan.input, catalog), plan.exprs,
+                             plan.names, plan.dict_overrides)
     if isinstance(plan, S.Aggregate):
         child = build(plan.input, catalog)
         if plan.key_sizes is not None and plan.mode == "complete":
@@ -58,6 +59,8 @@ def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
             plan.build_keys,
             plan.spec,
         )
+    if isinstance(plan, S.Union):
+        return ops.UnionOp(tuple(build(p, catalog) for p in plan.inputs))
     if isinstance(plan, S.Exchange):
         # single-device build: the shuffle is the identity; the multi-device
         # path lives in parallel/shuffle.py and is planned by parallel/dist.py

@@ -35,6 +35,8 @@ def estimated_rows(plan: S.PlanNode, catalog: Catalog) -> int:
     if isinstance(plan, S.Limit):
         return min(plan.limit + plan.offset,
                    estimated_rows(plan.input, catalog))
+    if isinstance(plan, S.Union):
+        return sum(estimated_rows(k, catalog) for k in plan.inputs)
     if hasattr(plan, "input"):
         return estimated_rows(plan.input, catalog)
     return 1 << 30
@@ -148,6 +150,16 @@ def _rewrite(plan, catalog, broadcast_rows):
         return S.Window(S.Gather(child), plan.partition_cols,
                         plan.order_keys, plan.specs), True
 
+    if isinstance(plan, S.Union):
+        kids = [_rewrite(k, catalog, broadcast_rows) for k in plan.inputs]
+        if all(rep for _, rep in kids):
+            return S.Union(tuple(k for k, _ in kids)), True
+        if any(rep for _, rep in kids):
+            # mixing a replicated child with sharded ones would duplicate
+            # its rows D times; gather everything instead
+            return S.Union(tuple(_gather(k, rep) for k, rep in kids)), True
+        return S.Union(tuple(k for k, _ in kids)), False
+
     if isinstance(plan, (S.Exchange, S.Broadcast, S.Gather)):
         raise TypeError(f"plan already distributed: {type(plan).__name__}")
 
@@ -158,7 +170,7 @@ def _rest_fields(plan):
     """Positional fields after `input` for Filter/Project reconstruction."""
     if isinstance(plan, S.Filter):
         return (plan.predicate,)
-    return (plan.exprs, plan.names)
+    return (plan.exprs, plan.names, plan.dict_overrides)
 
 
 def _schema_of(plan: S.PlanNode, catalog: Catalog):
@@ -177,6 +189,8 @@ def _schema_of(plan: S.PlanNode, catalog: Catalog):
     if isinstance(plan, (S.Filter, S.Sort, S.Limit,
                          S.Exchange, S.Broadcast, S.Gather)):
         return _schema_of(plan.input, catalog)
+    if isinstance(plan, S.Union):
+        return _schema_of(plan.inputs[0], catalog)
     if isinstance(plan, S.Project):
         base = _schema_of(plan.input, catalog)
         return Schema(tuple(plan.names),

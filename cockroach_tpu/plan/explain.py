@@ -44,6 +44,8 @@ def _node_label(n: S.PlanNode) -> str:
         return f"distinct on={list(n.cols) if n.cols else 'all'}"
     if isinstance(n, S.Exchange):
         return f"exchange (all-to-all) keys={list(n.keys)}"
+    if isinstance(n, S.Union):
+        return f"union-all ({len(n.inputs)} inputs)"
     if isinstance(n, S.Broadcast):
         return "broadcast (all-gather)"
     if isinstance(n, S.Gather):
@@ -61,6 +63,8 @@ def _node_label(n: S.PlanNode) -> str:
 def _children(n: S.PlanNode) -> list[S.PlanNode]:
     if isinstance(n, (S.HashJoin, S.MergeJoin)):
         return [n.probe, n.build]
+    if isinstance(n, S.Union):
+        return list(n.inputs)
     if hasattr(n, "input"):
         return [n.input]
     return []

@@ -41,6 +41,9 @@ class Project(PlanNode):
     input: PlanNode
     exprs: tuple[Expr, ...]
     names: tuple[str, ...]
+    # (output index, Dictionary) pairs for STRING outputs whose dictionary
+    # the expr machinery cannot infer (e.g. host-side string transforms)
+    dict_overrides: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -134,6 +137,14 @@ class Window(PlanNode):
     partition_cols: tuple[int, ...]
     order_keys: tuple[SortKey, ...]
     specs: tuple = ()
+
+
+@dataclass(frozen=True)
+class Union(PlanNode):
+    """UNION ALL: concatenation of same-schema inputs (execinfrapb's
+    unordered synchronizer fan-in role for plan-level unions)."""
+
+    inputs: tuple[PlanNode, ...]
 
 
 @dataclass(frozen=True)

@@ -84,6 +84,38 @@ class Rel:
                "ge": operator.ge}
         return self.str_pred(col, lambda s: fns[op](s, value))
 
+    def str_transform(self, col: str,
+                      fn: Callable[[str], str]) -> tuple[ex.Expr, Dictionary]:
+        """String-valued function of a STRING column (SUBSTRING etc.),
+        evaluated per dictionary entry on the host: returns a STRING
+        expression (a code-remap gather on device) plus the transformed
+        values' Dictionary — attach it when projecting (see with_dict)."""
+        from ..coldata.types import STRING
+
+        i = self.idx(col)
+        d = self.dicts[i]
+        mapped = np.array([fn(str(v)) for v in d.values], dtype=object)
+        uvals, codes = (np.unique(mapped.astype(str), return_inverse=True)
+                        if len(mapped) else (np.array([], dtype=object),
+                                             np.zeros(0, np.int32)))
+        table = codes.astype(np.int32) if len(codes) else np.zeros(1, np.int32)
+        return (ex.CodeLookup(col=i, table=table, out_type=STRING),
+                Dictionary(uvals.astype(object)))
+
+    def with_dict(self, col: str, d: Dictionary) -> "Rel":
+        """Attach a dictionary to a STRING output column (for columns whose
+        dictionary the projection machinery cannot infer, e.g. outputs of
+        str_transform). Must directly follow a project(); the override is
+        recorded on the Project plan node so the operator layer sees it."""
+        i = self.idx(col)
+        if not isinstance(self.plan, S.Project):
+            raise TypeError("with_dict must follow a project()")
+        plan = S.Project(self.plan.input, self.plan.exprs, self.plan.names,
+                         self.plan.dict_overrides + ((i, d),))
+        out = Rel(self.catalog, plan, self.schema, dict(self.dicts))
+        out.dicts[i] = d
+        return out
+
     # -- relational operators ----------------------------------------------
 
     @staticmethod
@@ -249,6 +281,29 @@ class Rel:
 
     def join(self, build: "Rel", on: list[tuple[str, str]],
              how: str = "inner", build_unique: bool = True) -> "Rel":
+        """inner | left | right | full | semi | anti. Right and full outer
+        compose from the primitive kernels the way the reference's hash
+        joiner emits unmatched build rows after the probe stream
+        (hashjoiner.go emitUnmatched): the matched part (inner for right,
+        left-outer for full) UNION ALL the build-side anti join against the
+        probe, null-extended over the probe columns."""
+        if how in ("right", "full"):
+            matched = self.join(build, on,
+                                how="inner" if how == "right" else "left",
+                                build_unique=build_unique)
+            rev = [(b, p) for (p, b) in on]
+            unmatched = build.join(self, on=rev, how="anti",
+                                   build_unique=False)
+            exprs = tuple(ex.Const(None, t) for t in self.schema.types)
+            exprs = exprs + tuple(ex.ColRef(i)
+                                  for i in range(len(build.schema)))
+            names = self.schema.names + build.schema.names
+            off = len(self.schema)
+            overrides = tuple((off + i, d) for i, d in build.dicts.items())
+            node = S.Project(unmatched.plan, exprs, names, overrides)
+            ne = Rel(self.catalog, node, matched.schema,
+                     {off + i: d for i, d in build.dicts.items()})
+            return matched.union_all(ne)
         pkeys = tuple(self.idx(l) for l, _ in on)
         bkeys = tuple(build.idx(r) for _, r in on)
         spec = join_ops.JoinSpec(how, build_unique)
@@ -262,6 +317,48 @@ class Rel:
             for i, d in build.dicts.items():
                 dicts[off + i] = d
         return Rel(self.catalog, node, schema, dicts)
+
+    def union_all(self, other: "Rel") -> "Rel":
+        """UNION ALL (bag semantics, like the reference's unordered
+        synchronizer over same-schema streams)."""
+        if len(self.schema) != len(other.schema):
+            raise ValueError("UNION ALL inputs must have equal arity")
+        for i, (lt, rt) in enumerate(zip(self.schema.types,
+                                         other.schema.types)):
+            if lt.family is not rt.family:
+                raise ValueError(
+                    f"UNION ALL column {i}: {lt} vs {rt} (type families "
+                    "must match)"
+                )
+        for i in set(self.dicts) & set(other.dicts):
+            if self.dicts.get(i) is not other.dicts.get(i):
+                raise ValueError(
+                    "UNION ALL over STRING columns requires a shared "
+                    "dictionary (codes are dictionary-relative)"
+                )
+        # a column with a dictionary on only ONE side is allowed solely for
+        # all-NULL arms (e.g. outer joins' null-extended side); non-NULL
+        # codes from the dict-less side would decode through the wrong
+        # dictionary
+        node = S.Union((self.plan, other.plan))
+        return Rel(self.catalog, node, self.schema, dict(self.dicts))
+
+    def cross_join(self, build: "Rel") -> "Rel":
+        """Cross join via a constant join key (every probe row matches the
+        single-key build side; the general-duplicate join emits the full
+        product — crossJoiner role, sized for small build sides)."""
+        lk = self.project(
+            [(n, self.c(n)) for n in self.schema.names] + [("__k", ex.lit(1))]
+        )
+        rk = build.project(
+            [(n, build.c(n)) for n in build.schema.names]
+            + [("__k", ex.lit(1))]
+        )
+        j = lk.join(rk, on=[("__k", "__k")], how="inner", build_unique=False)
+        np_, nb = len(self.schema), len(build.schema)
+        keep = list(range(np_)) + list(range(np_ + 1, np_ + 1 + nb))
+        items = [(j.schema.names[i], ex.ColRef(i)) for i in keep]
+        return j.project(items)
 
     # -- execution ----------------------------------------------------------
 

@@ -33,9 +33,12 @@ KEY_BYTES = 1 + PK_BYTES
 # -- host-side encode (write path: rows arrive one at a time via kv.Txn) ----
 
 
+MAX_TABLE_ID = 0xFD  # 0xFE would make table_span's end bound overflow a byte
+
+
 def encode_pk(table_id: int, pk: int) -> bytes:
     """Order-preserving, NUL-free key for (table, int64 primary key)."""
-    assert 0 <= table_id <= 0xFE
+    assert 0 <= table_id <= MAX_TABLE_ID
     u = (int(pk) & 0xFFFFFFFFFFFFFFFF) ^ (1 << 63)  # signed -> unsigned order
     out = bytearray([0x01 + table_id])
     for i in range(PK_BYTES - 1, -1, -1):
@@ -45,6 +48,7 @@ def encode_pk(table_id: int, pk: int) -> bytes:
 
 def table_span(table_id: int) -> tuple[bytes, bytes]:
     """[start, end) covering every key of the table."""
+    assert 0 <= table_id <= MAX_TABLE_ID
     return bytes([0x01 + table_id]), bytes([0x02 + table_id])
 
 

@@ -163,3 +163,121 @@ def test_string_key_join_cross_dictionary(rng):
     res = cd.to_host(out, pschema.concat(bschema), dictionaries={0: d1})
     got = sorted(zip(res["s"], res["bv"]))
     assert got == [("a", 200), ("c", 100)]
+
+
+# ---------------------------------------------------------------------------
+# round 2: right/full outer, cross join, UNION ALL
+
+
+@pytest.fixture(scope="module")
+def outer_cat():
+    import cockroach_tpu.catalog as catalog_mod
+    from cockroach_tpu.coldata.types import INT64, STRING, Schema
+
+    c = catalog_mod.Catalog()
+    c.add(catalog_mod.Table.from_strings(
+        "l", Schema.of(lk=INT64, lv=INT64, ls=STRING),
+        {"lk": np.array([1, 2, 2, 3, 5]),
+         "lv": np.array([10, 20, 21, 30, 50]),
+         "ls": np.array(["a", "b", "b", "c", "e"], dtype=object)},
+    ))
+    c.add(catalog_mod.Table.from_strings(
+        "r", Schema.of(rk=INT64, rv=INT64, rs=STRING),
+        {"rk": np.array([2, 3, 3, 4]),
+         "rv": np.array([200, 300, 301, 400]),
+         "rs": np.array(["x", "y", "y", "z"], dtype=object)},
+    ))
+    return c
+
+
+def _pd(cat, name):
+    from cockroach_tpu.coldata.batch import to_host
+    import pandas as pd
+
+    t = cat.get(name)
+    b = t.device_batch()
+    return pd.DataFrame(to_host(b, t.schema, t.dict_by_index()))
+
+
+def test_right_outer_join(outer_cat):
+    from cockroach_tpu.sql.rel import Rel
+
+    l = Rel.scan(outer_cat, "l")
+    r = Rel.scan(outer_cat, "r")
+    res = l.join(r, on=[("lk", "rk")], how="right",
+                 build_unique=False).run()
+    want = _pd(outer_cat, "l").merge(
+        _pd(outer_cat, "r"), left_on="lk", right_on="rk", how="right")
+    assert len(res["rk"]) == len(want)
+    got = sorted(zip(res["rv"], [x if x is not None else -1
+                                 for x in res["lv"]]))
+    exp = sorted(zip(want.rv, want.lv.fillna(-1).astype(int)))
+    assert got == exp
+    # null-extended probe STRING decodes to None
+    nulls = [s for v, s in zip(res["lv"], res["ls"]) if v is None]
+    assert nulls and all(s is None for s in nulls)
+
+
+def test_full_outer_join(outer_cat):
+    from cockroach_tpu.sql.rel import Rel
+
+    l = Rel.scan(outer_cat, "l")
+    r = Rel.scan(outer_cat, "r")
+    res = l.join(r, on=[("lk", "rk")], how="full",
+                 build_unique=False).run()
+    want = _pd(outer_cat, "l").merge(
+        _pd(outer_cat, "r"), left_on="lk", right_on="rk", how="outer")
+    assert len(res["lk"]) == len(want)
+    got = sorted(((-1 if a is None else a), (-1 if b is None else b))
+                 for a, b in zip(res["lv"], res["rv"]))
+    exp = sorted(zip(want.lv.fillna(-1).astype(int),
+                     want.rv.fillna(-1).astype(int)))
+    assert got == exp
+
+
+def test_cross_join(outer_cat):
+    from cockroach_tpu.sql.rel import Rel
+
+    l = Rel.scan(outer_cat, "l", ("lk", "lv"))
+    r = Rel.scan(outer_cat, "r", ("rk", "rs"))
+    res = l.cross_join(r).run()
+    assert len(res["lk"]) == 5 * 4
+    got = sorted(zip(res["lv"], res["rk"]))
+    exp = sorted((lv, rk) for lv in [10, 20, 21, 30, 50]
+                 for rk in [2, 3, 3, 4])
+    assert got == exp
+    assert set(res["rs"]) == {"x", "y", "z"}  # dict decodes across product
+
+
+def test_union_all(outer_cat):
+    from cockroach_tpu.sql.rel import Rel
+
+    from cockroach_tpu.ops import expr as ex
+
+    l = Rel.scan(outer_cat, "l", ("lk", "lv"))
+    u = l.union_all(l.filter(
+        ex.Cmp("gt", l.c("lv"), l.c("lv"))))  # empty second arm
+    res = u.run()
+    assert sorted(res["lk"]) == [1, 2, 2, 3, 5]
+    u2 = l.union_all(l)
+    assert sorted(u2.run()["lv"]) == sorted([10, 20, 21, 30, 50] * 2)
+    # arity mismatch rejected
+    with pytest.raises(ValueError):
+        l.union_all(Rel.scan(outer_cat, "r"))
+
+
+def test_right_full_joins_distributed(outer_cat):
+    from cockroach_tpu.parallel import mesh as mesh_mod
+    from cockroach_tpu.sql.rel import Rel
+
+    mesh = mesh_mod.make_mesh(8)
+    l = Rel.scan(outer_cat, "l", ("lk", "lv"))
+    r = Rel.scan(outer_cat, "r", ("rk", "rv"))
+    for how in ("right", "full"):
+        rel = l.join(r, on=[("lk", "rk")], how=how, build_unique=False)
+        got = rel.run_distributed(mesh, broadcast_rows=0)
+        want = rel.run()
+        key = lambda d: sorted(
+            ((-1 if a is None else a), (-1 if b is None else b))
+            for a, b in zip(d["lv"], d["rv"]))
+        assert key(got) == key(want), how

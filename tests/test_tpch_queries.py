@@ -245,3 +245,263 @@ def test_q5(cat, dfs):
     assert len(res["n_name"]) == len(want)
     np.testing.assert_array_equal(res["n_name"], want.n_name)
     np.testing.assert_allclose(res["revenue"], want.revenue, rtol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# round 2: the remaining 12 queries
+
+
+def test_q2(cat, dfs):
+    res = Q.q2(cat).run()
+    p, s, ps, n, r = (dfs["part"], dfs["supplier"], dfs["partsupp"],
+                      dfs["nation"], dfs["region"])
+    eu = n[n.n_regionkey.isin(r[r.r_name == "EUROPE"].r_regionkey)]
+    es = s[s.s_nationkey.isin(eu.n_nationkey)]
+    eps = ps.merge(es, left_on="ps_suppkey", right_on="s_suppkey")
+    mi = eps.groupby("ps_partkey").ps_supplycost.min().rename("min_cost")
+    pf = p[(p.p_size == 15) & p.p_type.str.endswith("BRASS")]
+    j = (eps.merge(pf, left_on="ps_partkey", right_on="p_partkey")
+         .merge(mi, left_on="ps_partkey", right_index=True))
+    j = j[j.ps_supplycost == j.min_cost].merge(
+        eu[["n_nationkey", "n_name"]], left_on="s_nationkey",
+        right_on="n_nationkey")
+    want = j.sort_values(
+        ["s_acctbal", "n_name", "s_name", "p_partkey"],
+        ascending=[False, True, True, True]).head(100)
+    np.testing.assert_array_equal(res["p_partkey"], want.p_partkey)
+    np.testing.assert_allclose(res["s_acctbal"], want.s_acctbal, rtol=1e-9)
+    np.testing.assert_array_equal(res["n_name"], want.n_name)
+    np.testing.assert_array_equal(res["s_name"], want.s_name)
+
+
+def test_q7(cat, dfs):
+    res = Q.q7(cat).run()
+    li, o, c, s, n = (dfs["lineitem"], dfs["orders"], dfs["customer"],
+                      dfs["supplier"], dfs["nation"])
+    f = li[(li.l_shipdate >= tpch.d("1995-01-01"))
+           & (li.l_shipdate <= tpch.d("1996-12-31"))]
+    j = (f.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(n.rename(columns={"n_nationkey": "k1", "n_name": "supp_nation"})
+                [["k1", "supp_nation"]], left_on="s_nationkey", right_on="k1")
+         .merge(n.rename(columns={"n_nationkey": "k2", "n_name": "cust_nation"})
+                [["k2", "cust_nation"]], left_on="c_nationkey", right_on="k2"))
+    j = j[((j.supp_nation == "FRANCE") & (j.cust_nation == "GERMANY"))
+          | ((j.supp_nation == "GERMANY") & (j.cust_nation == "FRANCE"))]
+    j = j.copy()
+    j["l_year"] = pd.to_datetime(j.l_shipdate, unit="D").dt.year
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    want = (j.groupby(["supp_nation", "cust_nation", "l_year"])
+            .agg(revenue=("volume", "sum")).reset_index()
+            .sort_values(["supp_nation", "cust_nation", "l_year"]))
+    assert len(res["revenue"]) == len(want)
+    np.testing.assert_array_equal(res["supp_nation"], want.supp_nation)
+    np.testing.assert_array_equal(res["cust_nation"], want.cust_nation)
+    np.testing.assert_array_equal(res["l_year"], want.l_year)
+    np.testing.assert_allclose(res["revenue"], want.revenue, rtol=1e-9)
+
+
+def test_q8(cat, dfs):
+    res = Q.q8(cat).run()
+    li, o, c, s, n, r, p = (dfs["lineitem"], dfs["orders"], dfs["customer"],
+                            dfs["supplier"], dfs["nation"], dfs["region"],
+                            dfs["part"])
+    pf = p[p.p_type == "ECONOMY ANODIZED STEEL"]
+    of = o[(o.o_orderdate >= tpch.d("1995-01-01"))
+           & (o.o_orderdate <= tpch.d("1996-12-31"))]
+    am = n[n.n_regionkey.isin(r[r.r_name == "AMERICA"].r_regionkey)]
+    j = (li[li.l_partkey.isin(pf.p_partkey)]
+         .merge(of, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey"))
+    j = j[j.c_nationkey.isin(am.n_nationkey)]
+    j = (j.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(n.rename(columns={"n_nationkey": "k2", "n_name": "nation"})
+                [["k2", "nation"]], left_on="s_nationkey", right_on="k2"))
+    j = j.copy()
+    j["o_year"] = pd.to_datetime(j.o_orderdate, unit="D").dt.year
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    j["nv"] = np.where(j.nation == "BRAZIL", j.volume, 0.0)
+    want = (j.groupby("o_year")
+            .agg(nat=("nv", "sum"), total=("volume", "sum")).reset_index()
+            .sort_values("o_year"))
+    want["mkt_share"] = want.nat / want.total
+    assert len(res["o_year"]) == len(want)
+    np.testing.assert_array_equal(res["o_year"], want.o_year)
+    np.testing.assert_allclose(res["mkt_share"], want.mkt_share, rtol=1e-9)
+
+
+def test_q11(cat, dfs):
+    res = Q.q11(cat).run()
+    ps, s, n = dfs["partsupp"], dfs["supplier"], dfs["nation"]
+    sg = s[s.s_nationkey.isin(n[n.n_name == "GERMANY"].n_nationkey)]
+    f = ps[ps.ps_suppkey.isin(sg.s_suppkey)].copy()
+    f["value"] = f.ps_supplycost * f.ps_availqty
+    per = f.groupby("ps_partkey").value.sum()
+    thr = f.value.sum() * 0.0001
+    want = per[per > thr].sort_values(ascending=False)
+    assert len(res["ps_partkey"]) == len(want)
+    np.testing.assert_array_equal(res["ps_partkey"], want.index.to_numpy())
+    np.testing.assert_allclose(res["value"], want.to_numpy(), rtol=1e-9)
+
+
+def test_q13(cat, dfs):
+    res = Q.q13(cat).run()
+    c, o = dfs["customer"], dfs["orders"]
+    of = o[~o.o_comment.str.match(".*special.*requests.*", na=False)]
+    j = c.merge(of, left_on="c_custkey", right_on="o_custkey", how="left")
+    counts = j.groupby("c_custkey").o_orderkey.count()
+    want = (counts.value_counts().rename("custdist").reset_index()
+            .rename(columns={"o_orderkey": "c_count", "index": "c_count"})
+            .sort_values(["custdist", "c_count"], ascending=[False, False]))
+    assert len(res["c_count"]) == len(want)
+    np.testing.assert_array_equal(res["c_count"], want.c_count)
+    np.testing.assert_array_equal(res["custdist"], want.custdist)
+
+
+def test_q15(cat, dfs):
+    res = Q.q15(cat).run()
+    li, s = dfs["lineitem"], dfs["supplier"]
+    f = li[(li.l_shipdate >= tpch.d("1996-01-01"))
+           & (li.l_shipdate < tpch.d("1996-01-01") + 90)].copy()
+    f["rev"] = f.l_extendedprice * (1 - f.l_discount)
+    rev = f.groupby("l_suppkey").rev.sum()
+    # decimal-exact max: engine sums scaled ints; round to cents like it does
+    revc = rev.round(4)
+    mrev = revc.max()
+    top = revc[revc == mrev]
+    want = s[s.s_suppkey.isin(top.index)].sort_values("s_suppkey")
+    assert len(res["s_suppkey"]) == len(want)
+    np.testing.assert_array_equal(res["s_suppkey"], want.s_suppkey)
+    np.testing.assert_array_equal(res["s_name"], want.s_name)
+    np.testing.assert_allclose(
+        res["total_revenue"],
+        revc[want.s_suppkey].to_numpy(), rtol=1e-9)
+
+
+def test_q16(cat, dfs):
+    res = Q.q16(cat).run()
+    p, ps, s = dfs["part"], dfs["partsupp"], dfs["supplier"]
+    pf = p[(p.p_brand != "Brand#45")
+           & ~p.p_type.str.startswith("MEDIUM POLISHED")
+           & p.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])]
+    bad = s[s.s_comment.str.match(".*Customer.*Complaints.*", na=False)]
+    j = ps[~ps.ps_suppkey.isin(bad.s_suppkey)].merge(
+        pf, left_on="ps_partkey", right_on="p_partkey")
+    want = (j.groupby(["p_brand", "p_type", "p_size"])
+            .ps_suppkey.nunique().rename("supplier_cnt").reset_index()
+            .sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                         ascending=[False, True, True, True]))
+    assert len(res["p_brand"]) == len(want)
+    np.testing.assert_array_equal(res["p_brand"], want.p_brand)
+    np.testing.assert_array_equal(res["p_type"], want.p_type)
+    np.testing.assert_array_equal(res["p_size"], want.p_size)
+    np.testing.assert_array_equal(res["supplier_cnt"], want.supplier_cnt)
+
+
+def test_q17(cat, dfs):
+    res = Q.q17(cat).run()
+    li, p = dfs["lineitem"], dfs["part"]
+    pf = p[(p.p_brand == "Brand#23") & (p.p_container == "MED BOX")]
+    f = li[li.l_partkey.isin(pf.p_partkey)]
+    avg = f.groupby("l_partkey").l_quantity.mean()
+    j = f.merge(avg.rename("avg_q"), left_on="l_partkey", right_index=True)
+    j = j[j.l_quantity < 0.2 * j.avg_q]
+    want = j.l_extendedprice.sum() / 7.0
+    np.testing.assert_allclose(float(res["avg_yearly"][0]), want, rtol=1e-9)
+
+
+def test_q19(cat, dfs):
+    li, p = dfs["lineitem"], dfs["part"]
+    f = li[li.l_shipmode.isin(["AIR", "AIR REG"])
+           & (li.l_shipinstruct == "DELIVER IN PERSON")]
+    j = f.merge(p, left_on="l_partkey", right_on="p_partkey")
+
+    def br(b, conts, qlo, qhi, smax):
+        return ((j.p_brand == b) & j.p_container.isin(conts)
+                & (j.l_quantity >= qlo) & (j.l_quantity <= qhi)
+                & (j.p_size >= 1) & (j.p_size <= smax))
+
+    # spec params select zero rows at this tiny scale: SQL SUM over the
+    # empty set is NULL (not 0)
+    res0 = Q.q19(cat).run()
+    assert res0["revenue"][0] is None
+    # widened quantity windows + sizes exercise the real disjunction
+    res = Q.q19(cat, qty1=0, qty2=0, qty3=0, width=50,
+                sizes=(50, 50, 50)).run()
+    k = j[br("Brand#12", ["SM CASE", "SM BOX", "SM PACK", "SM PKG"],
+             0, 50, 50)
+          | br("Brand#23", ["MED BAG", "MED BOX", "MED PKG", "MED PACK"],
+               0, 50, 50)
+          | br("Brand#34", ["LG CASE", "LG BOX", "LG PACK", "LG PKG"],
+               0, 50, 50)]
+    assert len(k) > 0
+    want = (k.l_extendedprice * (1 - k.l_discount)).sum()
+    np.testing.assert_allclose(float(res["revenue"][0]), want, rtol=1e-9)
+
+
+def test_q20(cat, dfs):
+    res = Q.q20(cat).run()
+    p, li, ps, s, n = (dfs["part"], dfs["lineitem"], dfs["partsupp"],
+                       dfs["supplier"], dfs["nation"])
+    pf = p[p.p_name.str.startswith("forest")]
+    f = li[(li.l_shipdate >= tpch.d("1994-01-01"))
+           & (li.l_shipdate < tpch.d("1994-01-01") + 365)
+           & li.l_partkey.isin(pf.p_partkey)]
+    sums = f.groupby(["l_partkey", "l_suppkey"]).l_quantity.sum()
+    psf = ps[ps.ps_partkey.isin(pf.p_partkey)].merge(
+        sums.rename("q").reset_index(),
+        left_on=["ps_partkey", "ps_suppkey"],
+        right_on=["l_partkey", "l_suppkey"])
+    good = psf[psf.ps_availqty > 0.5 * psf.q].ps_suppkey.unique()
+    ca = n[n.n_name == "CANADA"].n_nationkey
+    want = (s[s.s_nationkey.isin(ca) & s.s_suppkey.isin(good)]
+            .sort_values("s_name"))
+    assert len(res["s_name"]) == len(want)
+    np.testing.assert_array_equal(res["s_name"], want.s_name)
+    np.testing.assert_array_equal(res["s_address"], want.s_address)
+
+
+def test_q21(cat, dfs):
+    res = Q.q21(cat).run()
+    li, o, s, n = (dfs["lineitem"], dfs["orders"], dfs["supplier"],
+                   dfs["nation"])
+    n_supp = li.groupby("l_orderkey").l_suppkey.nunique()
+    late = li[li.l_receiptdate > li.l_commitdate]
+    n_late = late.groupby("l_orderkey").l_suppkey.nunique()
+    sa = s[s.s_nationkey.isin(n[n.n_name == "SAUDI ARABIA"].n_nationkey)]
+    fo = o[o.o_orderstatus == "F"]
+    l1 = late[late.l_orderkey.isin(fo.o_orderkey)
+              & late.l_suppkey.isin(sa.s_suppkey)]
+    l1 = l1.merge(n_supp.rename("ns"), left_on="l_orderkey",
+                  right_index=True)
+    l1 = l1.merge(n_late.rename("nl"), left_on="l_orderkey",
+                  right_index=True)
+    l1 = l1[(l1.ns >= 2) & (l1.nl == 1)]
+    l1 = l1.merge(sa[["s_suppkey", "s_name"]], left_on="l_suppkey",
+                  right_on="s_suppkey")
+    want = (l1.groupby("s_name").size().rename("numwait").reset_index()
+            .sort_values(["numwait", "s_name"], ascending=[False, True])
+            .head(100))
+    assert len(res["s_name"]) == len(want)
+    np.testing.assert_array_equal(res["s_name"], want.s_name)
+    np.testing.assert_array_equal(res["numwait"], want.numwait)
+
+
+def test_q22(cat, dfs):
+    res = Q.q22(cat).run()
+    c, o = dfs["customer"], dfs["orders"]
+    codes = ("13", "31", "23", "29", "30", "18", "17")
+    f = c[c.c_phone.str[:2].isin(codes)].copy()
+    f["cntrycode"] = f.c_phone.str[:2]
+    avg = f[f.c_acctbal > 0].c_acctbal.mean()
+    k = f[(f.c_acctbal > avg) & ~f.c_custkey.isin(o.o_custkey)]
+    want = (k.groupby("cntrycode")
+            .agg(numcust=("c_custkey", "size"),
+                 totacctbal=("c_acctbal", "sum")).reset_index()
+            .sort_values("cntrycode"))
+    assert len(res["cntrycode"]) == len(want)
+    np.testing.assert_array_equal(res["cntrycode"], want.cntrycode)
+    np.testing.assert_array_equal(res["numcust"], want.numcust)
+    np.testing.assert_allclose(res["totacctbal"], want.totacctbal,
+                               rtol=1e-9)
