@@ -48,12 +48,15 @@ def model_scan(read_ts, lo=None, hi=None):
     return out
 
 
+eng.flush()  # quiesce: scans then reuse the cached runs view
+print(f"flushed; runs={len(eng.runs)}", flush=True)
 for read_ts in (N, N // 2, N // 10, 1):
     t0 = time.time()
     got = eng.scan(None, None, ts=read_ts)
     want = model_scan(read_ts)
     assert got == want, f"scan@{read_ts}: {len(got)} vs {len(want)} rows"
-    print(f"scan@{read_ts}: {len(got)} rows OK in {time.time()-t0:.1f}s")
+    print(f"scan@{read_ts}: {len(got)} rows OK in {time.time()-t0:.1f}s",
+          flush=True)
 
 # bounded scan + point gets
 got = eng.scan(b"user01000", b"user02000", ts=N)

@@ -43,8 +43,17 @@ def _next_pow2(n: int) -> int:
 
 
 class ScanOp(SourceOperator):
-    """Tile-granular scan over a device-resident table (cFetcher analog —
-    the KV decode already happened at table load)."""
+    """Tile-granular scan (cFetcher analog). Two modes:
+
+    - resident: the table materializes once in HBM and tiles slice from it
+      (warm block-cache model; KV decode happened at load).
+    - streaming: tables over `sql.distsql.scan_stream_rows` never fully
+      occupy HBM — tiles upload host->device with DOUBLE BUFFERING (the
+      next tile's async transfer is issued before the current one is
+      consumed, so transfer overlaps downstream compute — SURVEY §7's
+      pipelining host<->device hard part; the reference's analog is the
+      goroutine-per-processor pull pipeline).
+    """
 
     def __init__(self, table: Table, columns: tuple[str, ...] | None = None,
                  tile: int | None = None):
@@ -62,8 +71,26 @@ class ScanOp(SourceOperator):
         self._batch = None
         self.tile = tile
         self._offset = 0
+        self.streaming = False
 
     def init(self):
+        from ..utils import settings
+
+        stream_rows = settings.get("sql.distsql.scan_stream_rows")
+        self.streaming = (
+            hasattr(self.table, "columns")  # KV-backed tables decode whole
+            and self.table.num_rows > stream_rows
+        )
+        if self.streaming:
+            self._init_streaming()
+        else:
+            self._init_resident()
+        self._offset = 0
+        super().init()
+
+    # -- resident mode ------------------------------------------------------
+
+    def _init_resident(self):
         self._batch = self.table.device_batch(self.output_schema.names)
         if self.tile is None or self._batch.capacity % self.tile != 0:
             # tiles must divide the padded capacity exactly or the clamped
@@ -77,10 +104,46 @@ class ScanOp(SourceOperator):
                     b,
                 )
             )
-        self._offset = 0
-        super().init()
+
+    # -- streaming mode -----------------------------------------------------
+
+    def _init_streaming(self):
+        t = self.table
+        names = self.output_schema.names
+        self._host_cols = {n: np.asarray(t.columns[n]) for n in names}
+        self._host_valids = {n: t.valids[n] for n in names if n in t.valids}
+        self._nrows = t.num_rows
+        # big tiles amortize dispatch (bounded so two in-flight double-
+        # buffered tiles stay far under HBM); ~64 tiles per table keeps the
+        # pipeline busy at any scale
+        auto = _next_pow2(max(1 << 12, min(1 << 20, self._nrows // 64)))
+        self.tile = max(self.tile or 0, auto)
+        self._prefetched = None
+
+    def _upload(self, off: int) -> Batch:
+        """Async host->device transfer of one tile (device_put returns
+        before the copy completes — that is the overlap)."""
+        from ..coldata.batch import from_host
+
+        hi = min(off + self.tile, self._nrows)
+        arrays = {n: a[off:hi] for n, a in self._host_cols.items()}
+        valids = {n: v[off:hi] for n, v in self._host_valids.items()}
+        return from_host(self.output_schema, arrays, valids=valids,
+                         capacity=self.tile)
 
     def _next(self):
+        if self.streaming:
+            if self._offset >= self._nrows:
+                return None
+            cur = self._prefetched
+            if cur is None:
+                cur = self._upload(self._offset)
+            nxt = self._offset + self.tile
+            # issue the next transfer BEFORE handing the current tile to
+            # the consumer: its device work overlaps this upload
+            self._prefetched = self._upload(nxt) if nxt < self._nrows else None
+            self._offset = nxt
+            return cur
         if self._offset >= self._batch.capacity:
             return None
         if self.tile == self._batch.capacity:

@@ -52,7 +52,7 @@ class KVTable:
         self.pk = pk
         self.pk_idx = schema.index(pk)
         self.table_id = table_id
-        self._count_cache: tuple[int, int] | None = None  # (engine seq, n)
+        self._count_cache = None  # ((engine seq, gen), row count)
         need = rowcodec.value_width(schema)
         if db.engine.val_width < need:
             raise ValueError(
@@ -86,7 +86,9 @@ class KVTable:
         from ..storage import mvcc
 
         eng: Engine = self.db.engine
-        if self._count_cache is not None and self._count_cache[0] == eng._seq:
+        key = (eng._seq, eng._gen)  # _gen catches intent resolutions,
+        # which change visibility without consuming a write sequence
+        if self._count_cache is not None and self._count_cache[0] == key:
             return self._count_cache[1]
         view = eng._merged_view()
         if view is None:
@@ -99,7 +101,7 @@ class KVTable:
                 jnp.asarray(K.encode_bound(end, eng.key_width)),
             )
             n = int(np.asarray(jnp.sum(sel)))
-        self._count_cache = (eng._seq, n)
+        self._count_cache = (key, n)
         return n
 
     def dict_by_index(self) -> dict:

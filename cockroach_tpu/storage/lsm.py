@@ -2,15 +2,24 @@
 
 Host-side orchestration of device-resident sorted runs:
 
-- writes append to a host memtable (plus an in-memory WAL record list);
+- writes append to a durable on-disk WAL (write-ahead, pebble's wal/) and a
+  host memtable;
 - ``flush`` sorts the memtable into an immutable device run (an "SST");
-- when runs pile past ``l0_trigger`` they compact: ``mvcc.merge_blocks``
-  (the k-way-merge kernel) + ``mvcc.mvcc_gc_filter`` — the Pebble compaction
-  loop as one lane-parallel device pass;
-- reads (``get``/``scan``) merge the relevant runs and run the
-  ``mvcc_scan_filter`` kernel (pebble_mvcc_scanner.go:381 semantics);
-- ``checkpoint``/``open_checkpoint`` persist runs+memtable to .npz files
-  (pkg/storage/pebble.go:2077 CreateCheckpoint analog).
+- when runs pile past ``l0_trigger`` a SIZE-TIERED compaction merges only
+  the smallest runs (``mvcc.merge_blocks`` + ``mvcc.mvcc_gc_filter`` — the
+  Pebble compaction loop as one lane-parallel device pass); a full
+  bottom-level compaction runs only on explicit ``compact(bottom=True)``.
+  Partial merges are always safe: the global write sequence resolves
+  same-(key, ts) winners regardless of which runs have merged;
+- reads never mutate the run set. Bounded reads (get / short scans) gather
+  only the in-range rows of each run + the memtable into small candidate
+  tiles and merge THOSE (the merging-iterator role, pebble_mvcc_scanner.go
+  :381 semantics via ``mvcc_scan_filter``), so point/short-range cost is
+  O(candidates·log), not O(total history). Unbounded reads use a merged
+  view cached per run-set generation;
+- ``checkpoint``/``open_checkpoint`` persist runs to .npz files and
+  truncate the WAL (pkg/storage/pebble.go:2077 CreateCheckpoint analog);
+  a crash between checkpoints recovers by WAL replay at open.
 
 Intents: provisional writes carry a txn id; ``resolve_intents`` commits or
 aborts them engine-wide (MVCCResolveWriteIntent). A scan that hits another
@@ -19,7 +28,9 @@ txn's visible intent raises WriteIntentError, like the reference.
 
 from __future__ import annotations
 
+import functools
 import os
+import struct
 from dataclasses import dataclass, field
 
 import jax
@@ -30,13 +41,19 @@ from . import keys as K
 from . import mvcc
 
 _RUN_ALIGN = 1024
+_CAND_ALIGN = 128  # candidate tiles for bounded reads start smaller
+
+_WAL_MAGIC = b"CTWL"
+# kind (0=write, 1=intent resolution), ts, seq, txn, tomb/commit, klen, vlen
+_WAL_REC = struct.Struct("<BqqqBHH")
+_REC_WRITE = 0
+_REC_RESOLVE = 1
 
 
-def _pad(n: int) -> int:
-    """Next power-of-2 capacity >= n (min 1024): runs and merges then take
-    only O(log) distinct static shapes, so every kernel compiles a handful
-    of times total no matter how write volume fluctuates."""
-    p = _RUN_ALIGN
+def _pad(n: int, align: int = _RUN_ALIGN) -> int:
+    """Next power-of-2 capacity >= n (min `align`): blocks take only O(log)
+    distinct static shapes, so kernels compile a handful of times total."""
+    p = align
     while p < n:
         p *= 2
     return p
@@ -44,13 +61,45 @@ def _pad(n: int) -> int:
 
 def _shrink(block: mvcc.KVBlock) -> mvcc.KVBlock:
     """Slice a *sorted* block (dead rows last) down to a power-of-2 capacity
-    covering its live rows — keeps merge/compaction capacities proportional
-    to data, not to the sum of historical paddings."""
+    covering its live rows."""
     live = int(np.asarray(jnp.sum(block.mask)))
     cap = _pad(live)
     if cap >= block.capacity:
         return block
     return jax.tree_util.tree_map(lambda x: x[:cap], block)
+
+
+@jax.jit
+def _live_rows(block: mvcc.KVBlock) -> jax.Array:
+    return jnp.sum(block.mask, dtype=jnp.int32)
+
+
+@jax.jit
+def _range_mask(block: mvcc.KVBlock, sw, ew):
+    """In-range liveness mask + its count, one fused kernel per source
+    shape (sw/ew None-ness is static trace structure)."""
+    words = K.key_words(block.key)
+    m = block.mask & K.words_in_range(words, sw, ew)
+    return m, jnp.sum(m, dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _gather_rows(block: mvcc.KVBlock, m: jax.Array, cap: int) -> mvcc.KVBlock:
+    """Compact the rows where `m` into a tile of `cap` (row order kept, so a
+    sorted source yields a sorted candidate tile)."""
+    dest = jnp.where(m, jnp.cumsum(m.astype(jnp.int32)) - 1, cap)
+    n = jnp.sum(m, dtype=jnp.int32)
+
+    def take(x):
+        shape = (cap,) + x.shape[1:]
+        return jnp.zeros(shape, x.dtype).at[dest].set(x, mode="drop")
+
+    return mvcc.KVBlock(
+        key=take(block.key), ts=take(block.ts), seq=take(block.seq),
+        txn=take(block.txn), tomb=take(block.tomb), value=take(block.value),
+        vlen=take(block.vlen),
+        mask=jnp.arange(cap, dtype=jnp.int32) < n,
+    )
 
 
 class WriteIntentError(Exception):
@@ -96,6 +145,9 @@ class Engine:
         l0_trigger: int | None = None,
         memtable_size: int = 4096,
         gc_ts: int = 0,
+        wal_path: str | None = None,
+        wal_fsync: bool = False,
+        compact_width: int = 4,
     ):
         assert key_width % 8 == 0
         from ..utils import settings
@@ -109,6 +161,7 @@ class Engine:
         )
         self.memtable_size = memtable_size
         self.gc_ts = gc_ts
+        self.compact_width = compact_width
         self.mem = _Memtable()
         self.runs: list[mvcc.KVBlock] = []  # sorted device runs, newest first
         self.stats = MVCCStats()
@@ -118,6 +171,88 @@ class Engine:
         # txn id holding an intent. Kept in sync by _append/resolve_intents
         # so lock checks are O(1) host lookups, never device merges.
         self._locks: dict[bytes, int] = {}
+        # read caches, invalidated by generation counters
+        self._gen = 0  # bumps whenever the run set changes
+        self._runs_view_cache: tuple[int, mvcc.KVBlock] | None = None
+        self._mem_cache: tuple[int, mvcc.KVBlock] | None = None
+        # durable write-ahead log
+        self.wal_path = wal_path
+        self.wal_fsync = wal_fsync
+        self._wal = None
+        self._replaying = False
+        if wal_path is not None:
+            self._arm_wal(wal_path)
+
+    # -- WAL ----------------------------------------------------------------
+
+    def _arm_wal(self, path: str) -> None:
+        """Replay any existing records, then open the WAL for appending
+        (shared by fresh opens and checkpoint restores)."""
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            self._replay_wal(path)
+        self.wal_path = path
+        self._wal = open(path, "ab")
+        if os.path.getsize(path) < len(_WAL_MAGIC):
+            self._wal.truncate(0)
+            self._wal.write(_WAL_MAGIC)
+            self._wal.flush()
+
+    def _wal_record(self, kind: int, key: bytes, value: bytes, ts: int,
+                    seq: int, txn: int, flag: bool) -> None:
+        rec = _WAL_REC.pack(kind, ts, seq, txn, 1 if flag else 0,
+                            len(key), len(value))
+        self._wal.write(rec + key + value)
+        self._wal.flush()
+        if self.wal_fsync:
+            os.fsync(self._wal.fileno())
+
+    def _replay_wal(self, path: str) -> None:
+        """Recover state lost in a crash: re-apply writes above the restored
+        sequence high-water mark and ALL intent resolutions, in log order
+        (resolutions are idempotent, so re-applying pre-checkpoint ones is
+        harmless; skipping one would resurrect a committed txn's intents)."""
+        with open(path, "rb") as f:
+            data = f.read()
+        if len(data) < len(_WAL_MAGIC):
+            return  # torn header: nothing recoverable was logged
+        if data[:4] != _WAL_MAGIC:
+            raise ValueError(f"corrupt WAL header in {path!r}")
+        off = 4
+        self._replaying = True
+        try:
+            while off + _WAL_REC.size <= len(data):
+                kind, ts, seq, txn, flag, klen, vlen = _WAL_REC.unpack_from(
+                    data, off)
+                off += _WAL_REC.size
+                if off + klen + vlen > len(data):
+                    break  # torn tail record: drop (standard WAL semantics)
+                key = data[off: off + klen]
+                value = data[off + klen: off + klen + vlen]
+                off += klen + vlen
+                if kind == _REC_RESOLVE:
+                    self.resolve_intents(txn, ts, commit=bool(flag))
+                elif seq > self._seq:
+                    self._raw_append(key, value, ts, seq, txn, bool(flag))
+        finally:
+            self._replaying = False
+        self.flush_mem_only()
+
+    def _truncate_wal(self) -> None:
+        if self._wal is None:
+            return
+        self._wal.close()
+        self._wal = open(self.wal_path, "wb")
+        self._wal.write(_WAL_MAGIC)
+        self._wal.flush()
+        if self.wal_fsync:
+            os.fsync(self._wal.fileno())
+        self._wal.close()
+        self._wal = open(self.wal_path, "ab")
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
 
     # -- writes -------------------------------------------------------------
 
@@ -138,23 +273,32 @@ class Engine:
             raise ValueError(f"key too long ({len(b)} > {self.key_width})")
         if len(v) > self.val_width:
             raise ValueError(f"value too long ({len(v)} > {self.val_width})")
-        self._seq += 1
+        seq = self._seq + 1
+        if self._wal is not None:  # write-ahead: durable before visible
+            self._wal_record(_REC_WRITE, b, v, int(ts), seq, int(txn), tomb)
+        self._raw_append(b, v, int(ts), seq, int(txn), tomb)
+        if len(self.mem) >= self.memtable_size:
+            self.flush()
+
+    def _raw_append(self, b: bytes, v: bytes, ts: int, seq: int, txn: int,
+                    tomb: bool) -> None:
+        self._seq = max(self._seq, seq)
         if txn != 0:
             self._locks[b] = int(txn)
         self.mem.keys.append(b)
-        self.mem.ts.append(int(ts))
-        self.mem.seq.append(self._seq)
-        self.mem.txn.append(int(txn))
-        self.mem.tomb.append(bool(tomb))
+        self.mem.ts.append(ts)
+        self.mem.seq.append(seq)
+        self.mem.txn.append(txn)
+        self.mem.tomb.append(tomb)
         self.mem.value.append(v)
-        if len(self.mem) >= self.memtable_size:
-            self.flush()
 
     # -- flush / compaction -------------------------------------------------
 
     def _mem_block(self) -> mvcc.KVBlock | None:
         if not len(self.mem):
             return None
+        if self._mem_cache is not None and self._mem_cache[0] == len(self.mem):
+            return self._mem_cache[1]
         n = len(self.mem)
         keys = K.encode_keys(self.mem.keys, self.key_width)
         vals = np.zeros((n, self.val_width), dtype=np.uint8)
@@ -162,7 +306,7 @@ class Engine:
         for i, v in enumerate(self.mem.value):
             vals[i, : len(v)] = np.frombuffer(v, dtype=np.uint8)
             vlen[i] = len(v)
-        return mvcc.block_from_host(
+        blk = mvcc.sort_block(mvcc.block_from_host(
             keys,
             np.asarray(self.mem.ts),
             np.asarray(self.mem.txn),
@@ -171,60 +315,125 @@ class Engine:
             vlen,
             cap=_pad(n),
             seq=np.asarray(self.mem.seq),
-        )
+        ))
+        self._mem_cache = (n, blk)
+        return blk
 
     def flush(self):
         """Memtable -> sorted immutable run (Pebble memtable flush)."""
+        self.flush_mem_only()
+        if len(self.runs) > self.l0_trigger:
+            self.compact(bottom=False)
+
+    def flush_mem_only(self):
         blk = self._mem_block()
         if blk is None:
             return
-        self.runs.insert(0, mvcc.sort_block(blk))
+        self.runs.insert(0, blk)
         self.mem = _Memtable()
+        self._mem_cache = None
+        self._gen += 1
         self.stats.flushes += 1
         self.stats.runs = len(self.runs)
-        if len(self.runs) > self.l0_trigger:
-            self.compact()
 
     def compact(self, bottom: bool = True):
-        """Merge all runs into one via the k-way merge kernel + GC filter."""
+        """Compaction. bottom=True merges everything and elides bottom-level
+        tombstones (a full/manual compaction); bottom=False is the
+        size-tiered incremental pass: merge only the `compact_width`
+        smallest runs (pebble's tiered L0->Lbase compaction picking)."""
         self.flush_mem_only()
-        if not self.runs:
+        if len(self.runs) < 2:
             return
-        total = sum(r.capacity for r in self.runs)
-        merged = mvcc.merge_blocks(tuple(self.runs), cap=_pad(total))
+        if bottom:
+            picked = list(range(len(self.runs)))
+        else:
+            by_size = sorted(
+                range(len(self.runs)), key=lambda i: self.runs[i].capacity
+            )
+            picked = sorted(by_size[: max(2, self.compact_width)])
+        blocks = tuple(self.runs[i] for i in picked)
+        total = sum(r.capacity for r in blocks)
+        merged = mvcc.merge_blocks(blocks, cap=_pad(total))
         keep = mvcc.mvcc_gc_filter(merged, jnp.int64(self.gc_ts), bottom)
         merged = mvcc.KVBlock(
             key=merged.key, ts=merged.ts, seq=merged.seq, txn=merged.txn,
             tomb=merged.tomb, value=merged.value, vlen=merged.vlen,
             mask=merged.mask & keep,
         )
-        self.runs = [_shrink(mvcc.sort_block(merged))]
+        merged = _shrink(mvcc.sort_block(merged))
+        kept = [r for i, r in enumerate(self.runs) if i not in set(picked)]
+        # the merged run replaces its sources at the oldest picked position
+        kept.insert(min(len(kept), picked[0]), merged)
+        self.runs = kept
+        self._gen += 1
         self.stats.compactions += 1
-        self.stats.runs = 1
+        self.stats.runs = len(self.runs)
 
-    def flush_mem_only(self):
-        blk = self._mem_block()
-        if blk is not None:
-            self.runs.insert(0, mvcc.sort_block(blk))
-            self.mem = _Memtable()
-            self.stats.flushes += 1
-            self.stats.runs = len(self.runs)
+    # -- read views ---------------------------------------------------------
 
-    # -- reads --------------------------------------------------------------
-
-    def _merged_view(self) -> mvcc.KVBlock | None:
-        """One sorted device view over memtable + all runs (the read path's
-        merging iterator)."""
-        self.flush_mem_only()
+    def _runs_view(self) -> mvcc.KVBlock | None:
+        """One sorted device view over all runs, cached per generation;
+        never mutates the run set."""
         if not self.runs:
             return None
+        if (self._runs_view_cache is not None
+                and self._runs_view_cache[0] == self._gen):
+            return self._runs_view_cache[1]
         if len(self.runs) == 1:
-            return self.runs[0]
-        total = sum(r.capacity for r in self.runs)
-        merged = _shrink(mvcc.merge_blocks(tuple(self.runs), cap=_pad(total)))
-        self.runs = [merged]  # merged view is also a valid single run
-        self.stats.runs = 1
-        return merged
+            view = self.runs[0]
+        else:
+            total = sum(r.capacity for r in self.runs)
+            view = _shrink(
+                mvcc.merge_blocks(tuple(self.runs), cap=_pad(total))
+            )
+        self._runs_view_cache = (self._gen, view)
+        return view
+
+    def _merged_view(self) -> mvcc.KVBlock | None:
+        """Sorted view over memtable + runs (the read path's merging
+        iterator). Cached runs view + a small memtable overlay merge; the
+        run set itself is never rewritten by reads."""
+        rv = self._runs_view()
+        mb = self._mem_block()
+        if mb is None:
+            return rv
+        if rv is None:
+            return mb
+        return mvcc.merge_blocks(
+            (mb, rv), cap=_pad(mb.capacity + rv.capacity)
+        )
+
+    def _bounded_view(self, sw, ew) -> mvcc.KVBlock | None:
+        """Candidate view for a bounded read: gather only in-range rows of
+        each source into small tiles and merge those — point/short-scan
+        cost scales with matching rows, not total history."""
+        sources = []
+        mb = self._mem_block()
+        if mb is not None:
+            sources.append(mb)
+        sources.extend(self.runs)
+        swj = None if sw is None else jnp.asarray(sw)
+        ewj = None if ew is None else jnp.asarray(ew)
+        parts = []
+        for src in sources:
+            m, cnt = _range_mask(src, swj, ewj)
+            cnt = int(np.asarray(cnt))
+            if cnt == 0:
+                continue
+            parts.append(_gather_rows(src, m, _pad(cnt, _CAND_ALIGN)))
+        if not parts:
+            return None
+        if len(parts) == 1:
+            return parts[0]
+        total = sum(p.capacity for p in parts)
+        return mvcc.merge_blocks(tuple(parts), cap=_pad(total, _CAND_ALIGN))
+
+    def _view_for(self, sw, ew) -> mvcc.KVBlock | None:
+        if sw is None and ew is None:
+            return self._merged_view()
+        return self._bounded_view(sw, ew)
+
+    # -- reads --------------------------------------------------------------
 
     def scan(
         self,
@@ -235,11 +444,11 @@ class Engine:
         max_keys: int | None = None,
     ) -> list[tuple[bytes, bytes]]:
         """[start, end) snapshot scan at `ts` -> [(key, value)] host pairs."""
-        view = self._merged_view()
-        if view is None:
-            return []
         sw = K.encode_bound(start, self.key_width)
         ew = K.encode_bound(end, self.key_width)
+        view = self._view_for(sw, ew)
+        if view is None:
+            return []
         sel, conflict = mvcc.mvcc_scan_filter(
             view, jnp.int64(ts), jnp.int64(txn),
             None if sw is None else jnp.asarray(sw),
@@ -261,12 +470,12 @@ class Engine:
         return [(k, bytes(v[:n])) for k, v, n in zip(ks, vals, vls)]
 
     def get(self, key: bytes | str, ts: int, txn: int = 0) -> bytes | None:
-        view = self._merged_view()
-        if view is None:
-            return None
         b = key.encode() if isinstance(key, str) else bytes(key)
         sw = K.encode_bound(b, self.key_width)
         ew = K.bound_next(sw)
+        view = self._bounded_view(sw, ew)
+        if view is None:
+            return None
         sel, conflict = mvcc.mvcc_scan_filter(
             view, jnp.int64(ts), jnp.int64(txn),
             jnp.asarray(sw), jnp.asarray(ew),
@@ -287,7 +496,12 @@ class Engine:
     # -- intents ------------------------------------------------------------
 
     def resolve_intents(self, txn: int, commit_ts: int, commit: bool):
-        """Commit or abort all of txn's intents across memtable + runs."""
+        """Commit or abort all of txn's intents across memtable + runs.
+        WAL-logged: without a resolution record, crash replay would
+        resurrect an acknowledged commit's writes as unresolved intents."""
+        if self._wal is not None and not self._replaying:
+            self._wal_record(_REC_RESOLVE, b"", b"", int(commit_ts), 0,
+                             int(txn), commit)
         self._locks = {k: t for k, t in self._locks.items() if t != txn}
         self.flush_mem_only()
         self.runs = [
@@ -298,6 +512,7 @@ class Engine:
             )
             for r in self.runs
         ]
+        self._gen += 1
 
     def has_committed_writes_in(
         self, start: bytes | None, end: bytes | None, ts_lo: int, ts_hi: int,
@@ -305,15 +520,13 @@ class Engine:
     ) -> bool:
         """Any committed version in (ts_lo, ts_hi] within [start, end)?
         The read-refresh check (kvcoord txn_interceptor_span_refresher
-        semantics: a txn's reads stay valid iff nothing committed under its
-        read spans between read_ts and commit_ts). ``point=True`` checks
-        exactly the key `start` (successor end bound, like get)."""
-        view = self._merged_view()
+        semantics). ``point=True`` checks exactly the key `start`."""
+        sw = K.encode_bound(start, self.key_width)
+        ew = K.bound_next(sw) if point else K.encode_bound(end, self.key_width)
+        view = self._view_for(sw, ew)
         if view is None:
             return False
         words = K.key_words(view.key)
-        sw = K.encode_bound(start, self.key_width)
-        ew = K.bound_next(sw) if point else K.encode_bound(end, self.key_width)
         in_range = view.mask & K.words_in_range(
             words,
             None if sw is None else jnp.asarray(sw),
@@ -336,18 +549,14 @@ class Engine:
 
     def newest_committed_ts(self, key: bytes) -> int:
         """Timestamp of the newest committed version of `key` (0 if none) —
-        powers the WriteTooOld check."""
-        view = self._merged_view()
+        powers the WriteTooOld check. Bounded point lookup: never merges."""
+        b = key.encode() if isinstance(key, str) else bytes(key)
+        sw = K.encode_bound(b, self.key_width)
+        ew = K.bound_next(sw)
+        view = self._bounded_view(sw, ew)
         if view is None:
             return 0
-        sw = K.encode_bound(key, self.key_width)
-        ew = K.bound_next(sw)
-        words = K.key_words(view.key)
-        hit = (
-            view.mask
-            & K.words_in_range(words, jnp.asarray(sw), jnp.asarray(ew))
-            & (view.txn == 0)
-        )
+        hit = view.mask & (view.txn == 0)
         ts = jnp.where(hit, view.ts, 0)
         return int(np.asarray(jnp.max(ts)))
 
@@ -374,7 +583,9 @@ class Engine:
         return s
 
     def checkpoint(self, path: str):
-        """Persist the engine state (CreateCheckpoint analog)."""
+        """Persist the engine state (CreateCheckpoint analog); the WAL
+        truncates afterwards — everything below the checkpoint is durable
+        in the .npz runs."""
         self.flush_mem_only()
         os.makedirs(path, exist_ok=True)
         for i, r in enumerate(self.runs):
@@ -388,12 +599,15 @@ class Engine:
             )
         with open(os.path.join(path, "MANIFEST"), "w") as f:
             f.write(f"{len(self.runs)} {self.key_width} {self.val_width}\n")
+        self._truncate_wal()
 
     @classmethod
     def open_checkpoint(cls, path: str, **kwargs) -> "Engine":
         with open(os.path.join(path, "MANIFEST")) as f:
             nruns, kw, vw = (int(x) for x in f.read().split())
+        wal_path = kwargs.pop("wal_path", None)
         eng = cls(key_width=kw, val_width=vw, **kwargs)
+        assert eng._wal is None, "pass wal_path to open_checkpoint, not cls"
         for i in range(nruns):
             z = np.load(os.path.join(path, f"run{i:04d}.npz"))
             eng.runs.append(
@@ -406,6 +620,7 @@ class Engine:
                 )
             )
         eng.stats.runs = len(eng.runs)
+        eng._gen += 1
         # restore the write-sequence high-water mark so post-restore writes
         # keep winning same-(key, ts) tie-breaks over persisted rows, and
         # rebuild the host lock table from persisted intents
@@ -419,4 +634,7 @@ class Engine:
                 ts = np.asarray(r.txn)[np.nonzero(im)[0]]
                 for kk, tt in zip(ks, ts):
                     eng._locks[kk] = int(tt)
+        if wal_path is not None:
+            # replay records that postdate the checkpoint, then arm the WAL
+            eng._arm_wal(wal_path)
         return eng

@@ -155,6 +155,13 @@ WORKMEM_ROWS = register_int(
     "threshold (disk_spiller.go:103 analog)",
     lo=1024,
 )
+SCAN_STREAM_ROWS = register_int(
+    "sql.distsql.scan_stream_rows", 1 << 23,
+    "tables larger than this stream host->device tile by tile with "
+    "double-buffered async transfers instead of materializing wholly in "
+    "HBM (the host half of SURVEY §7's pipelining hard part)",
+    lo=1024,
+)
 DENSE_AGG = register_bool(
     "sql.distsql.dense_agg.enabled", True,
     "allow the dense-code small-group aggregation specialization "
