@@ -89,7 +89,12 @@ def _money(rng, lo_cents: int, hi_cents: int, n: int) -> np.ndarray:
     return rng.integers(lo_cents, hi_cents + 1, n, dtype=np.int64)
 
 
-def gen_tpch(sf: float = 0.01, seed: int = 19920101) -> Catalog:
+def gen_tpch(sf: float = 0.01, seed: int = 19920101,
+             via_arrow: bool = True) -> Catalog:
+    """Generate the TPC-H catalog. via_arrow=True (default) round-trips
+    every table through Apache Arrow (coldata/arrow.py), so the standard
+    load path exercises the interchange format the way the reference's
+    colserde sits on its wire path."""
     rng = np.random.default_rng(seed)
     cat = Catalog()
     pool = _comment_pool(rng)
@@ -334,6 +339,13 @@ def gen_tpch(sf: float = 0.01, seed: int = 19920101) -> Catalog:
             "o_comment": comments(n_order),
         },
     ))
+    if via_arrow:
+        from ..coldata import arrow as arrow_mod
+
+        for name in list(cat.tables):
+            cat.tables[name] = arrow_mod.table_from_arrow(
+                name, arrow_mod.table_to_arrow(cat.tables[name])
+            )
     return cat
 
 

@@ -301,7 +301,8 @@ class AggregateOp(OneInputOperator):
 
     def init(self):
         super().init()
-        self._acc = None
+        self._tiles: list[Batch] = []
+        self._spooled = 0
         self._emitted = False
         if hasattr(self, "_partial_fn"):
             return
@@ -312,16 +313,23 @@ class AggregateOp(OneInputOperator):
         mcols = self.merge_group_cols
         mspecs = self.merge_specs
 
-        @functools.partial(jax.jit, static_argnames=("cap",))
-        def partial_fn(b, cap):
-            return agg_ops.sort_groupby(b, schema, gcols, pspecs, out_capacity=cap)
+        def partial_fn(b):
+            # out_capacity == input capacity: groups <= live rows, so this
+            # CANNOT overflow — no device->host sync on the hot tile loop
+            # (the per-tile sync was the dominant cost at real scale: each
+            # one pays a full host<->device round trip)
+            part, _ = agg_ops.sort_groupby(
+                b, schema, gcols, pspecs, out_capacity=b.capacity
+            )
+            return part
 
         @functools.partial(jax.jit, static_argnames=("cap",))
-        def merge_fn(acc, part, cap):
-            both = concat([acc, part], capacity=acc.capacity + part.capacity)
-            return agg_ops.sort_groupby(both, sschema, mcols, mspecs, out_capacity=cap)
+        def merge_fn(tiles, cap):
+            both = concat(list(tiles), capacity=cap)
+            return agg_ops.sort_groupby(both, sschema, mcols, mspecs,
+                                        out_capacity=cap)
 
-        self._partial_fn = partial_fn
+        self._partial_fn = jax.jit(partial_fn)
         self._merge_fn = merge_fn
         self._finalize_fn = jax.jit(self._finalize)
 
@@ -329,25 +337,26 @@ class AggregateOp(OneInputOperator):
         return agg_ops.finalize_states(state, self.final_map, self.num_keys)
 
     def _ingest(self, b: Batch):
-        cap = _next_pow2(int(b.capacity))
-        if self.mode == "final":
-            part = b  # child already emits state layout
-        else:
-            while True:
-                part, ng = self._partial_fn(b, cap=cap)
-                if int(ng) <= cap:
-                    break
-                cap = _next_pow2(int(ng))
-        if self._acc is None:
-            self._acc = part if part.capacity >= 1024 else concat([part], 1024)
-            return
-        cap = max(self._acc.capacity, part.capacity)
-        while True:
-            merged, ng = self._merge_fn(self._acc, part, cap=cap)
-            if int(ng) <= cap:
-                break
+        """Spool per-tile partial states; merge down only when the spool
+        exceeds workmem (amortized O(total/workmem) syncs, not one per
+        tile — the reference's hashAggregator equivalently buffers)."""
+        from ..utils import settings
+
+        part = b if self.mode == "final" else self._partial_fn(b)
+        self._tiles.append(part)
+        self._spooled += part.capacity
+        if self._spooled > settings.get("sql.distsql.workmem_rows"):
+            self._tiles = [self._merge_down()]
+            self._spooled = self._tiles[0].capacity
+
+    def _merge_down(self) -> Batch:
+        cap = _next_pow2(sum(t.capacity for t in self._tiles))
+        merged, ng = self._merge_fn(tuple(self._tiles), cap=cap)
+        # one bounded retry loop per merge-down, not per tile
+        while int(ng) > cap:
             cap = _next_pow2(int(ng))
-        self._acc = merged
+            merged, ng = self._merge_fn(tuple(self._tiles), cap=cap)
+        return merged
 
     def _next(self):
         if self._emitted:
@@ -358,11 +367,18 @@ class AggregateOp(OneInputOperator):
                 break
             self._ingest(b)
         self._emitted = True
-        if self._acc is None:
+        if not self._tiles:
             return None
+        # a single tile is already fully grouped UNLESS it came from a
+        # "final"-mode child (exchanged state rows may repeat group keys)
+        if len(self._tiles) == 1 and self.mode != "final":
+            acc = self._tiles[0]
+        else:
+            acc = self._merge_down()
+        self._tiles = []
         if self.mode == "partial":
-            return self._acc
-        return self._finalize_fn(self._acc)
+            return acc
+        return self._finalize_fn(acc)
 
 
 class ScalarAggregateOp(OneInputOperator):
@@ -624,6 +640,14 @@ class HashJoinOp(OneInputOperator):
             return None
         if self.spec.build_unique:
             return self._probe_fn(p, self._build_batch, self._index)
+        if self.spec.join_type in ("semi", "anti"):
+            # output is a probe-shaped mask: it cannot overflow out_cap,
+            # so skip the total check — a device->host sync per tile is
+            # the single dominant cost of the pull loop at scale
+            out, _ = self._probe_gen_fn(
+                p, self._build_batch, self._index, out_cap=self._out_cap
+            )
+            return out
         while True:
             out, total = self._probe_gen_fn(
                 p, self._build_batch, self._index, out_cap=self._out_cap

@@ -175,6 +175,7 @@ class Engine:
         self._gen = 0  # bumps whenever the run set changes
         self._runs_view_cache: tuple[int, mvcc.KVBlock] | None = None
         self._mem_cache: tuple[int, mvcc.KVBlock] | None = None
+        self._overlay_cache = None  # ((gen, mem len), merged view)
         # durable write-ahead log
         self.wal_path = wal_path
         self.wal_fsync = wal_fsync
@@ -187,9 +188,15 @@ class Engine:
 
     def _arm_wal(self, path: str) -> None:
         """Replay any existing records, then open the WAL for appending
-        (shared by fresh opens and checkpoint restores)."""
+        (shared by fresh opens and checkpoint restores). Torn bytes past
+        the last complete record are truncated away — appending after
+        garbage would corrupt every future replay."""
+        valid_off = 0
         if os.path.exists(path) and os.path.getsize(path) > 0:
-            self._replay_wal(path)
+            valid_off = self._replay_wal(path)
+            if valid_off < os.path.getsize(path):
+                with open(path, "r+b") as f:
+                    f.truncate(valid_off)
         self.wal_path = path
         self._wal = open(path, "ab")
         if os.path.getsize(path) < len(_WAL_MAGIC):
@@ -206,18 +213,21 @@ class Engine:
         if self.wal_fsync:
             os.fsync(self._wal.fileno())
 
-    def _replay_wal(self, path: str) -> None:
+    def _replay_wal(self, path: str) -> int:
         """Recover state lost in a crash: re-apply writes above the restored
         sequence high-water mark and ALL intent resolutions, in log order
         (resolutions are idempotent, so re-applying pre-checkpoint ones is
-        harmless; skipping one would resurrect a committed txn's intents)."""
+        harmless; skipping one would resurrect a committed txn's intents).
+        Returns the offset just past the last COMPLETE record, so the
+        caller can truncate torn bytes before appending."""
         with open(path, "rb") as f:
             data = f.read()
         if len(data) < len(_WAL_MAGIC):
-            return  # torn header: nothing recoverable was logged
+            return 0  # torn header: nothing recoverable was logged
         if data[:4] != _WAL_MAGIC:
             raise ValueError(f"corrupt WAL header in {path!r}")
         off = 4
+        valid_off = off
         self._replaying = True
         try:
             while off + _WAL_REC.size <= len(data):
@@ -229,6 +239,7 @@ class Engine:
                 key = data[off: off + klen]
                 value = data[off + klen: off + klen + vlen]
                 off += klen + vlen
+                valid_off = off
                 if kind == _REC_RESOLVE:
                     self.resolve_intents(txn, ts, commit=bool(flag))
                 elif seq > self._seq:
@@ -236,6 +247,7 @@ class Engine:
         finally:
             self._replaying = False
         self.flush_mem_only()
+        return valid_off
 
     def _truncate_wal(self) -> None:
         if self._wal is None:
@@ -391,17 +403,24 @@ class Engine:
 
     def _merged_view(self) -> mvcc.KVBlock | None:
         """Sorted view over memtable + runs (the read path's merging
-        iterator). Cached runs view + a small memtable overlay merge; the
-        run set itself is never rewritten by reads."""
+        iterator). Cached per (run-set generation, memtable length) so a
+        write-then-N-reads workload pays one overlay merge, not N; the run
+        set itself is never rewritten by reads."""
         rv = self._runs_view()
         mb = self._mem_block()
         if mb is None:
             return rv
         if rv is None:
             return mb
-        return mvcc.merge_blocks(
+        key = (self._gen, len(self.mem))
+        if (self._overlay_cache is not None
+                and self._overlay_cache[0] == key):
+            return self._overlay_cache[1]
+        view = mvcc.merge_blocks(
             (mb, rv), cap=_pad(mb.capacity + rv.capacity)
         )
+        self._overlay_cache = (key, view)
+        return view
 
     def _bounded_view(self, sw, ew) -> mvcc.KVBlock | None:
         """Candidate view for a bounded read: gather only in-range rows of
@@ -589,16 +608,28 @@ class Engine:
         self.flush_mem_only()
         os.makedirs(path, exist_ok=True)
         for i, r in enumerate(self.runs):
-            np.savez(
-                os.path.join(path, f"run{i:04d}.npz"),
-                key=np.asarray(r.key), ts=np.asarray(r.ts),
-                seq=np.asarray(r.seq),
-                txn=np.asarray(r.txn), tomb=np.asarray(r.tomb),
-                value=np.asarray(r.value), vlen=np.asarray(r.vlen),
-                mask=np.asarray(r.mask),
-            )
+            with open(os.path.join(path, f"run{i:04d}.npz"), "wb") as f:
+                np.savez(
+                    f,
+                    key=np.asarray(r.key), ts=np.asarray(r.ts),
+                    seq=np.asarray(r.seq),
+                    txn=np.asarray(r.txn), tomb=np.asarray(r.tomb),
+                    value=np.asarray(r.value), vlen=np.asarray(r.vlen),
+                    mask=np.asarray(r.mask),
+                )
+                f.flush()
+                os.fsync(f.fileno())
         with open(os.path.join(path, "MANIFEST"), "w") as f:
             f.write(f"{len(self.runs)} {self.key_width} {self.val_width}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        # the checkpoint must be durable BEFORE the WAL truncates, or a
+        # crash in between loses acknowledged writes
+        dfd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         self._truncate_wal()
 
     @classmethod

@@ -453,3 +453,26 @@ def test_wal_torn_header(tmp_path):
     eng2 = Engine(val_width=8, wal_path=wal)
     assert eng2.get(b"a", ts=5) == b"1"
     eng2.close()
+
+
+def test_wal_torn_tail_truncated_before_append(tmp_path):
+    """Torn tail bytes are truncated before new appends; without that, new
+    records land after garbage and later replays misparse them."""
+    from cockroach_tpu.storage.lsm import Engine
+
+    wal = str(tmp_path / "wal.log")
+    eng = Engine(val_width=8, wal_path=wal)
+    eng.put(b"a", b"1", ts=1)
+    eng.close()
+    with open(wal, "ab") as f:
+        f.write(b"\x00" * 7)  # crash mid-record: torn header bytes
+
+    eng2 = Engine(val_width=8, wal_path=wal)
+    assert eng2.get(b"a", ts=5) == b"1"
+    eng2.put(b"b", b"2", ts=2)  # appended after the truncation point
+    eng2.close()
+
+    eng3 = Engine(val_width=8, wal_path=wal)
+    assert eng3.get(b"a", ts=5) == b"1"
+    assert eng3.get(b"b", ts=5) == b"2"  # survived a second replay intact
+    eng3.close()
