@@ -349,6 +349,86 @@ def gen_tpch(sf: float = 0.01, seed: int = 19920101,
     return cat
 
 
+def save_catalog(cat: Catalog, path: str) -> None:
+    """Serialize a generated catalog to one .npz (columns + valids + string
+    dictionaries) so bench runs don't repay datagen (~80s at SF1)."""
+    import os
+
+    blob: dict[str, np.ndarray] = {}
+    meta = []
+    for name, t in cat.tables.items():
+        meta.append(name)
+        for cname in t.schema.names:
+            blob[f"{name}.col.{cname}"] = np.asarray(t.columns[cname])
+            if cname in t.valids:
+                blob[f"{name}.valid.{cname}"] = np.asarray(t.valids[cname])
+            if cname in t.dictionaries:
+                blob[f"{name}.dict.{cname}"] = (
+                    t.dictionaries[cname].values.astype(str)
+                )
+    blob["__tables__"] = np.array(meta, dtype=str)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, **blob)
+    os.replace(tmp, path)
+
+
+def load_catalog(path: str, sf: float) -> Catalog | None:
+    """Load a catalog saved by save_catalog; None if absent/corrupt."""
+    import os
+
+    from ..coldata.batch import Dictionary
+
+    if not os.path.exists(path):
+        return None
+    try:
+        z = np.load(path, allow_pickle=True)
+        names = list(z["__tables__"])
+        ref = gen_tpch(sf=0.0005)  # schemas only (tiny, fast)
+        cat = Catalog()
+        for name in names:
+            schema = ref.get(name).schema
+            cols, valids, dicts = {}, {}, {}
+            for cname in schema.names:
+                cols[cname] = z[f"{name}.col.{cname}"]
+                vk = f"{name}.valid.{cname}"
+                if vk in z:
+                    valids[cname] = z[vk]
+                dk = f"{name}.dict.{cname}"
+                if dk in z:
+                    dicts[cname] = Dictionary(z[dk].astype(object))
+            cat.add(Table(name=name, schema=schema, columns=cols,
+                          valids=valids, dictionaries=dicts))
+        return cat
+    except Exception:
+        return None
+
+
+_GEN_VERSION = 3  # bump when gen_tpch's data distributions change
+
+
+def gen_tpch_cached(sf: float, seed: int = 19920101,
+                    cache_dir: str | None = None) -> Catalog:
+    """gen_tpch with a .npz disk cache keyed by (scale, seed, generator
+    version) so generator changes can never silently reuse stale data."""
+    import os
+
+    if cache_dir is None:
+        cache_dir = os.environ.get("TPCH_CACHE_DIR", ".cache")
+    path = os.path.join(
+        cache_dir, f"tpch_sf{sf:g}_s{seed}_v{_GEN_VERSION}.npz"
+    )
+    cat = load_catalog(path, sf)
+    if cat is not None:
+        return cat
+    cat = gen_tpch(sf=sf, seed=seed)
+    try:
+        save_catalog(cat, path)
+    except Exception:
+        pass
+    return cat
+
+
 def to_pandas(cat: Catalog, name: str):
     """Decode a table to a pandas DataFrame for oracle computations."""
     import pandas as pd

@@ -23,8 +23,14 @@ from .coldata.types import Family, Schema
 TILE_ALIGN = 1024  # pad device tables to a multiple of this (8x128 lanes)
 
 
-def _pad_cap(n: int) -> int:
-    return max(TILE_ALIGN, ((n + TILE_ALIGN - 1) // TILE_ALIGN) * TILE_ALIGN)
+def _pad_cap(n: int, tile: int | None = None) -> int:
+    """Padded device capacity: a multiple of the scan tile (so bounded-tile
+    resident scans slice evenly — no full-table kernel shapes), min one tile.
+    Small tables align to 1024 lanes only."""
+    align = TILE_ALIGN
+    if tile is not None and n > tile:
+        align = tile
+    return max(align, ((n + align - 1) // align) * align)
 
 
 @dataclass
@@ -35,6 +41,7 @@ class Table:
     valids: dict[str, np.ndarray] = field(default_factory=dict)
     dictionaries: dict[str, Dictionary] = field(default_factory=dict)
     _device: dict | None = None
+    _stats: dict | None = None
 
     @property
     def num_rows(self) -> int:
@@ -45,13 +52,42 @@ class Table:
             self.schema.index(name): d for name, d in self.dictionaries.items()
         }
 
+    def col_stats(self) -> dict[str, tuple]:
+        """Per-column (lo, hi) bounds over valid rows for integer-represented
+        columns (the table-statistics analog of pkg/sql/stats, reduced to
+        what the kernel layer consumes: sort-key bit widths). Computed once
+        on the host, cached."""
+        if getattr(self, "_stats", None) is None:
+            stats: dict[str, tuple] = {}
+            for name, t in zip(self.schema.names, self.schema.types):
+                if t.family in (Family.FLOAT, Family.BYTES, Family.BOOL,
+                                Family.JSON):
+                    continue
+                a = np.asarray(self.columns[name])
+                if name in self.valids:
+                    a = a[np.asarray(self.valids[name])]
+                if len(a) == 0:
+                    continue
+                stats[name] = (int(a.min()), int(a.max()))
+            self._stats = stats
+        return self._stats
+
     def device_batch(self, names: tuple[str, ...] | None = None) -> Batch:
         """Device-resident batch of the requested columns. Cached per column,
         so a query never uploads columns it does not scan."""
+        from .utils import settings
+
         names = names or self.schema.names
         if self._device is None:
             self._device = {}
-        cap = _pad_cap(self.num_rows)
+        # pin the padded capacity when the cache is created: tile_size is a
+        # live setting, and per-column uploads after a change must match the
+        # capacity of already-cached columns
+        cap = self._device.get("__cap__")
+        if cap is None:
+            cap = _pad_cap(self.num_rows,
+                           settings.get("sql.distsql.tile_size"))
+            self._device["__cap__"] = cap
         n = self.num_rows
         if "__mask__" not in self._device:
             m = np.zeros((cap,), dtype=np.bool_)

@@ -39,13 +39,21 @@ class ComponentStats:
 
 class Operator:
     """Base pull operator. Subclasses set output_schema/dictionaries in
-    __init__ and implement _next()."""
+    __init__ and implement _next().
+
+    col_stats maps output column index -> (lo, hi) value bounds where known
+    (from catalog table statistics, propagated like dictionaries). Sort and
+    group-by kernels use them to bit-pack key columns into fewer sort
+    operands (ops/keys.py) — the optimizer-statistics analog applied to
+    kernel shape instead of plan choice."""
 
     output_schema: Schema
     dictionaries: dict[int, Dictionary]
+    col_stats: dict[int, tuple]
 
     def __init__(self):
         self.dictionaries = {}
+        self.col_stats = {}
         self._initialized = False
         self.stats = ComponentStats()
         self._collect = False
@@ -82,6 +90,13 @@ class Operator:
     def _next(self) -> Batch | None:
         raise NotImplementedError
 
+    def stream_parts(self):
+        """Fused-streaming contract: (source, fn, args) when this operator's
+        output is a pure per-tile device function of a source's tiles —
+        consumers compose the whole chain into one jit (flow/operators.py).
+        None means this operator is a pipeline barrier."""
+        return None
+
     def close(self) -> None:
         """Closer analog (colexecop/operator.go:194)."""
 
@@ -95,6 +110,7 @@ class OneInputOperator(Operator):
         super().__init__()
         self.child = child
         self.dictionaries = dict(child.dictionaries)
+        self.col_stats = dict(child.col_stats)
 
     def init(self) -> None:
         self.child.init()

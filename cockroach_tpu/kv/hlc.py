@@ -17,8 +17,11 @@ LOGICAL_MASK = (1 << LOGICAL_BITS) - 1
 
 
 def pack(wall_ms: int, logical: int) -> int:
+    if not 0 <= logical <= LOGICAL_MASK:
+        raise OverflowError(f"hlc logical component out of range: {logical}")
     ts = (wall_ms << LOGICAL_BITS) | logical
-    assert ts < (1 << 63), f"hlc wall component overflows int64: {wall_ms}"
+    if ts >= (1 << 63):
+        raise OverflowError(f"hlc wall component overflows int64: {wall_ms}")
     return ts
 
 
@@ -39,6 +42,13 @@ class Clock:
         ts = pack(wall, 0)
         if ts <= self._last:
             ts = self._last + 1
+            if (ts & LOGICAL_MASK) == 0:
+                # logical field saturated: 2^20 ticks were issued inside one
+                # wall millisecond and the increment carried into the wall
+                # component — surface it rather than silently drifting
+                raise OverflowError(
+                    "hlc logical counter saturated within one millisecond"
+                )
         self._last = ts
         return ts
 

@@ -112,9 +112,17 @@ class KVTable:
         return {}
 
     @property
-    def valids(self) -> dict:
-        # nullability is data-dependent; report every column maybe-NULL
-        return {n: np.zeros(1, dtype=bool) for n in self.schema.names}
+    def valids(self):
+        # Nullability is data-dependent (it lives in the engine, not a host
+        # bitmap). Raising AttributeError makes this sentinel impossible to
+        # misread: duck-typed consumers using getattr(t, "valids", ...) /
+        # hasattr fall back safely, while any code that would row-align a
+        # host bitmap (arrow conversion, streaming scans) fails loudly
+        # instead of silently treating a length-1 marker as real data.
+        raise AttributeError(
+            "KVTable has no host valid bitmaps; nullability is decoded on "
+            "device by device_batch()"
+        )
 
     def device_batch(self, names: tuple[str, ...] | None = None) -> Batch:
         """Columnar snapshot of the newest-visible rows, decoded on device.

@@ -110,28 +110,41 @@ def sort_groupby(
     group_cols: tuple[int, ...],
     aggs: tuple[AggSpec, ...],
     out_capacity: int | None = None,
+    col_stats: dict[int, tuple] | None = None,
 ) -> tuple[Batch, jax.Array]:
     """General grouped aggregation over one tile. Output tile: one live row per
     group (group key columns first, then aggregates), padded to capacity.
 
     Returns (batch, num_groups). If num_groups > out_capacity the output is
     truncated and the caller must retry with a larger tile (same capacity-
-    bucketing contract as hash_join_general)."""
+    bucketing contract as hash_join_general).
+
+    The group keys bit-pack into as few uint64 sort operands as possible
+    (ops/keys.py; catalog stats shrink integer keys) — on TPU lax.sort
+    compile time scales with operand count, so a 3-column TPC-H group-by
+    sorts on ONE packed word instead of seven operands."""
+    from . import keys as key_ops
+
     cap = batch.capacity
     cap_out = out_capacity or cap
     live = batch.mask
+    col_stats = col_stats or {}
 
-    # Sort live rows first, then by group keys (nulls are their own group).
-    # NULL rows carry garbage data: zero it in the sort key so the NULL
-    # group is contiguous even with later key columns in play.
-    operands = [~live]
+    # Sort live rows first, then by group keys (nulls are their own group;
+    # NULL rows' garbage data is zeroed inside key_segments so the NULL
+    # group is contiguous even with later key columns in play).
+    segs: list = [key_ops.BitSeg(1, (~live).astype(jnp.uint64))]
     for gi in group_cols:
         c = batch.cols[gi]
-        operands.append(~c.valid)
-        operands.append(jnp.where(c.valid, c.data, jnp.zeros_like(c.data)))
+        segs.extend(key_ops.key_segments(
+            c.data, c.valid, schema.types[gi], desc=False, nulls_first=False,
+            stats=col_stats.get(gi), order_semantics=False,
+        ))
+    operands = key_ops.pack_operands(segs)
     perm = jnp.arange(cap, dtype=jnp.int32)
-    num_keys = len(operands)
-    sorted_ops = jax.lax.sort(operands + [perm], num_keys=num_keys, is_stable=True)
+    sorted_ops = jax.lax.sort(
+        operands + [perm], num_keys=len(operands) + 1
+    )
     perm = sorted_ops[-1]
 
     live_s = live[perm]
@@ -139,16 +152,12 @@ def sort_groupby(
         (batch.cols[gi].data[perm], batch.cols[gi].valid[perm]) for gi in group_cols
     ]
 
-    #
-
+    # Group boundaries: compare adjacent rows on the SORTED packed words
+    # (word equality == full group-key equality, NULL==NULL included).
     idx = jnp.arange(cap)
     changed = jnp.zeros((cap,), jnp.bool_)
-    for kd, kv in keys_s:
-        prev_d = jnp.roll(kd, 1, axis=0)
-        prev_v = jnp.roll(kv, 1, axis=0)
-        # two NULLs are the same group regardless of underlying data
-        neq = (kv != prev_v) | (kv & prev_v & (kd != prev_d))
-        changed = changed | neq
+    for w in sorted_ops[:-1]:
+        changed = changed | (w != jnp.roll(w, 1, axis=0))
     prev_live = jnp.roll(live_s, 1)
     boundary = live_s & ((idx == 0) | changed | ~prev_live)
     num_groups = jnp.sum(boundary, dtype=jnp.int32)

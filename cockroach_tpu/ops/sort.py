@@ -83,22 +83,51 @@ def order_keys(
     return [null_key, u]
 
 
+def pack_sort_operands(
+    batch: Batch,
+    schema: Schema,
+    keys: tuple[SortKey, ...],
+    rank_tables: dict[int, np.ndarray] | None = None,
+    col_stats: dict[int, tuple] | None = None,
+    include_mask: bool = True,
+) -> list[jax.Array]:
+    """Bit-packed sort operands for the key list (see ops/keys.py): dead rows
+    last (leading ~mask bit), then per-key [null flag, value] segments packed
+    into as few uint64 words as possible; float keys ride as native f64."""
+    from . import keys as key_ops
+
+    rank_tables = rank_tables or {}
+    col_stats = col_stats or {}
+    segs: list = []
+    if include_mask:
+        segs.append(key_ops.BitSeg(1, (~batch.mask).astype(jnp.uint64)))
+    for k in keys:
+        c = batch.cols[k.col]
+        t = schema.types[k.col]
+        segs.extend(key_ops.key_segments(
+            c.data, c.valid, t, k.desc, k.effective_nulls_first(),
+            rank_table=rank_tables.get(k.col),
+            stats=col_stats.get(k.col),
+        ))
+    return key_ops.pack_operands(segs)
+
+
 def sort_perm(
     batch: Batch,
     schema: Schema,
     keys: tuple[SortKey, ...],
     rank_tables: dict[int, np.ndarray] | None = None,
+    col_stats: dict[int, tuple] | None = None,
 ) -> jax.Array:
-    """Stable permutation ordering live rows by keys, dead rows last."""
-    rank_tables = rank_tables or {}
+    """Stable permutation ordering live rows by keys, dead rows last.
+
+    Stability comes from the row index participating as the FINAL sort key
+    (equal-key rows order by original position) — measurably cheaper to
+    compile on TPU than is_stable=True with the index as payload."""
     cap = batch.capacity
-    operands: list[jax.Array] = [~batch.mask]
-    for k in keys:
-        c = batch.cols[k.col]
-        t = schema.types[k.col]
-        operands.extend(order_keys(c.data, c.valid, k, t, rank_tables.get(k.col)))
+    operands = pack_sort_operands(batch, schema, keys, rank_tables, col_stats)
     perm = jnp.arange(cap, dtype=jnp.int32)
-    res = jax.lax.sort(operands + [perm], num_keys=len(operands), is_stable=True)
+    res = jax.lax.sort(operands + [perm], num_keys=len(operands) + 1)
     return res[-1]
 
 
@@ -114,8 +143,11 @@ def sort_batch(
     schema: Schema,
     keys: tuple[SortKey, ...],
     rank_tables: dict[int, np.ndarray] | None = None,
+    col_stats: dict[int, tuple] | None = None,
 ) -> Batch:
-    return apply_perm(batch, sort_perm(batch, schema, keys, rank_tables))
+    return apply_perm(
+        batch, sort_perm(batch, schema, keys, rank_tables, col_stats)
+    )
 
 
 def limit_mask(batch: Batch, limit: int, offset: int = 0) -> Batch:

@@ -69,6 +69,7 @@ class _Lowering:
         self.factor = factor
         self.scan_specs: list[tuple[str, tuple[str, ...], int]] = []
         self.overflows: list[jax.Array] = []  # collected during tracing
+        self.emit_cache: dict = {}  # per-trace shared-subtree results
 
     # -- helpers ------------------------------------------------------------
 
@@ -114,10 +115,35 @@ class _Lowering:
     # -- node dispatch ------------------------------------------------------
 
     def lower(self, plan: S.PlanNode) -> _LNode:
+        # memoize by plan-node identity: DAG-shaped plans (a shared subtree
+        # feeding two consumers, e.g. q15's max-revenue branch) lower — and
+        # therefore trace and COMPUTE — once inside the single SPMD program
+        memo = getattr(self, "_memo", None)
+        if memo is None:
+            memo = self._memo = {}
+        ln = memo.get(id(plan))
+        if ln is not None:
+            return ln
         m = getattr(self, f"_lower_{type(plan).__name__.lower()}", None)
         if m is None:
             raise TypeError(f"cannot lower {type(plan).__name__}")
-        return m(plan)
+        ln = m(plan)
+        # cache emit RESULTS per trace as well: two consumers of a shared
+        # subtree reuse the same traced value instead of emitting the whole
+        # subgraph twice (emit_cache is cleared by local_fn per trace)
+        orig_emit = ln.emit
+        lowering = self
+
+        def cached_emit(env, _key=id(plan)):
+            r = lowering.emit_cache.get(_key)
+            if r is None:
+                r = orig_emit(env)
+                lowering.emit_cache[_key] = r
+            return r
+
+        ln = _LNode(cached_emit, ln.schema, ln.dicts, ln.replicated, ln.cap)
+        memo[id(plan)] = ln
+        return ln
 
     def _lower_tablescan(self, plan: S.TableScan) -> _LNode:
         table = self.catalog.get(plan.table)
@@ -519,7 +545,9 @@ class DistributedQuery:
 
         def local_fn(*scan_batches):
             low.overflows = []
+            low.emit_cache = {}
             out = root.emit(list(scan_batches))
+            low.emit_cache = {}
             if low.overflows:
                 ovf = sum(jnp.asarray(o, jnp.int32) for o in low.overflows)
             else:

@@ -520,9 +520,14 @@ class Binder:
         return rel
 
     def _base_col_non_nullable(self, table: str, col: str) -> bool:
-        """Whether a base-table column provably holds no NULLs. Tables are
-        static preloaded data, so inspecting the valid bitmap is sound."""
-        v = self.catalog.get(table).valids.get(col)
+        """Whether a base-table column provably holds no NULLs. Host tables
+        are static preloaded data, so inspecting the valid bitmap is sound;
+        KV-backed tables expose no host bitmap (nullability is decoded on
+        device) and conservatively report nullable."""
+        valids = getattr(self.catalog.get(table), "valids", None)
+        if valids is None:
+            return False
+        v = valids.get(col)
         return v is None or bool(np.asarray(v).all())
 
     def _require_non_nullable(self, ident: P.Ident, scope, what: str) -> None:

@@ -337,9 +337,24 @@ class Rel:
                     "dictionary (codes are dictionary-relative)"
                 )
         # a column with a dictionary on only ONE side is allowed solely for
-        # all-NULL arms (e.g. outer joins' null-extended side); non-NULL
-        # codes from the dict-less side would decode through the wrong
-        # dictionary
+        # provably all-NULL arms (e.g. outer joins' null-extended side);
+        # non-NULL codes from the dict-less side would decode through the
+        # wrong/absent dictionary — enforced, not assumed
+        def _all_null_col(rel: "Rel", i: int) -> bool:
+            p = rel.plan
+            return (isinstance(p, S.Project)
+                    and isinstance(p.exprs[i], ex.Const)
+                    and p.exprs[i].value is None)
+
+        for i in set(self.dicts) ^ set(other.dicts):
+            dictless = other if i in self.dicts else self
+            if (self.schema.types[i].family is Family.STRING
+                    and not _all_null_col(dictless, i)):
+                raise ValueError(
+                    f"UNION ALL column {i}: one arm is dictionary-coded and "
+                    "the other is not provably all-NULL; codes would decode "
+                    "through the wrong dictionary"
+                )
         node = S.Union((self.plan, other.plan))
         return Rel(self.catalog, node, self.schema, dict(self.dicts))
 

@@ -136,7 +136,13 @@ class _Memtable:
 
 
 class Engine:
-    """MVCC LSM engine over device-resident sorted runs."""
+    """MVCC LSM engine over device-resident sorted runs.
+
+    Durability scope: with the default ``wal_fsync=False`` the WAL is written
+    through the OS page cache only — acknowledged writes survive PROCESS
+    crashes but can be lost on machine/kernel crashes. Pass ``wal_fsync=True``
+    for fsync-per-record durability (Pebble's WAL sync default), at a large
+    single-writer throughput cost."""
 
     def __init__(
         self,

@@ -138,9 +138,12 @@ def randomize_metamorphic(rng) -> dict[str, Any]:
 # The framework's own settings (the ~700-setting registry's seed)
 
 TILE_SIZE = register_int(
-    "sql.distsql.tile_size", 4096,
-    "static tile capacity for scan batches (coldata batch size analog)",
-    lo=128, hi=65536, metamorphic=True,
+    "sql.distsql.tile_size", 1 << 20,
+    "static tile capacity for scan batches (coldata batch size analog). "
+    "Large tiles amortize XLA dispatch latency (~70ms/round over the TPU "
+    "tunnel) and keep sorts/gathers wide; resident tables pad to a tile "
+    "multiple so no kernel ever compiles at full-table shape",
+    lo=128, hi=1 << 24, metamorphic=True,
 )
 L0_COMPACTION = register_int(
     "storage.l0_compaction_threshold", 4,

@@ -23,7 +23,7 @@ def _reset_settings():
 
 
 def test_settings_registry():
-    assert settings.get("sql.distsql.tile_size") == 4096
+    assert settings.get("sql.distsql.tile_size") == 1 << 20
     settings.set("sql.distsql.tile_size", 1024)
     assert settings.get("sql.distsql.tile_size") == 1024
     with pytest.raises(ValueError):
@@ -31,7 +31,7 @@ def test_settings_registry():
     with pytest.raises(TypeError):
         settings.set("sql.distsql.dense_agg.enabled", "sideways")
     settings.reset("sql.distsql.tile_size")
-    assert settings.get("sql.distsql.tile_size") == 4096
+    assert settings.get("sql.distsql.tile_size") == 1 << 20
     assert "storage.l0_compaction_threshold" in settings.all_settings()
 
 
