@@ -285,10 +285,19 @@ def _bench_query(qname, cat, nrows, runs):
 
 
 _partial = {"detail": {}, "errors": [], "sf": 1.0, "platform": "unknown"}
+_emit_lock = __import__("threading").Lock()
+_emitted = False
 
 
 def _emit(final: bool) -> None:
-    """Assemble and print the one-line JSON from whatever has completed."""
+    """Assemble and print the one-line JSON from whatever has completed.
+    Guarded so the deadline timer and the main thread can never both print
+    (the contract is exactly ONE JSON line)."""
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            return
+        _emitted = True
     detail = _partial["detail"]
     errors = list(_partial["errors"])
     if not detail:

@@ -759,6 +759,17 @@ class HashJoinOp(OneInputOperator):
                 self.build_code_remaps[pos] = np.array(
                     [pd.code_of(str(v)) for v in bd.values], dtype=np.int32
                 )
+        # exact packed keys when every key column is bounded (catalog stats /
+        # dictionary sizes): probes become control-flow-free — no hash, no
+        # collision loop, no per-column verification gathers
+        self.exact_layout = join_ops.plan_exact_key(
+            probe.output_schema, probe_keys,
+            build.output_schema, build_keys,
+            probe.col_stats, build.col_stats,
+            {pk: len(probe.dictionaries[pk]) for pk in probe_keys
+             if pk in probe.dictionaries},
+            have_remaps=True,
+        )
         self._built = False
 
     def init(self):
@@ -770,11 +781,15 @@ class HashJoinOp(OneInputOperator):
         bschema = self.build.output_schema
         bkeys = self.build_keys
         bht = self.build_hash_tables or None
+        layout = self.exact_layout
+        eremaps = self.build_code_remaps or None
 
         @functools.partial(jax.jit, static_argnames=("cap",))
         def build_fn(tiles, cap):
             big = concat(list(tiles), capacity=cap)
-            index = join_ops.build_index(big, bschema, bkeys, bht)
+            index = join_ops.build_index(big, bschema, bkeys, bht,
+                                         exact_layout=layout,
+                                         exact_remaps=eremaps)
             return big, index
 
         self._build_fn = build_fn
@@ -789,7 +804,7 @@ class HashJoinOp(OneInputOperator):
             def probe_raw(p, build, index):
                 return join_ops.hash_join_unique(
                     p, pschema, pkeys, build, bschema, bkeys, spec,
-                    pht, bht, remaps, index=index,
+                    pht, bht, remaps, index=index, exact_layout=layout,
                 )
 
             self._probe_raw = probe_raw
@@ -803,6 +818,7 @@ class HashJoinOp(OneInputOperator):
                     out_capacity=1,
                     probe_hash_tables=pht, build_hash_tables=bht,
                     build_code_remaps=remaps, index=index,
+                    exact_layout=layout,
                 )
                 return out
 
@@ -815,7 +831,7 @@ class HashJoinOp(OneInputOperator):
             def probe_gen_fn(p, build, index, out_cap):
                 return join_ops.hash_join_general(
                     p, pschema, pkeys, build, bschema, bkeys, spec, out_cap,
-                    pht, bht, remaps, index=index,
+                    pht, bht, remaps, index=index, exact_layout=layout,
                 )
 
             self._probe_gen_fn = probe_gen_fn
@@ -842,8 +858,24 @@ class HashJoinOp(OneInputOperator):
     def children(self):
         return [self.child, self.build]
 
+    def fused_depth(self) -> int:
+        d = 1
+        op = self.child
+        while op is not None:
+            if isinstance(op, (HashJoinOp, MergeJoinOp)):
+                d += 1
+            op = getattr(op, "child", None)
+        return d
+
     def stream_parts(self):
+        from ..utils import settings
+
         if self._probe_raw is None:
+            return None
+        if self.fused_depth() > settings.get("sql.distsql.max_fused_joins"):
+            # compile-size safety valve: very deep probe pipelines split at
+            # this join (it runs as its own per-operator jit) so one fused
+            # segment never accretes unbounded XLA program size
             return None
         parts = self.child.stream_parts()
         if parts is None:

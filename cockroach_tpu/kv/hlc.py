@@ -36,19 +36,25 @@ class Clock:
     def __init__(self, wall_fn=None):
         self._wall_fn = wall_fn or (lambda: int(time.time() * 1e3))
         self._last = 0
+        self._ticks = 0  # local increments since the wall last advanced
 
     def now(self) -> int:
         wall = self._wall_fn()
         ts = pack(wall, 0)
         if ts <= self._last:
-            ts = self._last + 1
-            if (ts & LOGICAL_MASK) == 0:
-                # logical field saturated: 2^20 ticks were issued inside one
-                # wall millisecond and the increment carried into the wall
-                # component — surface it rather than silently drifting
+            # count LOCAL saturation only: a remote timestamp ingested by
+            # update() may legitimately carry a large logical component (the
+            # clock absorbs skew by running ahead), so the overflow signal is
+            # "2^20 local ticks without wall progress", not a carry bit
+            self._ticks += 1
+            if self._ticks > LOGICAL_MASK:
                 raise OverflowError(
-                    "hlc logical counter saturated within one millisecond"
+                    "hlc logical counter saturated: 2^20 local ticks "
+                    "without wall-clock progress"
                 )
+            ts = self._last + 1
+        else:
+            self._ticks = 0
         self._last = ts
         return ts
 

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..coldata.batch import Batch, Column
-from ..coldata.types import Schema
+from ..coldata.types import Family, Schema
 from .hashing import hash_columns
 
 _SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
@@ -39,6 +39,121 @@ _SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
 class JoinSpec:
     join_type: str = "inner"  # inner | left | semi | anti
     build_unique: bool = True
+
+
+# ---------------------------------------------------------------------------
+# Exact packed join keys
+#
+# When every join-key column has known bounds (catalog stats for ints/dates/
+# decimals; dictionary size for strings), the multi-column key bit-packs
+# EXACTLY into one uint64. Key equality then IS packed-word equality: the
+# probe needs no hash, no collision-advance while_loop and no per-column
+# key-verification gathers — on TPU that turns the probe into straight-line
+# gathers, an order of magnitude cheaper to XLA-compile than control flow.
+# The hash path below remains the fallback for unbounded keys.
+
+
+@dataclass(frozen=True)
+class ExactKeyLayout:
+    """Per key position: (kind, lo, bits). kind 'int' encodes (x - lo);
+    kind 'str' uses probe dictionary codes (build codes remapped host-side,
+    absent values -> the never-matching code 2**bits - 1)."""
+
+    segs: tuple[tuple[str, int, int], ...]
+    total_bits: int
+
+
+def plan_exact_key(
+    probe_schema: Schema,
+    probe_keys: tuple[int, ...],
+    build_schema: Schema,
+    build_keys: tuple[int, ...],
+    probe_stats: dict | None,
+    build_stats: dict | None,
+    probe_dict_sizes: dict | None,
+    have_remaps: bool,
+) -> ExactKeyLayout | None:
+    """Try to plan an exact packed key; None when any column is unbounded."""
+    from .keys import bits_for_count
+
+    probe_stats = probe_stats or {}
+    build_stats = build_stats or {}
+    probe_dict_sizes = probe_dict_sizes or {}
+    segs = []
+    total = 0
+    for pk, bk in zip(probe_keys, build_keys):
+        t = probe_schema.types[pk]
+        if t.family is Family.STRING:
+            if not have_remaps or pk not in probe_dict_sizes:
+                return None
+            n = probe_dict_sizes[pk]
+            bits = bits_for_count(n + 2)  # probe codes + absent sentinel
+            segs.append(("str", 0, bits))
+        elif t.family in (Family.FLOAT, Family.BYTES, Family.JSON):
+            return None
+        elif t.family is Family.BOOL:
+            segs.append(("int", 0, 1))
+            bits = 1
+        else:
+            ps = probe_stats.get(pk)
+            bs = build_stats.get(bk)
+            if ps is None or bs is None:
+                return None
+            lo = min(int(ps[0]), int(bs[0]))
+            hi = max(int(ps[1]), int(bs[1]))
+            bits = bits_for_count(hi - lo + 1)
+            segs.append(("int", lo, bits))
+        total += segs[-1][2]
+    if total > 63:
+        return None
+    return ExactKeyLayout(tuple(segs), total)
+
+
+def exact_keys(
+    batch: Batch,
+    keys: tuple[int, ...],
+    layout: ExactKeyLayout,
+    code_remaps: dict | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(packed u64 key, active) — NULL-key and dead rows get the sentinel
+    (which no packed key can equal: total_bits <= 63)."""
+    k = jnp.zeros((batch.capacity,), jnp.uint64)
+    active = batch.mask
+    for pos, (ki, (kind, lo, bits)) in enumerate(zip(keys, layout.segs)):
+        c = batch.cols[ki]
+        active = active & c.valid
+        if kind == "str":
+            v = c.data.astype(jnp.int64)
+            if code_remaps is not None and pos in code_remaps:
+                remap = jnp.asarray(code_remaps[pos]).astype(jnp.int64)
+                v = remap[jnp.clip(v, 0, remap.shape[0] - 1)]
+            # absent-in-probe-dict (-1) -> the never-matching top code
+            v = jnp.where(v < 0, jnp.int64((1 << bits) - 1), v)
+        else:
+            v = c.data.astype(jnp.int64) - lo
+        k = (k << np.uint64(bits)) | (
+            v.astype(jnp.uint64) & jnp.uint64((1 << bits) - 1)
+        )
+    return jnp.where(active, k, _SENTINEL), active
+
+
+def bsearch(sorted_u64: jax.Array, queries: jax.Array,
+            side: str = "left") -> jax.Array:
+    """Branchless UNROLLED binary search (log2(n) static gather+select
+    steps). Replaces jnp.searchsorted, whose lax.scan lowering is far more
+    expensive for XLA:TPU to compile inside fused query kernels."""
+    n = sorted_u64.shape[0]
+    bits = max(1, int(n - 1).bit_length()) if n > 1 else 1
+    pos = jnp.zeros(queries.shape, jnp.int32)
+    for sb in range(bits - 1, -1, -1):
+        cand = pos + (1 << sb)
+        v = sorted_u64[jnp.clip(cand - 1, 0, n - 1)]
+        if side == "left":
+            ok = (cand <= n) & (v < queries)
+        else:
+            ok = (cand <= n) & (v <= queries)
+        pos = jnp.where(ok, cand, pos)
+    return pos
 
 
 def _key_hashes(batch: Batch, keys: tuple[int, ...], schema: Schema, hash_tables):
@@ -73,18 +188,29 @@ def _keys_equal(probe: Batch, pkeys, build: Batch, bkeys, bidx, build_remaps=Non
 
 
 def build_index(
-    build: Batch, schema: Schema, keys: tuple[int, ...], hash_tables=None
+    build: Batch, schema: Schema, keys: tuple[int, ...], hash_tables=None,
+    exact_layout: ExactKeyLayout | None = None, exact_remaps=None,
 ):
-    """Sort build rows by key hash -> (sorted_hashes, orig_index). NULL-key and
-    dead rows hash to the max sentinel and sort to the end."""
-    bh, _ = _key_hashes(build, keys, schema, hash_tables)
+    """Sort build rows by key (exact packed key when the layout allows, else
+    64-bit hash) -> (sorted_keys, orig_index). NULL-key and dead rows get
+    the max sentinel and sort to the end."""
+    if exact_layout is not None:
+        if (exact_remaps is None
+                and any(k == "str" for k, _, _ in exact_layout.segs)):
+            raise ValueError(
+                "exact STRING join keys need build-code remaps (pass "
+                "exact_remaps or a precomputed index)"
+            )
+        bh, _ = exact_keys(build, keys, exact_layout, exact_remaps)
+    else:
+        bh, _ = _key_hashes(build, keys, schema, hash_tables)
     perm = jnp.arange(build.capacity, dtype=jnp.int32)
     sh, order = jax.lax.sort([bh, perm], num_keys=1)
     return sh, order
 
 
 def _probe_positions(sh, ph):
-    return jnp.searchsorted(sh, ph, side="left").astype(jnp.int32)
+    return bsearch(sh, ph, side="left")
 
 
 def hash_join_unique(
@@ -99,48 +225,65 @@ def hash_join_unique(
     build_hash_tables=None,
     build_code_remaps=None,
     index=None,
+    exact_layout: ExactKeyLayout | None = None,
+    exact_remaps=None,
 ) -> Batch:
     """Join with unique build keys. Output tile is probe-capacity:
     probe columns followed by build columns (semi/anti: probe columns only).
     `index` is an optional precomputed build_index() result so the build-side
-    sort runs once per build batch, not once per probe tile."""
+    sort runs once per build batch, not once per probe tile.
+
+    With an exact_layout the probe is control-flow-free: one unrolled binary
+    search + one equality compare (packed-key equality IS key equality).
+    The hash path verifies columns and advances past 64-bit collisions."""
     cap = probe.capacity
     bcap = build.capacity
     sh, order = index if index is not None else build_index(
-        build, build_schema, build_keys, build_hash_tables
+        build, build_schema, build_keys, build_hash_tables,
+        exact_layout=exact_layout, exact_remaps=exact_remaps,
     )
-    ph, p_active = _key_hashes(probe, probe_keys, probe_schema, probe_hash_tables)
-    pos = _probe_positions(sh, jnp.where(p_active, ph, _SENTINEL))
-
-    def cond(state):
-        _, _, active, _ = state
-        return jnp.any(active)
-
-    def body(state):
-        pos, found_idx, active, found = state
-        inb = pos < bcap
+    if exact_layout is not None:
+        ph, p_active = exact_keys(probe, probe_keys, exact_layout)
+        pos = _probe_positions(sh, ph)
         posc = jnp.clip(pos, 0, bcap - 1)
-        bidx = order[posc]
-        hash_eq = inb & (sh[posc] == ph) & active
-        key_eq = _keys_equal(
-            probe, probe_keys, build, build_keys, bidx, build_code_remaps
+        found_idx = order[posc]
+        found = (pos < bcap) & (sh[posc] == ph) & p_active
+        found = found & build.mask[found_idx]
+    else:
+        ph, p_active = _key_hashes(
+            probe, probe_keys, probe_schema, probe_hash_tables
         )
-        hit = hash_eq & key_eq
-        found_idx = jnp.where(hit, bidx, found_idx)
-        found = found | hit
-        # advance only on hash collision with key mismatch
-        advance = hash_eq & ~key_eq
-        return pos + advance, found_idx, advance, found
+        pos = _probe_positions(sh, jnp.where(p_active, ph, _SENTINEL))
 
-    init = (
-        pos,
-        jnp.zeros((cap,), jnp.int32),
-        p_active,
-        jnp.zeros((cap,), jnp.bool_),
-    )
-    _, found_idx, _, found = jax.lax.while_loop(cond, body, init)
-    # guard against sentinel-hash self-matches
-    found = found & p_active & build.mask[found_idx]
+        def cond(state):
+            _, _, active, _ = state
+            return jnp.any(active)
+
+        def body(state):
+            pos, found_idx, active, found = state
+            inb = pos < bcap
+            posc = jnp.clip(pos, 0, bcap - 1)
+            bidx = order[posc]
+            hash_eq = inb & (sh[posc] == ph) & active
+            key_eq = _keys_equal(
+                probe, probe_keys, build, build_keys, bidx, build_code_remaps
+            )
+            hit = hash_eq & key_eq
+            found_idx = jnp.where(hit, bidx, found_idx)
+            found = found | hit
+            # advance only on hash collision with key mismatch
+            advance = hash_eq & ~key_eq
+            return pos + advance, found_idx, advance, found
+
+        init = (
+            pos,
+            jnp.zeros((cap,), jnp.int32),
+            p_active,
+            jnp.zeros((cap,), jnp.bool_),
+        )
+        _, found_idx, _, found = jax.lax.while_loop(cond, body, init)
+        # guard against sentinel-hash self-matches
+        found = found & p_active & build.mask[found_idx]
 
     if spec.join_type == "semi":
         return probe.with_mask(probe.mask & found)
@@ -174,6 +317,8 @@ def hash_join_general(
     build_hash_tables=None,
     build_code_remaps=None,
     index=None,
+    exact_layout: ExactKeyLayout | None = None,
+    exact_remaps=None,
 ):
     """General join (duplicate build keys). Returns (out_batch, total_rows);
     if total_rows > out_capacity the caller must retry with a larger tile
@@ -181,12 +326,19 @@ def hash_join_general(
     cap = probe.capacity
     bcap = build.capacity
     sh, order = index if index is not None else build_index(
-        build, build_schema, build_keys, build_hash_tables
+        build, build_schema, build_keys, build_hash_tables,
+        exact_layout=exact_layout, exact_remaps=exact_remaps,
     )
-    ph, p_active = _key_hashes(probe, probe_keys, probe_schema, probe_hash_tables)
-    phs = jnp.where(p_active, ph, _SENTINEL)
-    lo = jnp.searchsorted(sh, phs, side="left").astype(jnp.int32)
-    hi = jnp.searchsorted(sh, phs, side="right").astype(jnp.int32)
+    if exact_layout is not None:
+        ph, p_active = exact_keys(probe, probe_keys, exact_layout)
+        phs = ph
+    else:
+        ph, p_active = _key_hashes(
+            probe, probe_keys, probe_schema, probe_hash_tables
+        )
+        phs = jnp.where(p_active, ph, _SENTINEL)
+    lo = bsearch(sh, phs, side="left")
+    hi = bsearch(sh, phs, side="right")
     run = jnp.where(p_active, hi - lo, 0)
     max_run = jnp.max(run)
 
@@ -194,6 +346,9 @@ def hash_join_general(
         posc = jnp.clip(lo + k, 0, bcap - 1)
         bidx = order[posc]
         valid_k = (k < run) & p_active & build.mask[bidx]
+        if exact_layout is not None:
+            # packed-key equality is exact: the [lo, hi) run IS the match set
+            return bidx, valid_k
         return bidx, valid_k & _keys_equal(
             probe, probe_keys, build, build_keys, bidx, build_code_remaps
         )

@@ -181,16 +181,24 @@ class Scope:
 
 
 class ExprLowerer:
-    """Lower AST expressions against one Rel's schema (after joins)."""
+    """Lower AST expressions against one Rel's schema (after joins).
 
-    def __init__(self, rel: Rel, names: dict[str, int] | None = None):
+    resolver, when given, maps an Ident to a column POSITION via the query's
+    scope + join column map — the only correct resolution once a self-join
+    has produced duplicate column names in the joined schema."""
+
+    def __init__(self, rel: Rel, names: dict[str, int] | None = None,
+                 resolver=None):
         self.rel = rel
+        self.resolver = resolver
         # name -> column index (defaults to the rel's schema)
         self.names = names or {
             n: i for i, n in enumerate(rel.schema.names)
         }
 
     def idx(self, ident: P.Ident) -> int:
+        if self.resolver is not None:
+            return self.resolver(ident)
         if ident.name in self.names:
             return self.names[ident.name]
         raise BindError(f"unknown column {ident.name}")
@@ -204,6 +212,22 @@ class ExprLowerer:
 
     def _colname(self, i: int) -> str:
         return self.rel.schema.names[i]
+
+    # positional string-predicate helpers: Rel's name-based str_* entry
+    # points mis-resolve duplicate names after self-joins, so the lowerer
+    # builds the dictionary-code lookups itself from a column POSITION
+    def _str_pred_at(self, i: int, fn) -> ex.Expr:
+        d = self.rel.dicts[i]
+        table = np.array([bool(fn(str(v))) for v in d.values])
+        if len(table) == 0:
+            table = np.zeros(1, dtype=bool)
+        return ex.CodeLookup(col=i, table=table)
+
+    def _str_eq_at(self, i: int, value: str) -> ex.Expr:
+        from ..coldata.types import INT32
+
+        code = self.rel.dicts[i].code_of(value)
+        return ex.Cmp("eq", ex.ColRef(i), ex.Const(code, INT32))
 
     def lower(self, e: P.Node) -> ex.Expr:
         e = _fold(e)
@@ -238,9 +262,7 @@ class ExprLowerer:
             if i is None:
                 raise BindError("LIKE requires a string column")
             rx = _like_regex(e.pattern)
-            pred = self.rel.str_pred(
-                self._colname(i), lambda s: rx.match(s) is not None
-            )
+            pred = self._str_pred_at(i, lambda s: rx.match(s) is not None)
             return ex.Not(pred) if e.negated else pred
         if isinstance(e, P.InList):
             i = self._is_string_col(e.arg)
@@ -250,7 +272,8 @@ class ExprLowerer:
                 ]
                 if len(vals) != len(e.items):
                     raise BindError("string IN list must be all literals")
-                pred = self.rel.str_in(self._colname(i), vals)
+                vset = set(vals)
+                pred = self._str_pred_at(i, lambda s: s in vset)
                 return ex.Not(pred) if e.negated else pred
             if (isinstance(e.arg, P.FuncCall)
                     and e.arg.name == "substring"):
@@ -296,16 +319,21 @@ class ExprLowerer:
         for a, b, flip in ((e.left, e.right, False), (e.right, e.left, True)):
             i = self._is_string_col(a)
             if i is not None and isinstance(b, P.StrLit):
-                name = self._colname(i)
                 op = e.op
                 if flip:
                     op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
                           "eq": "eq", "ne": "ne"}[op]
                 if op == "eq":
-                    return self.rel.str_eq(name, b.value)
+                    return self._str_eq_at(i, b.value)
                 if op == "ne":
-                    return ex.Not(self.rel.str_eq(name, b.value))
-                return self.rel.str_cmp(name, op, b.value)
+                    return ex.Not(self._str_eq_at(i, b.value))
+                import operator as _op
+
+                fns = {"lt": _op.lt, "le": _op.le, "gt": _op.gt,
+                       "ge": _op.ge}
+                return self._str_pred_at(
+                    i, lambda s: fns[op](s, b.value)
+                )
         # substring(col from a for n) = 'lit'  (Q22 country-code pattern)
         if (isinstance(e.left, P.FuncCall) and e.left.name == "substring"
                 and isinstance(e.right, P.StrLit)):
@@ -339,9 +367,7 @@ class ExprLowerer:
         start = int(fc.args[1].value) - 1
         n = int(fc.args[2].value)
         vals = {x.value for x in e.items}
-        pred = self.rel.str_pred(
-            self._colname(i), lambda s: s[start:start + n] in vals
-        )
+        pred = self._str_pred_at(i, lambda s: s[start:start + n] in vals)
         return ex.Not(pred) if e.negated else pred
 
 
@@ -352,8 +378,14 @@ class ExprLowerer:
 class Binder:
     def __init__(self, catalog: Catalog):
         self.catalog = catalog
+        self.ctes: dict[str, Rel] = {}
 
     def bind(self, sel: P.Select) -> Rel:
+        for name, csel in sel.ctes:
+            # CTEs bind once; every reference shares the one plan subtree
+            # (the distributed lowering memoizes shared subtrees, so a CTE
+            # used twice computes once inside the SPMD program)
+            self.ctes[name] = self.bind(csel)
         sources, join_filters = self._bind_from(sel.from_)
         scope = Scope(sources)
 
@@ -365,6 +397,7 @@ class Binder:
         per_source: dict[int, list[P.Node]] = {}
         residual: list[P.Node] = []
         sub_joins: list[tuple[P.Node, set[int]]] = []
+        corr_scalars: list[P.Node] = []
         for c in conjuncts:
             if isinstance(c, (P.Exists, P.InSelect)) or (
                 isinstance(c, P.Not)
@@ -373,6 +406,11 @@ class Binder:
                 node = c.arg if isinstance(c, P.Not) else c
                 negate = isinstance(c, P.Not)
                 sub_joins.append((node, negate))
+                continue
+            sub = next((x for x in _walk(c)
+                        if isinstance(x, P.ScalarSubquery)), None)
+            if sub is not None and self._scalar_sub_is_correlated(sub):
+                corr_scalars.append(c)
                 continue
             if isinstance(c, P.Cmp) and c.op == "eq" and \
                     isinstance(c.left, P.Ident) and isinstance(c.right, P.Ident):
@@ -385,6 +423,10 @@ class Binder:
             if len(srcs) == 1:
                 per_source.setdefault(next(iter(srcs)), []).append(c)
             else:
+                # an OR whose every branch repeats the same equi-join edge
+                # (TPC-H q19's shape) contributes that edge to the join
+                # graph; the full OR stays as a post-join filter
+                equi_edges.extend(self._or_common_equis(c, scope))
                 residual.append(c)
 
         # scalar subqueries inside residual/per-source conjuncts: execute
@@ -406,15 +448,37 @@ class Binder:
         for node, negate in sub_joins:
             joined = self._apply_sub_join(joined, node, negate, scope, sources)
 
+        resolver = self._make_resolver(scope, joined)
+
+        # correlated scalar subqueries: decorrelate into a grouped join
+        for c in corr_scalars:
+            joined = self._apply_corr_scalar(joined, c, scope)
+            resolver = self._make_resolver(scope, joined)
+
         # residual multi-source predicates
         if residual:
-            lower = ExprLowerer(joined.rel)
             for c in residual:
+                lower = ExprLowerer(joined.rel, resolver=resolver)
                 joined.rel = joined.rel.filter(
                     self._lower_with_subqueries(lower, c))
-                lower = ExprLowerer(joined.rel)
 
-        return self._finish(sel, joined.rel)
+        return self._finish(sel, joined.rel, resolver)
+
+    @staticmethod
+    def _make_resolver(scope: Scope, joined: "BoundQuery"):
+        """Ident -> joined-schema POSITION via scope + join column map;
+        required once self-joins duplicate names in the joined schema."""
+        if joined.colmap is None:
+            return None
+
+        def resolve(ident: P.Ident) -> int:
+            i, n = scope.resolve(ident)
+            pos = joined.colmap.get((i, n))
+            if pos is None:
+                raise BindError(f"column {n} not available after join")
+            return pos
+
+        return resolve
 
     # -- FROM ---------------------------------------------------------------
 
@@ -423,7 +487,12 @@ class Binder:
         join_filters: list[P.Node] = []
 
         def bind_item(it):
-            if isinstance(it, P.TableRef):
+            if isinstance(it, P.TableRef) and it.name in self.ctes:
+                rel = self.ctes[it.name]
+                sources.append(
+                    Source(it.alias or it.name, rel, rel.schema.names)
+                )
+            elif isinstance(it, P.TableRef):
                 rel = Rel.scan(self.catalog, it.name)
                 sources.append(
                     Source(it.alias or it.name, rel, rel.schema.names,
@@ -433,16 +502,14 @@ class Binder:
             elif isinstance(it, P.SubqueryRef):
                 rel = self.bind(it.select)
                 sources.append(Source(it.alias, rel, rel.schema.names))
-            elif isinstance(it, P.Join):
+            elif isinstance(it, P.Join) and it.kind == "inner":
                 bind_item(it.left)
                 bind_item(it.right)
                 # ON conjuncts go into the shared predicate pool; the join
-                # planner extracts the equi keys (left-join ON handled below)
-                if it.kind != "inner":
-                    raise BindError(
-                        "outer joins are planned explicitly (future work)"
-                    )
+                # planner extracts the equi keys
                 join_filters.extend(_conjuncts(it.on))
+            elif isinstance(it, P.Join) and it.kind == "left":
+                sources.append(self._bind_left_join(it))
             else:
                 raise BindError(f"unsupported FROM item {it}")
 
@@ -450,34 +517,85 @@ class Binder:
             bind_item(it)
         return sources, join_filters
 
+    def _bind_left_join(self, it: P.Join) -> Source:
+        """LEFT OUTER JOIN of two primaries -> one combined source.
+
+        ON conjuncts split into equi keys and single-side predicates; a
+        right-only predicate filters the build side BEFORE the outer join
+        (ON-clause semantics: a failed predicate null-extends rather than
+        dropping the left row). Left-only ON predicates would need a
+        post-join mask and are refused."""
+        sub_sources, _ = self._bind_from([it.left, it.right])
+        if len(sub_sources) != 2:
+            raise BindError("nested outer joins not supported")
+        left, right = sub_sources
+        sub_scope = Scope([left, right])
+        keys: list[tuple[str, str]] = []
+        for c in _conjuncts(it.on):
+            c = _fold(c)
+            if (isinstance(c, P.Cmp) and c.op == "eq"
+                    and isinstance(c.left, P.Ident)
+                    and isinstance(c.right, P.Ident)):
+                li, ln = sub_scope.resolve(c.left)
+                ri, rn = sub_scope.resolve(c.right)
+                if {li, ri} == {0, 1}:
+                    keys.append((ln, rn) if li == 0 else (rn, ln))
+                    continue
+            srcs = sub_scope.sources_of(c)
+            if srcs == {1}:
+                lower = ExprLowerer(right.rel)
+                right = Source(right.alias, right.rel.filter(lower.lower(c)),
+                               right.cols, right.base_rows, right.table)
+            else:
+                raise BindError(
+                    "LEFT JOIN ON supports equi keys and right-side "
+                    "predicates only"
+                )
+        if not keys:
+            raise BindError("LEFT JOIN requires at least one equi key")
+        rel = left.rel.join(right.rel, on=keys, how="left",
+                            build_unique=False)
+        return Source(
+            alias=f"{left.alias}*{right.alias}", rel=rel,
+            cols=rel.schema.names, base_rows=left.base_rows,
+        )
+
     # -- join planning ------------------------------------------------------
 
     def _join_sources(self, sources, equi_edges, scope) -> "BoundQuery":
         n = len(sources)
         if n == 1:
-            return BoundQuery(sources[0].rel, {0: sources[0]})
+            colmap = {(0, c): i
+                      for i, c in enumerate(sources[0].rel.schema.names)}
+            return BoundQuery(sources[0].rel, {0: sources[0]}, colmap)
         sizes = [s.base_rows for s in sources]
         start = max(range(n), key=lambda i: sizes[i])
         placed = {start}
         rel = sources[start].rel
+        colmap = {(start, c): i for i, c in enumerate(rel.schema.names)}
         while len(placed) < n:
-            # find edges from placed to unplaced
-            cand: dict[int, list[tuple[str, str]]] = {}
+            # find edges from placed to unplaced (join keys resolved to
+            # POSITIONS on the probe side via colmap — names can repeat)
+            cand: dict[int, list[tuple[int, str]]] = {}
             for li, ln, ri, rn in equi_edges:
                 if li in placed and ri not in placed:
-                    cand.setdefault(ri, []).append((ln, rn))
+                    cand.setdefault(ri, []).append((colmap[(li, ln)], rn))
                 elif ri in placed and li not in placed:
-                    cand.setdefault(li, []).append((rn, ln))
+                    cand.setdefault(li, []).append((colmap[(ri, rn)], ln))
             if not cand:
                 raise BindError("cross join required but not supported")
             # smallest build side first
             nxt = min(cand, key=lambda i: sizes[i])
-            on = cand[nxt]
+            on = cand[nxt]  # (probe POSITION, build name) pairs
+            off = len(rel.schema)
+            build_names = sources[nxt].rel.schema.names
             rel = rel.join(
                 sources[nxt].rel, on=on, how="inner", build_unique=False
             )
+            for i, c in enumerate(build_names):
+                colmap[(nxt, c)] = off + i
             placed.add(nxt)
-        return BoundQuery(rel, {i: sources[i] for i in placed})
+        return BoundQuery(rel, {i: sources[i] for i in placed}, colmap)
 
     def _apply_sub_join(self, joined: "BoundQuery", node, negate, scope,
                         sources) -> "BoundQuery":
@@ -496,22 +614,234 @@ class Binder:
                 # NULL checks before using anti join.
                 self._require_non_nullable(arg, scope, "NOT IN argument")
                 self._require_inner_non_nullable(node.select)
-            outer_col = arg.name
+            resolver = self._make_resolver(scope, joined)
+            outer_pos = (resolver(arg) if resolver is not None
+                         else joined.rel.idx(arg.name))
             inner_col = sub.schema.names[0]
             joined.rel = joined.rel.join(
-                sub, on=[(outer_col, inner_col)], how=how, build_unique=False
+                sub, on=[(outer_pos, inner_col)], how=how, build_unique=False
             )
             return joined
         how = "anti" if negate else "semi"
         if isinstance(node, P.Exists):
             # correlated equality conjuncts reference outer columns
             sub_sel = node.select
-            inner_rel, corr = self._bind_correlated(sub_sel, joined)
-            joined.rel = joined.rel.join(
-                inner_rel, on=corr, how=how, build_unique=False
+            inner_rel, corr, ne_pairs = self._bind_correlated(
+                sub_sel, joined)
+            resolver = self._make_resolver(scope, joined)
+
+            def opos(ident: P.Ident) -> int:
+                return (resolver(ident) if resolver is not None
+                        else joined.rel.idx(ident.name))
+
+            on_pos = [(opos(oid), iname) for oid, iname in corr]
+            if not ne_pairs:
+                joined.rel = joined.rel.join(
+                    inner_rel, on=on_pos, how=how, build_unique=False
+                )
+                return joined
+            # EXISTS with an extra `inner.s <> outer.s` correlation (TPC-H
+            # q21): aggregate the inner per correlation key to (min s,
+            # max s); some inner s differs from outer s iff min != s or
+            # max != s. NOT EXISTS additionally keeps keys with no inner
+            # rows (left join, NULL min). The reference reaches the same
+            # plans through optbuilder's apply-decorrelation rules.
+            if len(ne_pairs) != 1:
+                raise BindError("at most one <> correlation supported")
+            o_ident, i_name = ne_pairs[0]
+            grouped = inner_rel.groupby(
+                [ik for _, ik in corr],
+                [("_mn", "min", i_name), ("_mx", "max", i_name)],
+            )
+            n0 = len(joined.rel.schema)
+            names0 = joined.rel.schema.names
+            s_pos = opos(o_ident)
+            mn_pos = n0 + len(corr)
+            mx_pos = mn_pos + 1
+            if how == "semi":
+                rel = joined.rel.join(grouped, on=on_pos, how="inner",
+                                      build_unique=True)
+                pred = ex.or_(
+                    ex.Cmp("ne", ex.ColRef(mn_pos), ex.ColRef(s_pos)),
+                    ex.Cmp("ne", ex.ColRef(mx_pos), ex.ColRef(s_pos)),
+                )
+            else:
+                rel = joined.rel.join(grouped, on=on_pos, how="left",
+                                      build_unique=True)
+                pred = ex.or_(
+                    ex.IsNull(ex.ColRef(mn_pos)),
+                    ex.and_(
+                        ex.Cmp("eq", ex.ColRef(mn_pos), ex.ColRef(s_pos)),
+                        ex.Cmp("eq", ex.ColRef(mx_pos), ex.ColRef(s_pos)),
+                    ),
+                )
+            rel = rel.filter(pred)
+            joined.rel = rel.project(
+                [(names0[i], ex.ColRef(i)) for i in range(n0)]
             )
             return joined
         raise BindError(f"unsupported subquery predicate {node}")
+
+    @staticmethod
+    def _or_common_equis(c: P.Node, scope: Scope):
+        """Equi edges present in EVERY branch of an OR (hoistable to the
+        join graph; the OR itself remains a residual filter)."""
+        if not (isinstance(c, P.Bin) and c.op == "or"):
+            return []
+
+        def disjuncts(e):
+            if isinstance(e, P.Bin) and e.op == "or":
+                return disjuncts(e.left) + disjuncts(e.right)
+            return [e]
+
+        per_branch = []
+        for b in disjuncts(c):
+            eqs = set()
+            for cj in _conjuncts(b):
+                if (isinstance(cj, P.Cmp) and cj.op == "eq"
+                        and isinstance(cj.left, P.Ident)
+                        and isinstance(cj.right, P.Ident)):
+                    try:
+                        li, ln = scope.resolve(cj.left)
+                        ri, rn = scope.resolve(cj.right)
+                    except BindError:
+                        continue
+                    if li != ri:
+                        key = ((li, ln), (ri, rn))
+                        if key[0] > key[1]:
+                            key = (key[1], key[0])
+                        eqs.add(key)
+            per_branch.append(eqs)
+        common = set.intersection(*per_branch) if per_branch else set()
+        return [(li, ln, ri, rn) for (li, ln), (ri, rn) in common]
+
+    def _scalar_sub_is_correlated(self, sub: P.ScalarSubquery) -> bool:
+        """True when the subquery references columns outside its own FROM."""
+        try:
+            inner_sources, _ = self._bind_from(sub.select.from_)
+        except BindError:
+            return False
+        inner_scope = Scope(inner_sources)
+        nodes = list(sub.select.items) + (
+            [sub.select.where] if sub.select.where is not None else []
+        )
+        for n in nodes:
+            for x in _walk(n):
+                if isinstance(x, P.Ident):
+                    try:
+                        inner_scope.resolve(x)
+                    except BindError:
+                        return True
+        return False
+
+    def _apply_corr_scalar(self, joined: "BoundQuery", conjunct: P.Node,
+                           scope: Scope) -> "BoundQuery":
+        """Decorrelate `expr CMP (select agg(...) from ... where inner.k =
+        outer.k and ...)` — the reference's optbuilder/norm rules turn these
+        into grouped joins (plan_opt.go); here the rewrite happens on the
+        AST: bind the subquery GROUPED BY its correlation keys, inner-join
+        the group result on the keys (group output is unique per key), then
+        filter and project the helper columns away.
+
+        Inner-join semantics are exactly SQL's: a key with no inner rows
+        yields a NULL scalar, the comparison is not-true, the row drops."""
+        sub = next(x for x in _walk(conjunct)
+                   if isinstance(x, P.ScalarSubquery))
+        sel2 = sub.select
+        if len(sel2.items) != 1:
+            raise BindError("scalar subquery must produce one column")
+        inner_sources, jf2 = self._bind_from(sel2.from_)
+        inner_scope = Scope(inner_sources)
+
+        def is_inner(ident: P.Ident) -> bool:
+            try:
+                inner_scope.resolve(ident)
+                return True
+            except BindError:
+                return False
+
+        corr: list[tuple[P.Ident, P.Ident]] = []  # (outer, inner)
+        inner_where: list[P.Node] = []
+        for c in jf2 + [_fold(x) for x in _conjuncts(sel2.where)]:
+            if (isinstance(c, P.Cmp) and c.op == "eq"
+                    and isinstance(c.left, P.Ident)
+                    and isinstance(c.right, P.Ident)):
+                li, ri = is_inner(c.left), is_inner(c.right)
+                if li and not ri:
+                    corr.append((c.right, c.left))
+                    continue
+                if ri and not li:
+                    corr.append((c.left, c.right))
+                    continue
+            for x in _walk(c):
+                if isinstance(x, P.Ident) and not is_inner(x):
+                    raise BindError(
+                        "correlated scalar subquery supports only equality "
+                        f"correlation (found outer ref {x.name})"
+                    )
+            inner_where.append(c)
+
+        if not corr:
+            raise BindError("scalar subquery correlation not found")
+
+        # rewritten inner AST: group by the correlation keys
+        key_items = tuple(
+            P.SelectItem(inner_id, alias=f"_ck{i}")
+            for i, (_, inner_id) in enumerate(corr)
+        )
+        where2 = None
+        for c in inner_where:
+            where2 = c if where2 is None else P.Bin("and", where2, c)
+        sel3 = P.Select(
+            items=key_items + (
+                P.SelectItem(sel2.items[0].expr, alias="_sub"),),
+            from_=sel2.from_,
+            where=where2,
+            group_by=tuple(inner_id for _, inner_id in corr),
+            having=None, order_by=(), limit=None, offset=0,
+            distinct=False,
+        )
+        grouped = self.bind(sel3)
+
+        resolver = self._make_resolver(scope, joined)
+        n_outer = len(joined.rel.schema)
+        outer_names = joined.rel.schema.names
+        on = [
+            (resolver(outer_id) if resolver else
+             joined.rel.idx(outer_id.name), f"_ck{i}")
+            for i, (outer_id, _) in enumerate(corr)
+        ]
+        rel = joined.rel.join(grouped, on=on, how="inner", build_unique=True)
+        sub_pos = n_outer + len(corr)  # "_sub" column position
+
+        # lower the conjunct with the subquery replaced by the joined column
+        marker = P.Ident("__corr__", "_sub")
+
+        def replace(e: P.Node) -> P.Node:
+            if isinstance(e, P.ScalarSubquery):
+                return marker
+            if isinstance(e, P.Cmp):
+                return P.Cmp(e.op, replace(e.left), replace(e.right))
+            if isinstance(e, P.Bin):
+                return P.Bin(e.op, replace(e.left), replace(e.right))
+            if isinstance(e, P.Not):
+                return P.Not(replace(e.arg))
+            return e
+
+        def resolve2(ident: P.Ident) -> int:
+            if ident is marker or (ident.table == "__corr__"):
+                return sub_pos
+            if resolver is not None:
+                return resolver(ident)
+            return joined.rel.idx(ident.name)
+
+        lower = ExprLowerer(rel, resolver=resolve2)
+        rel = rel.filter(lower.lower(replace(conjunct)))
+        # project the helper columns away, restoring original positions
+        rel = rel.project(
+            [(outer_names[i], ex.ColRef(i)) for i in range(n_outer)]
+        )
+        return BoundQuery(rel, joined.sources, joined.colmap)
 
     def bind_subquery_for_in(self, sel: P.Select) -> Rel:
         rel = self.bind(sel)
@@ -587,18 +917,24 @@ class Binder:
                 return "outer"
             raise BindError(f"unknown column {ident.name}")
 
-        corr: list[tuple[str, str]] = []
+        # pairs carry the outer IDENT (not its bare name): resolution to a
+        # joined-schema position must honor qualifiers, or a self-joined
+        # outer table would silently bind the wrong duplicate column
+        corr: list[tuple[P.Ident, str]] = []
+        ne_pairs: list[tuple[P.Ident, str]] = []
         inner_preds: list[P.Node] = []
         for c in jf + [(_fold(x)) for x in _conjuncts(sel.where)]:
-            if (isinstance(c, P.Cmp) and c.op == "eq"
+            if (isinstance(c, P.Cmp) and c.op in ("eq", "ne")
                     and isinstance(c.left, P.Ident)
                     and isinstance(c.right, P.Ident)):
                 ls, rs = side(c.left), side(c.right)
+                pair = None
                 if ls == "inner" and rs == "outer":
-                    corr.append((c.right.name, c.left.name))
-                    continue
-                if rs == "inner" and ls == "outer":
-                    corr.append((c.left.name, c.right.name))
+                    pair = (c.right, c.left.name)
+                elif rs == "inner" and ls == "outer":
+                    pair = (c.left, c.right.name)
+                if pair is not None:
+                    (corr if c.op == "eq" else ne_pairs).append(pair)
                     continue
             # any other predicate must be purely inner; an outer reference
             # here is a correlation shape the semi-join rewrite can't express
@@ -614,7 +950,7 @@ class Binder:
             rel = rel.filter(ExprLowerer(rel).lower(p))
         if not corr:
             raise BindError("uncorrelated EXISTS not supported")
-        return rel, corr
+        return rel, corr, ne_pairs
 
     def _lower_with_subqueries(self, lower: ExprLowerer, c: P.Node) -> ex.Expr:
         """Lower a predicate, executing uncorrelated scalar subqueries into
@@ -649,26 +985,27 @@ class Binder:
 
     # -- SELECT list / aggregation / ordering -------------------------------
 
-    def _finish(self, sel: P.Select, rel: Rel) -> Rel:
+    def _finish(self, sel: P.Select, rel: Rel, resolver=None) -> Rel:
         has_agg = (
             bool(sel.group_by)
             or any(_has_agg(it.expr) for it in sel.items)
             or (sel.having is not None and _has_agg(sel.having))
         )
         if has_agg:
-            rel = self._aggregate(sel, rel)
+            rel = self._aggregate(sel, rel, resolver)
         else:
-            rel = self._project(sel, rel)
+            rel = self._project(sel, rel, resolver)
         if sel.distinct:
             rel = rel.distinct()
         rel = self._order_limit(sel, rel)
         return rel
 
-    def _project(self, sel: P.Select, rel: Rel) -> Rel:
+    def _project(self, sel: P.Select, rel: Rel, resolver=None) -> Rel:
         items: list[tuple[str, ex.Expr]] = []
         expr_names: dict[P.Node, str] = {}
         used: set[str] = set()
-        lower = ExprLowerer(rel)
+        lower = ExprLowerer(rel, resolver=resolver)
+        dict_attach: list[tuple[str, object]] = []
         for it in sel.items:
             if isinstance(it.expr, P.Star):
                 for n in rel.schema.names:
@@ -677,7 +1014,13 @@ class Binder:
             name = self._uniq(
                 it.alias or self._default_name(it.expr, len(items)), used
             )
-            items.append((name, lower.lower(it.expr)))
+            st = self._string_transform(rel, it.expr, lower)
+            if st is not None:
+                expr, d = st
+                items.append((name, expr))
+                dict_attach.append((name, d))
+            else:
+                items.append((name, lower.lower(it.expr)))
             expr_names[it.expr] = name
         # resolve ORDER BY to output columns, adding hidden ones as needed
         hidden: list[tuple[str, ex.Expr]] = []
@@ -698,11 +1041,41 @@ class Binder:
             else:
                 raise BindError(f"cannot order by {o.expr}")
         proj = rel.project(items + hidden)
+        for name, d in dict_attach:
+            proj = proj.with_dict(name, d)
         proj._visible = len(items)  # order_limit projects hidden cols away
         proj._order_keys = order_keys
         return proj
 
-    def _aggregate(self, sel: P.Select, rel: Rel) -> Rel:
+    @staticmethod
+    def _string_transform(rel: Rel, e: P.Node, lower: ExprLowerer):
+        """String-valued functions of a STRING column (substring) — host-
+        evaluated per dictionary entry, a code-remap gather on device.
+        Returns (expr, Dictionary) or None."""
+        if not (isinstance(e, P.FuncCall) and e.name == "substring"
+                and len(e.args) == 3 and isinstance(e.args[0], P.Ident)):
+            return None
+        i = lower.idx(e.args[0])
+        if rel.schema.types[i].family is not Family.STRING:
+            return None
+        from ..coldata.batch import Dictionary
+        from ..coldata.types import STRING
+
+        start = int(e.args[1].value) - 1
+        n = int(e.args[2].value)
+        d = rel.dicts[i]
+        mapped = np.array([str(v)[start:start + n] for v in d.values],
+                          dtype=object)
+        if len(mapped):
+            uvals, codes = np.unique(mapped.astype(str), return_inverse=True)
+            table = codes.astype(np.int32)
+        else:
+            uvals = np.array([], dtype=object)
+            table = np.zeros(1, np.int32)
+        return (ex.CodeLookup(col=i, table=table, out_type=STRING),
+                Dictionary(uvals.astype(object)))
+
+    def _aggregate(self, sel: P.Select, rel: Rel, resolver=None) -> Rel:
         # 1. collect aggregate calls across SELECT + HAVING + ORDER BY
         aggs: dict[P.FuncCall, str] = {}
 
@@ -741,32 +1114,53 @@ class Binder:
                 group_items.append((alias, g))
 
         # 3. pre-projection: group keys + agg inputs
-        lower = ExprLowerer(rel)
+        lower = ExprLowerer(rel, resolver=resolver)
         pre: list[tuple[str, ex.Expr]] = []
         for name, g in group_items:
             pre.append((name, lower.lower(g)))
         agg_specs: list[tuple[str, str, str | None]] = []
-        for fc, name in aggs.items():
-            func = fc.name
-            if func == "count" and (
-                not fc.args or isinstance(fc.args[0], P.Star)
-            ):
-                agg_specs.append((name, "count_rows", None))
-                continue
-            if fc.distinct:
-                raise BindError("DISTINCT aggregates not supported yet")
-            in_name = f"{name}_in"
-            pre.append((in_name, lower.lower(fc.args[0])))
-            agg_specs.append((name, func, in_name))
-        rel2 = rel.project(pre)
+        distinct_aggs = [fc for fc in aggs if fc.distinct]
+        if distinct_aggs:
+            # DISTINCT aggregates: dedupe (group keys, arg) first, then
+            # aggregate the deduped rows (the reference plans these as a
+            # distinct stage under the aggregator). All distinct aggs must
+            # share one argument for the single-dedupe rewrite to be sound.
+            args = {fc.args[0] for fc in distinct_aggs}
+            if len(args) > 1 or len(distinct_aggs) != len(aggs):
+                raise BindError(
+                    "DISTINCT aggregates must all share one argument and "
+                    "cannot mix with plain aggregates"
+                )
+            in_name = "_distinct_in"
+            pre.append((in_name, lower.lower(next(iter(args)))))
+            for fc, name in aggs.items():
+                if fc.name not in ("count", "sum", "min", "max", "avg"):
+                    raise BindError(
+                        f"DISTINCT {fc.name} not supported"
+                    )
+                agg_specs.append((name, fc.name, in_name))
+            rel2 = rel.project(pre).distinct()
+        else:
+            for fc, name in aggs.items():
+                func = fc.name
+                if func == "count" and (
+                    not fc.args or isinstance(fc.args[0], P.Star)
+                ):
+                    agg_specs.append((name, "count_rows", None))
+                    continue
+                in_name = f"{name}_in"
+                pre.append((in_name, lower.lower(fc.args[0])))
+                agg_specs.append((name, func, in_name))
+            rel2 = rel.project(pre)
         if group_items:
             g = rel2.groupby([n for n, _ in group_items], agg_specs)
         else:
             g = rel2.scalar_agg(agg_specs)
 
-        # 4. HAVING
+        # 4. HAVING (uncorrelated scalar subqueries fold to literals first)
         if sel.having is not None:
-            g = g.filter(self._lower_agg_expr(g, sel.having, aggs, group_items))
+            having = self._replace_scalar_subqueries(sel.having)
+            g = g.filter(self._lower_agg_expr(g, having, aggs, group_items))
 
         # 5. post-projection for the SELECT list
         post: list[tuple[str, ex.Expr]] = []
@@ -886,6 +1280,9 @@ class Binder:
 class BoundQuery:
     rel: Rel
     sources: dict[int, Source]
+    # (source index, column name) -> position in rel's joined schema. The
+    # only sound resolution once self-joins duplicate column names.
+    colmap: dict[tuple[int, str], int] | None = None
 
 
 def sql(catalog: Catalog, text: str) -> Rel:

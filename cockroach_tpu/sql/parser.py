@@ -12,6 +12,7 @@ optbuilder analog.
 from __future__ import annotations
 
 import re
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -36,7 +37,7 @@ KEYWORDS = {
     "is", "null", "case", "when", "then", "else", "end", "cast", "extract",
     "year", "month", "day", "date", "interval", "join", "inner", "left",
     "right", "outer", "on", "asc", "desc", "distinct", "all", "union",
-    "substring", "for", "true", "false", "any", "some",
+    "substring", "for", "true", "false", "any", "some", "with",
 }
 
 
@@ -251,6 +252,7 @@ class Select(Node):
     limit: Optional[int]
     offset: int = 0
     distinct: bool = False
+    ctes: tuple[tuple[str, "Select"], ...] = ()  # WITH name AS (select)
 
 
 # ---------------------------------------------------------------------------
@@ -305,7 +307,19 @@ class Parser:
     # -- entry --------------------------------------------------------------
 
     def parse(self) -> Select:
+        ctes: list[tuple[str, Select]] = []
+        if self.eat_kw("with"):
+            while True:
+                name = self.next().value
+                self.expect_kw("as")
+                self.expect_op("(")
+                ctes.append((name, self.parse_select()))
+                self.expect_op(")")
+                if not self.eat_op(","):
+                    break
         s = self.parse_select()
+        if ctes:
+            s = dataclasses.replace(s, ctes=tuple(ctes))
         self.eat_op(";")
         if self.peek().kind != "eof":
             t = self.peek()

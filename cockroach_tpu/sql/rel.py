@@ -279,14 +279,19 @@ class Rel:
                 dicts[off + i] = d
         return Rel(self.catalog, node, schema, dicts)
 
-    def join(self, build: "Rel", on: list[tuple[str, str]],
+    def join(self, build: "Rel", on: list[tuple[str | int, str | int]],
              how: str = "inner", build_unique: bool = True) -> "Rel":
-        """inner | left | right | full | semi | anti. Right and full outer
+        """inner | left | right | full | semi | anti. `on` pairs accept
+        column names or POSITIONS (positions are the only sound reference
+        once self-joins duplicate names). Right and full outer
         compose from the primitive kernels the way the reference's hash
         joiner emits unmatched build rows after the probe stream
         (hashjoiner.go emitUnmatched): the matched part (inner for right,
         left-outer for full) UNION ALL the build-side anti join against the
         probe, null-extended over the probe columns."""
+        def _pk(r: "Rel", c) -> int:
+            return c if isinstance(c, int) else r.idx(c)
+
         if how in ("right", "full"):
             matched = self.join(build, on,
                                 how="inner" if how == "right" else "left",
@@ -304,8 +309,8 @@ class Rel:
             ne = Rel(self.catalog, node, matched.schema,
                      {off + i: d for i, d in build.dicts.items()})
             return matched.union_all(ne)
-        pkeys = tuple(self.idx(l) for l, _ in on)
-        bkeys = tuple(build.idx(r) for _, r in on)
+        pkeys = tuple(_pk(self, l) for l, _ in on)
+        bkeys = tuple(_pk(build, r) for _, r in on)
         spec = join_ops.JoinSpec(how, build_unique)
         node = S.HashJoin(self.plan, build.plan, pkeys, bkeys, spec)
         if how in ("semi", "anti"):

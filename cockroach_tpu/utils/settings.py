@@ -165,6 +165,12 @@ SCAN_STREAM_ROWS = register_int(
     "HBM (the host half of SURVEY §7's pipelining hard part)",
     lo=1024,
 )
+MAX_FUSED_JOINS = register_int(
+    "sql.distsql.max_fused_joins", 4,
+    "maximum join probes composed into one fused streaming segment; deeper "
+    "pipelines split into separate jits to bound XLA program size",
+    lo=0, hi=64,
+)
 DENSE_AGG = register_bool(
     "sql.distsql.dense_agg.enabled", True,
     "allow the dense-code small-group aggregation specialization "

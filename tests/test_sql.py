@@ -188,6 +188,200 @@ TPCH_SQL = {
         group by l_shipmode
         order by l_shipmode
     """,
+    "q19": """
+        select sum(l_extendedprice * (1 - l_discount)) as revenue
+        from lineitem, part
+        where (p_partkey = l_partkey and p_brand = 'Brand#12'
+               and p_container in ('SM CASE', 'SM BOX', 'SM PACK', 'SM PKG')
+               and l_quantity >= 1 and l_quantity <= 11
+               and p_size between 1 and 5
+               and l_shipmode in ('AIR', 'AIR REG')
+               and l_shipinstruct = 'DELIVER IN PERSON')
+           or (p_partkey = l_partkey and p_brand = 'Brand#23'
+               and p_container in ('MED BAG', 'MED BOX', 'MED PKG', 'MED PACK')
+               and l_quantity >= 10 and l_quantity <= 20
+               and p_size between 1 and 10
+               and l_shipmode in ('AIR', 'AIR REG')
+               and l_shipinstruct = 'DELIVER IN PERSON')
+           or (p_partkey = l_partkey and p_brand = 'Brand#34'
+               and p_container in ('LG CASE', 'LG BOX', 'LG PACK', 'LG PKG')
+               and l_quantity >= 20 and l_quantity <= 30
+               and p_size between 1 and 15
+               and l_shipmode in ('AIR', 'AIR REG')
+               and l_shipinstruct = 'DELIVER IN PERSON')
+    """,
+    "q7": """
+        select supp_nation, cust_nation, l_year, sum(volume) as revenue
+        from (
+            select n1.n_name as supp_nation, n2.n_name as cust_nation,
+                   extract(year from l_shipdate) as l_year,
+                   l_extendedprice * (1 - l_discount) as volume
+            from supplier, lineitem, orders, customer, nation as n1,
+                 nation as n2
+            where s_suppkey = l_suppkey and o_orderkey = l_orderkey
+              and c_custkey = o_custkey and s_nationkey = n1.n_nationkey
+              and c_nationkey = n2.n_nationkey
+              and ((n1.n_name = 'FRANCE' and n2.n_name = 'GERMANY')
+                or (n1.n_name = 'GERMANY' and n2.n_name = 'FRANCE'))
+              and l_shipdate between date '1995-01-01' and date '1996-12-31'
+        ) as shipping
+        group by supp_nation, cust_nation, l_year
+        order by supp_nation, cust_nation, l_year
+    """,
+    "q8": """
+        select o_year,
+               sum(case when nation = 'BRAZIL' then volume else 0.0 end)
+               / sum(volume) as mkt_share
+        from (
+            select extract(year from o_orderdate) as o_year,
+                   l_extendedprice * (1 - l_discount) as volume,
+                   n2.n_name as nation
+            from part, supplier, lineitem, orders, customer, nation as n1,
+                 nation as n2, region
+            where p_partkey = l_partkey and s_suppkey = l_suppkey
+              and l_orderkey = o_orderkey and o_custkey = c_custkey
+              and c_nationkey = n1.n_nationkey
+              and n1.n_regionkey = r_regionkey and r_name = 'AMERICA'
+              and s_nationkey = n2.n_nationkey
+              and o_orderdate between date '1995-01-01' and date '1996-12-31'
+              and p_type = 'ECONOMY ANODIZED STEEL'
+        ) as all_nations
+        group by o_year
+        order by o_year
+    """,
+    "q13": """
+        select c_count, count(*) as custdist
+        from (
+            select c_custkey, count(o_orderkey) as c_count
+            from customer left outer join orders
+                 on c_custkey = o_custkey
+                 and o_comment not like '%special%requests%'
+            group by c_custkey
+        ) as c_orders
+        group by c_count
+        order by custdist desc, c_count desc
+    """,
+    "q17": """
+        select sum(l_extendedprice) / 7.0 as avg_yearly
+        from lineitem, part
+        where p_partkey = l_partkey
+          and p_brand = 'Brand#23' and p_container = 'MED BOX'
+          and l_quantity < (
+              select 0.2 * avg(l_quantity) from lineitem
+              where l_partkey = p_partkey)
+    """,
+    "q16": """
+        select p_brand, p_type, p_size,
+               count(distinct ps_suppkey) as supplier_cnt
+        from partsupp, part
+        where p_partkey = ps_partkey
+          and p_brand <> 'Brand#45'
+          and p_type not like 'MEDIUM POLISHED%'
+          and p_size in (49, 14, 23, 45, 19, 3, 36, 9)
+          and ps_suppkey not in (
+              select s_suppkey from supplier
+              where s_comment like '%Customer%Complaints%')
+        group by p_brand, p_type, p_size
+        order by supplier_cnt desc, p_brand, p_type, p_size
+    """,
+    "q11": """
+        select ps_partkey, sum(ps_supplycost * ps_availqty) as value
+        from partsupp, supplier, nation
+        where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+          and n_name = 'GERMANY'
+        group by ps_partkey
+        having sum(ps_supplycost * ps_availqty) > (
+            select sum(ps_supplycost * ps_availqty) * 0.0001
+            from partsupp, supplier, nation
+            where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+              and n_name = 'GERMANY')
+        order by value desc
+    """,
+    "q2": """
+        select s_acctbal, s_name, n_name, p_partkey, p_mfgr, s_address,
+               s_phone, s_comment
+        from part, supplier, partsupp, nation, region
+        where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+          and p_size = 15 and p_type like '%BRASS'
+          and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+          and r_name = 'EUROPE'
+          and ps_supplycost = (
+              select min(ps_supplycost)
+              from partsupp, supplier, nation, region
+              where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+                and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+                and r_name = 'EUROPE')
+        order by s_acctbal desc, n_name, s_name, p_partkey
+        limit 100
+    """,
+    "q20": """
+        select s_name, s_address
+        from supplier, nation
+        where s_suppkey in (
+            select ps_suppkey from partsupp
+            where ps_partkey in (
+                select p_partkey from part where p_name like 'forest%')
+              and ps_availqty > (
+                  select 0.5 * sum(l_quantity) from lineitem
+                  where l_partkey = ps_partkey and l_suppkey = ps_suppkey
+                    and l_shipdate >= date '1994-01-01'
+                    and l_shipdate < date '1994-01-01' + interval '1' year)
+          )
+          and s_nationkey = n_nationkey and n_name = 'CANADA'
+        order by s_name
+    """,
+    "q21": """
+        select s_name, count(*) as numwait
+        from supplier, lineitem as l1, orders, nation
+        where s_suppkey = l1.l_suppkey and o_orderkey = l1.l_orderkey
+          and o_orderstatus = 'F' and l1.l_receiptdate > l1.l_commitdate
+          and exists (
+              select * from lineitem as l2
+              where l2.l_orderkey = l1.l_orderkey
+                and l2.l_suppkey <> l1.l_suppkey)
+          and not exists (
+              select * from lineitem as l3
+              where l3.l_orderkey = l1.l_orderkey
+                and l3.l_suppkey <> l1.l_suppkey
+                and l3.l_receiptdate > l3.l_commitdate)
+          and s_nationkey = n_nationkey and n_name = 'SAUDI ARABIA'
+        group by s_name
+        order by numwait desc, s_name
+        limit 100
+    """,
+    "q22": """
+        select cntrycode, count(*) as numcust, sum(c_acctbal) as totacctbal
+        from (
+            select substring(c_phone from 1 for 2) as cntrycode, c_acctbal
+            from customer
+            where substring(c_phone from 1 for 2)
+                  in ('13', '31', '23', '29', '30', '18', '17')
+              and c_acctbal > (
+                  select avg(c_acctbal) from customer
+                  where c_acctbal > 0.00
+                    and substring(c_phone from 1 for 2)
+                        in ('13', '31', '23', '29', '30', '18', '17'))
+              and not exists (
+                  select * from orders where o_custkey = c_custkey)
+        ) as custsale
+        group by cntrycode
+        order by cntrycode
+    """,
+    "q15": """
+        with revenue as (
+            select l_suppkey as supplier_no,
+                   sum(l_extendedprice * (1 - l_discount)) as total_revenue
+            from lineitem
+            where l_shipdate >= date '1996-01-01'
+              and l_shipdate < date '1996-01-01' + 90
+            group by l_suppkey
+        )
+        select s_suppkey, s_name, s_address, s_phone, total_revenue
+        from supplier, revenue
+        where s_suppkey = supplier_no
+          and total_revenue = (select max(total_revenue) from revenue)
+        order by s_suppkey
+    """,
 }
 
 
@@ -287,18 +481,38 @@ def test_sql_offset_without_limit(cat):
     np.testing.assert_array_equal(got["n_nationkey"], np.arange(5, 25))
 
 
-def test_sql_correlated_nonequality_rejected(cat):
-    from cockroach_tpu.sql import BindError
+def test_sql_correlated_nonequality_exists(cat):
+    """EXISTS with an extra <> correlation (TPC-H q21's shape) rewrites to a
+    min/max-per-key grouped join; oracle is pandas."""
+    got = sql(cat, """
+        select count(*) as n from lineitem l1
+        where exists (
+          select * from lineitem l2
+          where l2.l_orderkey = l1.l_orderkey
+            and l2.l_suppkey <> l1.l_suppkey
+        )
+    """).run()
+    li = tpch.to_pandas(cat, "lineitem")
+    per = li.groupby("l_orderkey").l_suppkey.agg(["min", "max"])
+    j = li.merge(per, left_on="l_orderkey", right_index=True)
+    want = int(((j["min"] != j.l_suppkey) | (j["max"] != j.l_suppkey)).sum())
+    assert int(got["n"][0]) == want
 
-    with pytest.raises(BindError):
-        sql(cat, """
-            select count(*) from lineitem l1
-            where exists (
-              select * from lineitem l2
-              where l2.l_orderkey = l1.l_orderkey
-                and l2.l_suppkey <> l1.l_suppkey
-            )
-        """)
+
+def test_sql_correlated_nonequality_not_exists(cat):
+    got = sql(cat, """
+        select count(*) as n from lineitem l1
+        where not exists (
+          select * from lineitem l2
+          where l2.l_orderkey = l1.l_orderkey
+            and l2.l_suppkey <> l1.l_suppkey
+        )
+    """).run()
+    li = tpch.to_pandas(cat, "lineitem")
+    per = li.groupby("l_orderkey").l_suppkey.agg(["min", "max"])
+    j = li.merge(per, left_on="l_orderkey", right_index=True)
+    want = int(((j["min"] == j.l_suppkey) & (j["max"] == j.l_suppkey)).sum())
+    assert int(got["n"][0]) == want
 
 
 def test_sql_subquery_in_from(cat):
