@@ -143,7 +143,11 @@ def bsearch(sorted_u64: jax.Array, queries: jax.Array,
     steps). Replaces jnp.searchsorted, whose lax.scan lowering is far more
     expensive for XLA:TPU to compile inside fused query kernels."""
     n = sorted_u64.shape[0]
-    bits = max(1, int(n - 1).bit_length()) if n > 1 else 1
+    # n.bit_length() (not n-1): the insertion point ranges over [0, n]
+    # INCLUSIVE, and a power-of-two n needs the extra step to reach n when
+    # the query is >= the last element (otherwise the final matching build
+    # row of a fully-live power-of-two batch is silently dropped)
+    bits = max(1, int(n).bit_length())
     pos = jnp.zeros(queries.shape, jnp.int32)
     for sb in range(bits - 1, -1, -1):
         cand = pos + (1 << sb)

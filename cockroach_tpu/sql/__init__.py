@@ -4,6 +4,7 @@ SELECT into an executable Rel."""
 
 from .binder import BindError, sql
 from .rel import Rel
+from .session import Session
 
 
 def explain(catalog, text: str) -> str:
@@ -24,4 +25,4 @@ def explain(catalog, text: str) -> str:
     return rel.explain()
 
 
-__all__ = ["BindError", "Rel", "explain", "sql"]
+__all__ = ["BindError", "Rel", "Session", "explain", "sql"]

@@ -139,6 +139,9 @@ class Source:
     # base-table provenance (None for subquery sources); lets bind-time
     # checks prove column non-nullability from the catalog's valid bitmaps
     table: str | None = None
+    # combined sources (a bound LEFT JOIN) expose their constituent aliases
+    # so table-qualified references through either side still resolve
+    sub_aliases: tuple[tuple[str, tuple[str, ...]], ...] = ()
 
 
 class Scope:
@@ -156,6 +159,13 @@ class Scope:
                             f"column {ident.name} not in {ident.table}"
                         )
                     return i, ident.name
+                for sub_alias, sub_cols in s.sub_aliases:
+                    if sub_alias == ident.table:
+                        if ident.name not in sub_cols:
+                            raise BindError(
+                                f"column {ident.name} not in {ident.table}"
+                            )
+                        return i, ident.name
             raise BindError(f"unknown table alias {ident.table}")
         hits = [
             (i, ident.name)
@@ -553,11 +563,20 @@ class Binder:
                 )
         if not keys:
             raise BindError("LEFT JOIN requires at least one equi key")
+        dup = set(left.cols) & set(right.cols)
+        if dup:
+            # the combined source resolves columns by NAME; a shared name
+            # (e.g. a self left-join) would silently bind the left copy
+            raise BindError(
+                f"LEFT JOIN sides share column names {sorted(dup)}; "
+                "project/rename one side first"
+            )
         rel = left.rel.join(right.rel, on=keys, how="left",
                             build_unique=False)
         return Source(
             alias=f"{left.alias}*{right.alias}", rel=rel,
             cols=rel.schema.names, base_rows=left.base_rows,
+            sub_aliases=((left.alias, left.cols), (right.alias, right.cols)),
         )
 
     # -- join planning ------------------------------------------------------
@@ -745,8 +764,17 @@ class Binder:
 
         Inner-join semantics are exactly SQL's: a key with no inner rows
         yields a NULL scalar, the comparison is not-true, the row drops."""
-        sub = next(x for x in _walk(conjunct)
-                   if isinstance(x, P.ScalarSubquery))
+        # fold any UNCORRELATED subqueries in the conjunct to literals first
+        # so the marker substitution below can only ever target the one
+        # correlated subquery
+        conjunct = self._replace_scalar_subqueries(conjunct)
+        subs = [x for x in _walk(conjunct)
+                if isinstance(x, P.ScalarSubquery)]
+        if len(subs) != 1:
+            raise BindError(
+                "at most one correlated scalar subquery per predicate"
+            )
+        sub = subs[0]
         sel2 = sub.select
         if len(sel2.items) != 1:
             raise BindError("scalar subquery must produce one column")
@@ -960,6 +988,8 @@ class Binder:
 
     def _replace_scalar_subqueries(self, c: P.Node) -> P.Node:
         if isinstance(c, P.ScalarSubquery):
+            if self._scalar_sub_is_correlated(c):
+                return c  # handled by _apply_corr_scalar
             rel = self.bind(c.select)
             res = rel.run()
             if len(rel.schema) != 1:

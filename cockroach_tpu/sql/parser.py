@@ -38,6 +38,8 @@ KEYWORDS = {
     "year", "month", "day", "date", "interval", "join", "inner", "left",
     "right", "outer", "on", "asc", "desc", "distinct", "all", "union",
     "substring", "for", "true", "false", "any", "some", "with",
+    "create", "table", "primary", "key", "insert", "into", "values",
+    "update", "set", "delete", "default",
 }
 
 
@@ -242,6 +244,43 @@ class OrderItem(Node):
 
 
 @dataclass(frozen=True)
+class ColumnDef(Node):
+    name: str
+    type_name: str  # normalized lowercase
+    precision: int | None = None
+    scale: int | None = None
+    primary_key: bool = False
+    not_null: bool = False
+
+
+@dataclass(frozen=True)
+class CreateTable(Node):
+    name: str
+    columns: tuple[ColumnDef, ...]
+
+
+@dataclass(frozen=True)
+class Insert(Node):
+    table: str
+    columns: tuple[str, ...] | None  # None = all, in schema order
+    rows: tuple[tuple[Node, ...], ...]  # VALUES literal rows
+    select: Optional["Select"] = None  # INSERT INTO ... SELECT
+
+
+@dataclass(frozen=True)
+class Update(Node):
+    table: str
+    sets: tuple[tuple[str, Node], ...]
+    where: Optional[Node]
+
+
+@dataclass(frozen=True)
+class Delete(Node):
+    table: str
+    where: Optional[Node]
+
+
+@dataclass(frozen=True)
 class Select(Node):
     items: tuple[SelectItem, ...]
     from_: tuple[Node, ...]  # TableRef | SubqueryRef | Join
@@ -305,6 +344,115 @@ class Parser:
             raise SyntaxError(f"expected {op!r}, got {t.value!r} at {t.pos}")
 
     # -- entry --------------------------------------------------------------
+
+    def parse_statement(self) -> Node:
+        """Statement entry: SELECT (incl. WITH) | CREATE TABLE | INSERT |
+        UPDATE | DELETE. Reference grammar: pkg/sql/parser/sql.y."""
+        if self.at_kw("create"):
+            s = self.parse_create_table()
+        elif self.at_kw("insert"):
+            s = self.parse_insert()
+        elif self.at_kw("update"):
+            s = self.parse_update()
+        elif self.at_kw("delete"):
+            s = self.parse_delete()
+        else:
+            return self.parse()
+        self.eat_op(";")
+        if self.peek().kind != "eof":
+            t = self.peek()
+            raise SyntaxError(f"trailing input at {t.pos}: {t.value!r}")
+        return s
+
+    def parse_create_table(self) -> CreateTable:
+        self.expect_kw("create")
+        self.expect_kw("table")
+        name = self.next().value
+        self.expect_op("(")
+        cols: list[ColumnDef] = []
+        while True:
+            if self.at_kw("primary"):  # table-level PRIMARY KEY (col)
+                self.next()
+                self.expect_kw("key")
+                self.expect_op("(")
+                pk = self.next().value
+                self.expect_op(")")
+                cols = [
+                    dataclasses.replace(c, primary_key=(c.name == pk))
+                    for c in cols
+                ]
+            else:
+                cname = self.next().value
+                tname = self.next().value.lower()
+                prec = scale = None
+                if self.eat_op("("):
+                    prec = int(self.next().value)
+                    if self.eat_op(","):
+                        scale = int(self.next().value)
+                    self.expect_op(")")
+                pkey = nnull = False
+                while True:
+                    if self.eat_kw("primary"):
+                        self.expect_kw("key")
+                        pkey = True
+                    elif self.eat_kw("not"):
+                        self.expect_kw("null")
+                        nnull = True
+                    else:
+                        break
+                cols.append(ColumnDef(cname, tname, prec, scale, pkey, nnull))
+            if not self.eat_op(","):
+                break
+        self.expect_op(")")
+        return CreateTable(name, tuple(cols))
+
+    def parse_insert(self) -> Insert:
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        table = self.next().value
+        columns = None
+        if self.eat_op("("):
+            columns = [self.next().value]
+            while self.eat_op(","):
+                columns.append(self.next().value)
+            self.expect_op(")")
+        if self.at_kw("select", "with"):
+            return Insert(table, tuple(columns) if columns else None, (),
+                          select=self.parse())
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            vals = [self.parse_expr()]
+            while self.eat_op(","):
+                vals.append(self.parse_expr())
+            self.expect_op(")")
+            rows.append(tuple(vals))
+            if not self.eat_op(","):
+                break
+        return Insert(table, tuple(columns) if columns else None,
+                      tuple(rows))
+
+    def parse_update(self) -> Update:
+        self.expect_kw("update")
+        table = self.next().value
+        self.expect_kw("set")
+        sets = []
+        while True:
+            col = self.next().value
+            self.expect_op("=")
+            sets.append((col, self.parse_expr()))
+            if not self.eat_op(","):
+                break
+        where = self.parse_expr() if self.eat_kw("where") else None
+        return Update(table, tuple(sets), where)
+
+    def parse_delete(self) -> Delete:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self.next().value
+        where = self.parse_expr() if self.eat_kw("where") else None
+        return Delete(table, where)
 
     def parse(self) -> Select:
         ctes: list[tuple[str, Select]] = []
@@ -619,3 +767,7 @@ class Parser:
 
 def parse(text: str) -> Select:
     return Parser(text).parse()
+
+
+def parse_statement(text: str) -> Node:
+    return Parser(text).parse_statement()

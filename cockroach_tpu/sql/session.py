@@ -1,0 +1,279 @@
+"""SQL session — DDL/DML execution over the KV layer (the conn-executor
+analog, reduced to statement dispatch).
+
+Reference shape: pkg/sql/conn_executor.go:2323 runs statements through the
+planner; INSERT/UPDATE/DELETE encode rows and write through kv.Txn
+(pkg/sql/insert.go, kv/txn.go), DDL creates descriptors. Here:
+
+- CREATE TABLE registers a KVTable (storage/rowcodec row encoding, engine-
+  backed, MVCC reads) in the catalog;
+- INSERT VALUES / INSERT ... SELECT encode rows and put them inside ONE
+  kv transaction (atomic: every row or none, write intents + commit);
+- UPDATE/DELETE plan their WHERE through the same binder/engine as SELECT
+  (a columnar scan computes the affected rows), then write the new
+  versions / tombstones transactionally;
+- SELECT returns columns through the standard bind/execute path.
+
+Divergences (documented): no schema changes after creation, single-node
+descriptors (table ids allocated locally), and writes materialize the
+affected rows on the host before re-encoding (no vectorized write path
+yet — the reference's colenc).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..catalog import Catalog
+from ..coldata import types as T
+from ..kv import DB, Clock
+from ..kv.table import KVTable, create_kv_table
+from ..storage import rowcodec
+from ..storage.lsm import Engine
+from . import parser as P
+from .binder import BindError, Binder, ExprLowerer
+from .rel import Rel
+
+_TYPE_MAP = {
+    "int": T.INT64, "integer": T.INT64, "bigint": T.INT64,
+    "int8": T.INT64, "int4": T.INT32, "smallint": T.INT16,
+    "float": T.FLOAT64, "double": T.FLOAT64, "real": T.FLOAT64,
+    "float8": T.FLOAT64, "date": T.DATE, "timestamp": T.TIMESTAMP,
+    "interval": T.INTERVAL, "bool": T.BOOL, "boolean": T.BOOL,
+}
+
+
+def _col_type(c: P.ColumnDef) -> T.SQLType:
+    tn = c.type_name
+    if tn in ("decimal", "numeric"):
+        return T.DECIMAL(c.precision or 19,
+                         c.scale if c.scale is not None else 2)
+    if tn in ("string", "text", "varchar", "char"):
+        raise BindError(
+            "STRING columns in KV tables need the dictionary write path "
+            "(planned); use fixed-width types"
+        )
+    t = _TYPE_MAP.get(tn)
+    if t is None:
+        raise BindError(f"unknown column type {tn!r}")
+    return t
+
+
+class Session:
+    """One SQL session over one KV store. execute() returns:
+    - SELECT: dict[str, np.ndarray] of result columns
+    - INSERT/UPDATE/DELETE: {"rows_affected": n}
+    - CREATE TABLE: {"created": name}
+    """
+
+    def __init__(self, catalog: Catalog | None = None, db: DB | None = None,
+                 val_width: int = 128, key_width: int = 16):
+        self.catalog = catalog if catalog is not None else Catalog()
+        self.db = db if db is not None else DB(
+            Engine(key_width=key_width, val_width=val_width,
+                   memtable_size=4096),
+            Clock(),
+        )
+
+    # -- dispatch ------------------------------------------------------------
+
+    def execute(self, text: str):
+        stmt = P.parse_statement(text)
+        if isinstance(stmt, P.Select):
+            return Binder(self.catalog).bind(stmt).run()
+        if isinstance(stmt, P.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, P.Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, P.Update):
+            return self._update(stmt)
+        if isinstance(stmt, P.Delete):
+            return self._delete(stmt)
+        raise BindError(f"unsupported statement {type(stmt).__name__}")
+
+    # -- DDL -----------------------------------------------------------------
+
+    def _create_table(self, stmt: P.CreateTable):
+        if stmt.name in self.catalog.tables:
+            raise BindError(f"table {stmt.name!r} already exists")
+        names = tuple(c.name for c in stmt.columns)
+        types = tuple(_col_type(c) for c in stmt.columns)
+        pks = [c.name for c in stmt.columns if c.primary_key]
+        if len(pks) != 1:
+            raise BindError("exactly one PRIMARY KEY column is required")
+        schema = T.Schema(names, types)
+        need = rowcodec.value_width(schema)
+        if self.db.engine.val_width < need:
+            raise BindError(
+                f"row width {need} exceeds engine value width "
+                f"{self.db.engine.val_width}; open the Session with "
+                f"val_width>={need}"
+            )
+        create_kv_table(self.catalog, self.db, stmt.name, schema, pk=pks[0])
+        return {"created": stmt.name}
+
+    # -- DML -----------------------------------------------------------------
+
+    def _kv_table(self, name: str) -> KVTable:
+        t = self.catalog.tables.get(name)
+        if t is None:
+            raise BindError(f"unknown table {name!r}")
+        if not isinstance(t, KVTable):
+            raise BindError(
+                f"table {name!r} is a static host table; DML targets "
+                "KV-backed tables (CREATE TABLE)"
+            )
+        return t
+
+    @staticmethod
+    def _literal(e: P.Node, t: T.SQLType):
+        from .binder import _fold
+
+        e = _fold(e)
+        # constant arithmetic (incl. unary minus, which parses as 0 - x)
+        if isinstance(e, P.Bin) and e.op in ("+", "-", "*", "/"):
+            lv = Session._literal(e.left, T.FLOAT64)
+            rv = Session._literal(e.right, T.FLOAT64)
+            if lv is None or rv is None:
+                return None
+            v = {"+": lv + rv, "-": lv - rv, "*": lv * rv,
+                 "/": lv / rv}[e.op]
+            e = P.NumLit(v)
+        if isinstance(e, P.NullLit):
+            return None
+        if isinstance(e, P.NumLit):
+            v = e.value
+            if t.family is T.Family.DECIMAL:
+                scaled = float(v) * (10 ** t.scale)
+                if abs(scaled - round(scaled)) > 1e-6:
+                    raise BindError(
+                        f"literal {v} has more than {t.scale} decimal places"
+                    )
+                return int(round(scaled))
+            if t.family is T.Family.FLOAT:
+                return float(v)
+            return int(v)
+        if isinstance(e, P.DateLit):
+            return int((np.datetime64(e.value) -
+                        np.datetime64("1970-01-01")).astype(int))
+        if isinstance(e, (P.Bin,)):
+            raise BindError("INSERT VALUES supports literals only")
+        if isinstance(e, P.StrLit):
+            raise BindError("STRING values need the dictionary write path")
+        if e.__class__.__name__ == "NumLit":
+            return e.value
+        # booleans arrive as true/false keywords folded to idents
+        raise BindError(f"unsupported INSERT literal {e}")
+
+    def _insert(self, stmt: P.Insert):
+        t = self._kv_table(stmt.table)
+        names = stmt.columns or t.schema.names
+        for n in names:
+            if n not in t.schema.names:
+                raise BindError(f"unknown column {n!r}")
+        if stmt.select is not None:
+            res = Binder(self.catalog).bind(stmt.select).run()
+            if len(res) != len(names):
+                raise BindError(
+                    f"INSERT ... SELECT produces {len(res)} columns, "
+                    f"target list has {len(names)}"
+                )
+            cols = list(res.values())
+            nrows = len(cols[0]) if cols else 0
+            rows = []
+            keys = list(res.keys())
+            for i in range(nrows):
+                rows.append({
+                    names[j]: _from_result(res[keys[j]][i],
+                                           t.schema.type_of(names[j]))
+                    for j in range(len(names))
+                })
+        else:
+            rows = []
+            for vals in stmt.rows:
+                if len(vals) != len(names):
+                    raise BindError(
+                        f"INSERT row has {len(vals)} values, expected "
+                        f"{len(names)}"
+                    )
+                rows.append({
+                    n: self._literal(v, t.schema.type_of(n))
+                    for n, v in zip(names, vals)
+                })
+        missing = set(t.schema.names) - set(names)
+        if missing:
+            raise BindError(f"columns {sorted(missing)} need values "
+                            "(defaults not supported)")
+
+        def op(txn):
+            for r in rows:
+                t.insert(txn, r)
+
+        self.db.txn(op)
+        return {"rows_affected": len(rows)}
+
+    def _affected(self, t: KVTable, where: P.Node | None,
+                  extra_cols: list[tuple[str, P.Node]] = ()):
+        """Plan WHERE + SET expressions through the columnar engine; returns
+        host rows of (pk, full current row, computed extras)."""
+        rel = Rel.scan(self.catalog, t.name)
+        if where is not None:
+            binder = Binder(self.catalog)
+            folded = binder._replace_scalar_subqueries(where)
+            rel = rel.filter(ExprLowerer(rel).lower(folded))
+        items = [(n, ExprLowerer(rel).lower(P.Ident(None, n)))
+                 for n in t.schema.names]
+        for name, e in extra_cols:
+            items.append((f"__set_{name}", ExprLowerer(rel).lower(e)))
+        rel = rel.project(items)
+        return rel.run()
+
+    def _update(self, stmt: P.Update):
+        t = self._kv_table(stmt.table)
+        for col, _ in stmt.sets:
+            if col not in t.schema.names:
+                raise BindError(f"unknown column {col!r}")
+            if col == t.pk:
+                raise BindError("updating the PRIMARY KEY is not supported")
+        res = self._affected(t, stmt.where, list(stmt.sets))
+        n = len(res[t.pk])
+
+        def op(txn):
+            for i in range(n):
+                row = {}
+                for cname, typ in zip(t.schema.names, t.schema.types):
+                    src = (f"__set_{cname}"
+                           if any(c == cname for c, _ in stmt.sets)
+                           else cname)
+                    row[cname] = _from_result(res[src][i], typ)
+                t.insert(txn, row)  # MVCC: a new version at the txn ts
+
+        self.db.txn(op)
+        return {"rows_affected": n}
+
+    def _delete(self, stmt: P.Delete):
+        t = self._kv_table(stmt.table)
+        res = self._affected(t, stmt.where)
+        pk_t = t.schema.type_of(t.pk)
+        pks = [_from_result(v, pk_t) for v in res[t.pk]]
+
+        def op(txn):
+            for pk in pks:
+                t.delete_pk(txn, pk)
+
+        self.db.txn(op)
+        return {"rows_affected": len(pks)}
+
+
+def _from_result(v, t: T.SQLType):
+    """Convert a materialized result value back to the row-encoding domain
+    (to_host descales DECIMAL to float; re-scale for storage)."""
+    if v is None:
+        return None
+    if t.family is T.Family.DECIMAL:
+        return int(round(float(v) * (10 ** t.scale)))
+    if t.family is T.Family.FLOAT:
+        return float(v)
+    if t.family is T.Family.BOOL:
+        return bool(v)
+    return int(v)
