@@ -36,15 +36,25 @@ def _shrink_for_readback(b):
 
 
 def run_operator(root) -> dict[str, np.ndarray]:
-    root.init()
+    from ..utils.errors import QueryError, _PASSTHROUGH
+
     outs: list[dict[str, np.ndarray]] = []
-    while True:
-        b = root.next_batch()
-        if b is None:
-            break
-        b = _shrink_for_readback(b)
-        outs.append(to_host(b, root.output_schema, root.dictionaries))
-    root.close()
+    try:
+        root.init()
+        while True:
+            b = root.next_batch()
+            if b is None:
+                break
+            b = _shrink_for_readback(b)
+            outs.append(to_host(b, root.output_schema, root.dictionaries))
+    except _PASSTHROUGH:
+        raise
+    except Exception as e:
+        # the colexecerror boundary: engine/kernel failures surface as a
+        # typed query error, never a raw JAX traceback mid-flow
+        raise QueryError(f"operator {type(root).__name__}", e) from e
+    finally:
+        root.close()
     if not outs:
         return {n: np.array([]) for n in root.output_schema.names}
     return {

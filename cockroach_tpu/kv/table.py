@@ -25,18 +25,50 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from ..coldata.batch import Batch
+from ..coldata.batch import Batch, Dictionary
 from ..coldata.types import Family, Schema
 from ..storage import rowcodec
 from ..storage.lsm import Engine, WriteIntentError
 from .txn import DB, Txn
 
-_UNSUPPORTED = (Family.STRING, Family.BYTES, Family.JSON)
+_UNSUPPORTED = (Family.BYTES, Family.JSON)
+
+
+class _TableDict:
+    """Growable per-column string dictionary for a KV table.
+
+    Codes live in the row payload (int32 slots); the code -> string mapping
+    persists in the SAME engine under a companion dictionary table id, so a
+    restore rebuilds it by scanning that span — the system-table discipline
+    (the reference keeps descriptors/interning in system ranges). Query
+    plans take an immutable Dictionary snapshot at bind time."""
+
+    def __init__(self, values: list[str] | None = None):
+        self.values: list[str] = list(values or [])
+        self._code: dict[str, int] = {v: i for i, v in enumerate(self.values)}
+        self._snapshot = None
+
+    def code_of(self, v: str) -> int | None:
+        return self._code.get(v)
+
+    def add(self, v: str) -> int:
+        code = len(self.values)
+        self.values.append(v)
+        self._code[v] = code
+        self._snapshot = None
+        return code
+
+    def snapshot(self) -> Dictionary:
+        if self._snapshot is None or len(self._snapshot) != len(self.values):
+            self._snapshot = Dictionary(
+                np.array(self.values, dtype=object)
+            )
+        return self._snapshot
 
 
 class KVTable:
     def __init__(self, db: DB, name: str, schema: Schema, pk: str,
-                 table_id: int):
+                 table_id: int, dict_table_id: int | None = None):
         for t in schema.types:
             if t.family in _UNSUPPORTED:
                 raise TypeError(
@@ -60,10 +92,113 @@ class KVTable:
             )
         # snapshot timestamp for reads; None = now() at device_batch time
         self.read_ts: int | None = None
+        # STRING columns: dictionary-coded in the value slots; the mapping
+        # persists in a companion key space of the same engine
+        self._string_cols = tuple(
+            i for i, t in enumerate(schema.types)
+            if t.family is Family.STRING
+        )
+        self.dict_table_id = dict_table_id
+        self._dicts: dict[int, _TableDict] = {}
+        if self._string_cols:
+            if dict_table_id is None:
+                raise ValueError(
+                    "STRING columns need a dict_table_id (companion key "
+                    "space for the persistent dictionary)"
+                )
+            self._load_dicts()
+
+    # -- persistent dictionaries --------------------------------------------
+
+    @staticmethod
+    def _dict_pk(col: int, code: int) -> int:
+        return (col << 40) | code
+
+    def _load_dicts(self) -> None:
+        """Rebuild dictionaries from the companion span (restore path)."""
+        start, end = rowcodec.table_span(self.dict_table_id)
+        rows = self.db.scan(start, end)
+        by_col: dict[int, list[tuple[int, str]]] = {}
+        for k, v in rows:
+            pk = rowcodec.decode_pk(k)
+            col, code = pk >> 40, pk & ((1 << 40) - 1)
+            ln = int.from_bytes(v[:2], "little")
+            by_col.setdefault(col, []).append(
+                (code, v[2:2 + ln].decode("utf-8"))
+            )
+        for i in self._string_cols:
+            entries = sorted(by_col.get(i, []))
+            if [c for c, _ in entries] != list(range(len(entries))):
+                raise ValueError(
+                    f"corrupt string dictionary for {self.name!r} column "
+                    f"{i}: codes {[c for c, _ in entries]} have holes"
+                )
+            self._dicts[i] = _TableDict([s for _, s in entries])
+
+    def _encode_strings(self, t: Txn, row: dict) -> dict:
+        """Replace str values with dictionary codes, persisting new entries
+        in the same transaction (atomic with the row write).
+
+        New codes stay PENDING on the transaction until commit: the
+        in-memory dictionary must roll back with the txn, or a retry/abort
+        would leave it permanently ahead of the engine's companion span
+        (committed rows referencing codes the persistent dictionary lost)."""
+        if not self._string_cols:
+            return row
+        out = dict(row)
+        vw = self.db.engine.val_width
+        pending = getattr(t, "_dict_pending", None)
+        if pending is None:
+            pending = t._dict_pending = {}
+        slots = pending.get(id(self))
+        if slots is None:
+            slots = pending[id(self)] = {}  # col -> {str: pending code}
+            t.on_commit(lambda: self._commit_pending(slots))
+        for i in self._string_cols:
+            name = self.schema.names[i]
+            v = out.get(name)
+            if v is None:
+                continue
+            if isinstance(v, (int, np.integer)):
+                continue  # already a code
+            v = str(v)
+            d = self._dicts.setdefault(i, _TableDict())
+            slot = slots.setdefault(i, {})
+            code = d.code_of(v)
+            if code is None:
+                code = slot.get(v)
+            if code is None:
+                enc = v.encode("utf-8")
+                if len(enc) + 2 > vw:
+                    raise ValueError(
+                        f"string of {len(enc)} bytes exceeds engine value "
+                        f"width {vw}"
+                    )
+                code = len(d.values) + len(slot)
+                slot[v] = code
+                t.put(
+                    rowcodec.encode_pk(self.dict_table_id,
+                                       self._dict_pk(i, code)),
+                    len(enc).to_bytes(2, "little") + enc,
+                )
+            out[name] = code
+        return out
+
+    def _commit_pending(self, slots: dict) -> None:
+        for i, mapping in slots.items():
+            d = self._dicts.setdefault(i, _TableDict())
+            for v, code in sorted(mapping.items(), key=lambda x: x[1]):
+                got = d.add(v)
+                if got != code:
+                    raise RuntimeError(
+                        f"dictionary code drift: {v!r} got {got}, "
+                        f"txn assigned {code}"
+                    )
 
     # -- write surface ------------------------------------------------------
 
     def insert(self, t: Txn, row: dict) -> None:
+        row = self._encode_strings(t, row)
         key = rowcodec.encode_pk(self.table_id, int(row[self.pk]))
         t.put(key, rowcodec.encode_row(self.schema, row))
 
@@ -72,7 +207,15 @@ class KVTable:
 
     def get_row(self, pk: int, ts: int | None = None) -> dict | None:
         v = self.db.get(rowcodec.encode_pk(self.table_id, int(pk)), ts=ts)
-        return None if v is None else rowcodec.decode_row(self.schema, v)
+        if v is None:
+            return None
+        row = rowcodec.decode_row(self.schema, v)
+        for i in self._string_cols:
+            name = self.schema.names[i]
+            code = row.get(name)
+            if code is not None:
+                row[name] = self._dicts[i].values[int(code)]
+        return row
 
     # -- Table facade (catalog.Table duck type) ------------------------------
 
@@ -105,11 +248,14 @@ class KVTable:
         return n
 
     def dict_by_index(self) -> dict:
-        return {}
+        return {i: d.snapshot() for i, d in self._dicts.items()}
 
     @property
     def dictionaries(self) -> dict:
-        return {}
+        return {
+            self.schema.names[i]: d.snapshot()
+            for i, d in self._dicts.items()
+        }
 
     @property
     def valids(self):
@@ -175,13 +321,22 @@ def create_kv_table(catalog, db: DB, name: str, schema: Schema, pk: str,
                     table_id: int | None = None) -> KVTable:
     """Create + register a KV-backed table in the catalog so sql()/Rel
     scans resolve to it. table_id determines the key-space prefix; ids must
-    be unique per engine or spans would overlap."""
-    used = {t.table_id for t in catalog.tables.values()
-            if isinstance(t, KVTable)}
+    be unique per engine or spans would overlap. Tables with STRING columns
+    get a second id for the persistent dictionary span."""
+    used = set()
+    for t in catalog.tables.values():
+        if isinstance(t, KVTable):
+            used.add(t.table_id)
+            if t.dict_table_id is not None:
+                used.add(t.dict_table_id)
     if table_id is None:
         table_id = max(used, default=0) + 1
     elif table_id in used:
         raise ValueError(f"table_id {table_id} already in use")
-    t = KVTable(db, name, schema, pk, table_id)
+    used.add(table_id)
+    dict_table_id = None
+    if any(tt.family is Family.STRING for tt in schema.types):
+        dict_table_id = max(used, default=0) + 1
+    t = KVTable(db, name, schema, pk, table_id, dict_table_id)
     catalog.tables[name] = t
     return t

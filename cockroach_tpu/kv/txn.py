@@ -35,6 +35,14 @@ class TransactionRetryError(Exception):
 class TransactionAbortedError(Exception):
     """Non-retryable inside the closure: the txn was aborted."""
 
+# txn control-flow errors cross the query error boundary unwrapped (the
+# colexecerror.ExpectedError discipline)
+from ..utils.errors import register_passthrough as _rp  # noqa: E402
+
+_rp(TransactionRetryError)
+_rp(TransactionAbortedError)
+
+
 
 _txn_ids = itertools.count(1)
 
@@ -50,6 +58,13 @@ class Txn:
     _read_spans: list[tuple[bytes, bytes | None, bool]] = field(
         default_factory=list)
     _write_keys: list[bytes] = field(default_factory=list)
+    # callbacks fired once after a SUCCESSFUL commit (discarded on
+    # rollback/retry): side effects that must be atomic with the txn
+    # (e.g. KVTable's in-memory dictionary additions)
+    _commit_hooks: list = field(default_factory=list)
+
+    def on_commit(self, cb) -> None:
+        self._commit_hooks.append(cb)
 
     # -- reads --------------------------------------------------------------
 
@@ -121,6 +136,8 @@ class Txn:
             self.txn_id, commit_ts, commit=True
         )
         self._finished = True
+        for cb in self._commit_hooks:
+            cb()
         return commit_ts
 
     def rollback(self) -> None:

@@ -603,8 +603,14 @@ class DistributedQuery:
         )
 
     def run(self) -> dict[str, np.ndarray]:
-        out, schema, dicts = self.run_batch()
-        return to_host(out, schema, dicts)
+        from ..utils.errors import query_boundary
+
+        @query_boundary("distributed flow")
+        def _go():
+            out, schema, dicts = self.run_batch()
+            return to_host(out, schema, dicts)
+
+        return _go()
 
     def explain(self) -> str:
         from ..plan.explain import explain_plan

@@ -43,16 +43,17 @@ _TYPE_MAP = {
 }
 
 
+class NotALiteral(BindError):
+    """The expression is not a constant (it references columns)."""
+
+
 def _col_type(c: P.ColumnDef) -> T.SQLType:
     tn = c.type_name
     if tn in ("decimal", "numeric"):
         return T.DECIMAL(c.precision or 19,
                          c.scale if c.scale is not None else 2)
     if tn in ("string", "text", "varchar", "char"):
-        raise BindError(
-            "STRING columns in KV tables need the dictionary write path "
-            "(planned); use fixed-width types"
-        )
+        return T.STRING
     t = _TYPE_MAP.get(tn)
     if t is None:
         raise BindError(f"unknown column type {tn!r}")
@@ -127,6 +128,12 @@ class Session:
 
     @staticmethod
     def _literal(e: P.Node, t: T.SQLType):
+        """Evaluate a literal expression for column type t. Raises
+        NotALiteral when the expression references columns (the caller may
+        then route it through the engine); genuine validation errors
+        (precision overflow, type mismatch) raise BindError and MUST
+        propagate — swallowing them would silently reclassify an invalid
+        literal as a computed expression."""
         from .binder import _fold
 
         e = _fold(e)
@@ -157,13 +164,12 @@ class Session:
             return int((np.datetime64(e.value) -
                         np.datetime64("1970-01-01")).astype(int))
         if isinstance(e, (P.Bin,)):
-            raise BindError("INSERT VALUES supports literals only")
+            raise NotALiteral("expression references columns")
         if isinstance(e, P.StrLit):
-            raise BindError("STRING values need the dictionary write path")
-        if e.__class__.__name__ == "NumLit":
-            return e.value
-        # booleans arrive as true/false keywords folded to idents
-        raise BindError(f"unsupported INSERT literal {e}")
+            if t.family is not T.Family.STRING:
+                raise BindError("string literal for non-STRING column")
+            return e.value  # KVTable dictionary-encodes on insert
+        raise NotALiteral(f"not a literal: {e}")
 
     def _insert(self, stmt: P.Insert):
         t = self._kv_table(stmt.table)
@@ -230,22 +236,35 @@ class Session:
 
     def _update(self, stmt: P.Update):
         t = self._kv_table(stmt.table)
-        for col, _ in stmt.sets:
+        # literal SETs (incl. string literals, whose dictionary code may not
+        # exist yet) evaluate host-side; column-referencing SETs compute
+        # through the columnar engine alongside the WHERE scan
+        const_sets: dict[str, object] = {}
+        computed_sets: list[tuple[str, P.Node]] = []
+        for col, e in stmt.sets:
             if col not in t.schema.names:
                 raise BindError(f"unknown column {col!r}")
             if col == t.pk:
                 raise BindError("updating the PRIMARY KEY is not supported")
-        res = self._affected(t, stmt.where, list(stmt.sets))
+            try:
+                const_sets[col] = self._literal(e, t.schema.type_of(col))
+            except NotALiteral:
+                computed_sets.append((col, e))
+        res = self._affected(t, stmt.where, computed_sets)
         n = len(res[t.pk])
+        computed = {c for c, _ in computed_sets}
 
         def op(txn):
             for i in range(n):
                 row = {}
                 for cname, typ in zip(t.schema.names, t.schema.types):
-                    src = (f"__set_{cname}"
-                           if any(c == cname for c, _ in stmt.sets)
-                           else cname)
-                    row[cname] = _from_result(res[src][i], typ)
+                    if cname in computed:
+                        row[cname] = _from_result(res[f"__set_{cname}"][i],
+                                                  typ)
+                    elif cname in const_sets:
+                        row[cname] = const_sets[cname]
+                    else:
+                        row[cname] = _from_result(res[cname][i], typ)
                 t.insert(txn, row)  # MVCC: a new version at the txn ts
 
         self.db.txn(op)
@@ -267,9 +286,12 @@ class Session:
 
 def _from_result(v, t: T.SQLType):
     """Convert a materialized result value back to the row-encoding domain
-    (to_host descales DECIMAL to float; re-scale for storage)."""
+    (to_host descales DECIMAL to float and decodes STRING dictionaries;
+    re-scale / re-encode for storage)."""
     if v is None:
         return None
+    if t.family is T.Family.STRING:
+        return str(v)  # KVTable dictionary-encodes on insert
     if t.family is T.Family.DECIMAL:
         return int(round(float(v) * (10 ** t.scale)))
     if t.family is T.Family.FLOAT:

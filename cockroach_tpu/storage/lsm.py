@@ -109,6 +109,11 @@ class WriteIntentError(Exception):
         self.txns = txns
 
 
+from ..utils.errors import register_passthrough as _rp  # noqa: E402
+
+_rp(WriteIntentError)  # expected error: crosses the query boundary unwrapped
+
+
 @dataclass
 class MVCCStats:
     """Coarse engine stats (enginepb.MVCCStats analog)."""
@@ -177,6 +182,9 @@ class Engine:
         # txn id holding an intent. Kept in sync by _append/resolve_intents
         # so lock checks are O(1) host lookups, never device merges.
         self._locks: dict[bytes, int] = {}
+        # host-side newest-committed-timestamp index (tscache analog): keeps
+        # the per-write WriteTooOld check off the device
+        self._newest_committed: dict[bytes, int] = {}
         # read caches, invalidated by generation counters
         self._gen = 0  # bumps whenever the run set changes
         self._runs_view_cache: tuple[int, mvcc.KVBlock] | None = None
@@ -303,6 +311,8 @@ class Engine:
         self._seq = max(self._seq, seq)
         if txn != 0:
             self._locks[b] = int(txn)
+        elif ts > self._newest_committed.get(b, 0):
+            self._newest_committed[b] = ts
         self.mem.keys.append(b)
         self.mem.ts.append(ts)
         self.mem.seq.append(seq)
@@ -527,6 +537,10 @@ class Engine:
         if self._wal is not None and not self._replaying:
             self._wal_record(_REC_RESOLVE, b"", b"", int(commit_ts), 0,
                              int(txn), commit)
+        if commit:
+            for k, t in self._locks.items():
+                if t == txn and commit_ts > self._newest_committed.get(k, 0):
+                    self._newest_committed[k] = int(commit_ts)
         self._locks = {k: t for k, t in self._locks.items() if t != txn}
         self.flush_mem_only()
         self.runs = [
@@ -574,16 +588,13 @@ class Engine:
 
     def newest_committed_ts(self, key: bytes) -> int:
         """Timestamp of the newest committed version of `key` (0 if none) —
-        powers the WriteTooOld check. Bounded point lookup: never merges."""
+        powers the WriteTooOld check. O(1) HOST lookup: the engine indexes
+        newest-committed timestamps as writes land (like the reference's
+        timestamp cache, kvserver/tscache) — a device point-read per write
+        would re-upload the memtable per call and made ingest quadratic.
+        open_checkpoint rebuilds the index per key from the restored runs."""
         b = key.encode() if isinstance(key, str) else bytes(key)
-        sw = K.encode_bound(b, self.key_width)
-        ew = K.bound_next(sw)
-        view = self._bounded_view(sw, ew)
-        if view is None:
-            return 0
-        hit = view.mask & (view.txn == 0)
-        ts = jnp.where(hit, view.ts, 0)
-        return int(np.asarray(jnp.max(ts)))
+        return self._newest_committed.get(b, 0)
 
     def intent_keys(self, txn: int) -> list[bytes]:
         return sorted(k for k, t in self._locks.items() if t == txn)
@@ -665,6 +676,17 @@ class Engine:
             m = np.asarray(r.mask)
             if m.any():
                 eng._seq = max(eng._seq, int(np.asarray(r.seq)[m].max()))
+                cm = m & (np.asarray(r.txn) == 0)
+                if cm.any():
+                    # rebuild the per-key newest-committed index exactly —
+                    # a global floor would block writers on EVERY key until
+                    # the clock passed the restored max timestamp
+                    idx = np.nonzero(cm)[0]
+                    ks = K.decode_keys(np.asarray(r.key)[idx])
+                    ts = np.asarray(r.ts)[idx]
+                    for kk, tt in zip(ks, ts):
+                        if int(tt) > eng._newest_committed.get(kk, 0):
+                            eng._newest_committed[kk] = int(tt)
             im = m & (np.asarray(r.txn) != 0)
             if im.any():
                 ks = K.decode_keys(np.asarray(r.key)[np.nonzero(im)[0]])

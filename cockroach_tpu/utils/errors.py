@@ -1,0 +1,56 @@
+"""Query error boundary — the colexecerror analog.
+
+Reference: pkg/sql/colexecerror/error.go:45 CatchVectorizedRuntimeError
+converts engine panics (index-out-of-range in generated kernels, internal
+assertions) into SQL errors at the flow boundary so a bad kernel never
+takes down the process with a raw stack. Here the boundary wraps the flow
+pull loop and the distributed SPMD runner: any failure below it surfaces
+as a typed QueryError carrying the failing operator/stage context, while
+programming errors in the session layer (BindError and friends) pass
+through untouched.
+"""
+
+from __future__ import annotations
+
+
+class QueryError(Exception):
+    """A query failed inside the execution engine. str() is user-facing;
+    __cause__ keeps the original exception for debugging."""
+
+    def __init__(self, stage: str, cause: BaseException):
+        self.stage = stage
+        super().__init__(
+            f"query execution failed in {stage}: "
+            f"{type(cause).__name__}: {cause}"
+        )
+
+
+# exception types that are NOT engine failures and must pass through the
+# boundary untouched (user-facing or control-flow exceptions)
+_PASSTHROUGH: tuple[type, ...] = (QueryError, KeyboardInterrupt, SystemExit)
+
+
+def register_passthrough(exc_type: type) -> None:
+    """Let a domain exception (e.g. kv.WriteIntentError) cross the boundary
+    unwrapped — the analog of colexecerror.ExpectedError."""
+    global _PASSTHROUGH
+    if exc_type not in _PASSTHROUGH:
+        _PASSTHROUGH = _PASSTHROUGH + (exc_type,)
+
+
+def query_boundary(stage: str):
+    """Decorator: wrap engine failures in QueryError (panic->error)."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            try:
+                return fn(*args, **kwargs)
+            except _PASSTHROUGH:
+                raise
+            except Exception as e:
+                raise QueryError(stage, e) from e
+        return wrapped
+
+    return deco

@@ -153,3 +153,54 @@ def test_streaming_scan_matches_resident(cat):
             np.testing.assert_allclose(
                 g.astype(np.float64), w.astype(np.float64),
                 rtol=1e-9, err_msg=k)
+
+
+def test_query_error_boundary(cat):
+    """Engine/kernel failures surface as typed QueryError at the flow
+    boundary, never a raw backend traceback (colexecerror/error.go:45);
+    expected domain errors pass through unwrapped."""
+    import jax.numpy as jnp
+
+    from cockroach_tpu.flow.runtime import run_operator
+    from cockroach_tpu.plan import builder as plan_builder
+    from cockroach_tpu.sql.rel import Rel
+    from cockroach_tpu.utils.errors import QueryError
+
+    rel = Rel.scan(cat, "lineitem", ("l_orderkey",))
+    root = plan_builder.build(rel.plan, cat)
+
+    class Broken:
+        output_schema = root.output_schema
+        dictionaries = {}
+        col_stats = {}
+
+        def init(self):
+            pass
+
+        def next_batch(self):
+            raise AssertionError("kernel blew up")
+
+        def close(self):
+            pass
+
+    with pytest.raises(QueryError) as ei:
+        run_operator(Broken())
+    assert "kernel blew up" in str(ei.value)
+    assert isinstance(ei.value.__cause__, AssertionError)
+
+    # distributed boundary: a plan over a KV table cannot distribute and
+    # must surface as a clean QueryError (wrapping the TypeError)
+    from cockroach_tpu.utils.errors import register_passthrough
+    from cockroach_tpu.kv.txn import TransactionRetryError
+
+    register_passthrough(TransactionRetryError)
+
+    def raises_passthrough():
+        raise TransactionRetryError()
+
+    class Passthrough(Broken):
+        def next_batch(self):
+            raises_passthrough()
+
+    with pytest.raises(TransactionRetryError):
+        run_operator(Passthrough())

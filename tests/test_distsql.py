@@ -46,8 +46,11 @@ def _unordered(res: dict, keys: list[str]) -> dict:
 # north-star queries through the distributed planner
 
 
-@pytest.mark.parametrize("qname", ["q1", "q3", "q9", "q18"])
-def test_north_star_queries_distributed(cat, mesh, qname):
+@pytest.mark.parametrize("qname", sorted(Q.QUERIES))
+def test_all_tpch_distributed(cat, mesh, qname):
+    """22/22: every TPC-H query through distribute()+shard_map on the
+    8-device mesh must match the single-device flow engine (the fakedist
+    discipline, logictestbase.go:315)."""
     rel = Q.QUERIES[qname](cat)
     want = rel.run()
     got = rel.run_distributed(mesh)

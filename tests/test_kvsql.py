@@ -168,3 +168,53 @@ def test_ycsb_e_microbench():
     assert out["ops_per_sec"] > 0
     assert out["rows_scanned"] >= 5 * 16  # scans dominate the mix (a scan
     # starting near the end of the keyspace legitimately returns fewer rows)
+
+
+def test_q1_over_kv_backed_lineitem():
+    """TPC-H Q1 end-to-end over a lineitem that LIVES IN THE ENGINE —
+    strings included (VERDICT: the kv/table.py fixed-width restriction is
+    gone). Oracle: the same query over the host-resident catalog table."""
+    from cockroach_tpu.bench import queries as Q
+    from cockroach_tpu.bench import tpch
+
+    host_cat = tpch.gen_tpch(sf=0.002, seed=5)
+    want = Q.q1(host_cat).run()
+
+    li = host_cat.get("lineitem")
+    db = DB(
+        Engine(key_width=16, val_width=rowcodec.value_width(li.schema),
+               memtable_size=1 << 14),
+        ManualClock(),
+    )
+    kv_cat = catalog_mod.Catalog()
+    kvt = create_kv_table(kv_cat, db, "lineitem", li.schema, pk="l_rowid"
+                          if "l_rowid" in li.schema.names else
+                          li.schema.names[0])
+    # lineitem has no single-column pk; use a synthetic rowid as the key
+    n = li.num_rows
+
+    def ins(txn):
+        for r in range(n):
+            row = {}
+            for cname in li.schema.names:
+                v = li.columns[cname][r]
+                if cname in li.dictionaries:
+                    v = li.dictionaries[cname].values[int(v)]
+                row[cname] = v
+            # key by row index: l_orderkey repeats, so the first column
+            # cannot key the row; overwrite the pk encoding input
+            row[kvt.pk] = r
+            kvt.insert(txn, row)
+
+    db.txn(ins)
+    assert kvt.num_rows == n
+
+    got = Q.q1(kv_cat).run()
+    assert list(got["l_returnflag"]) == list(want["l_returnflag"])
+    assert list(got["l_linestatus"]) == list(want["l_linestatus"])
+    for col in ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
+                "avg_qty", "avg_price", "avg_disc", "count_order"):
+        np.testing.assert_allclose(
+            np.asarray(got[col], dtype=np.float64),
+            np.asarray(want[col], dtype=np.float64), rtol=1e-9,
+        )
