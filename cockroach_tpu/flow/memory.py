@@ -1,0 +1,74 @@
+"""Memory accounting — the colmem.Allocator / mon.BytesMonitor analog.
+
+Reference: pkg/sql/colmem/allocator.go:32 wraps every batch mutation with
+byte accounting against a BytesMonitor; pkg/sql/colexec/colexecdisk/
+disk_spiller.go:103 swaps an in-memory operator for its external variant
+when the account would exceed the budget. Here buffering operators charge
+their spools to an Allocator sized by `sql.distsql.workmem_bytes` (device
+HBM is the scarce resource; XLA owns the actual allocations, so accounting
+tracks LOGICAL bytes of live tiles — capacity x dtype width — which is what
+HBM pressure follows under static shapes)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..coldata.batch import Batch
+
+
+class BudgetExceededError(Exception):
+    """An operator's reservation would exceed its memory budget — callers
+    spill to the external variant or fail the query cleanly."""
+
+    def __init__(self, op: str, want: int, budget: int):
+        super().__init__(
+            f"{op}: memory budget exceeded "
+            f"({want} bytes wanted, budget {budget})"
+        )
+        self.want = want
+        self.budget = budget
+
+
+def batch_bytes(b: Batch) -> int:
+    """Logical device bytes of a tile: data + valid bitmap per column, plus
+    the liveness mask (bools are 1 byte under XLA's dense layout)."""
+    total = b.capacity  # mask
+    for c in b.cols:
+        total += c.data.size * c.data.dtype.itemsize
+        total += c.valid.size * c.valid.dtype.itemsize
+    return int(total)
+
+
+class Allocator:
+    """Byte account for one operator (or operator subtree).
+
+    Unlike the reference's hierarchical monitors, budgets here are flat
+    per-operator accounts against the workmem setting — the multi-tenant
+    monitor tree arrives with the control plane."""
+
+    def __init__(self, op: str, budget: int | None = None):
+        from ..utils import settings
+
+        self.op = op
+        self.budget = (budget if budget is not None
+                       else settings.get("sql.distsql.workmem_bytes"))
+        self.used = 0
+        self.high_water = 0
+
+    def would_exceed(self, nbytes: int) -> bool:
+        return self.used + int(nbytes) > self.budget
+
+    def reserve(self, nbytes: int) -> None:
+        n = int(nbytes)
+        if self.used + n > self.budget:
+            raise BudgetExceededError(self.op, self.used + n, self.budget)
+        self.used += n
+        self.high_water = max(self.high_water, self.used)
+
+    def reserve_batch(self, b: Batch) -> int:
+        n = batch_bytes(b)
+        self.reserve(n)
+        return n
+
+    def release(self, nbytes: int | None = None) -> None:
+        self.used = 0 if nbytes is None else max(0, self.used - int(nbytes))

@@ -26,12 +26,13 @@ class ComponentStats:
     (execinfrapb/component_stats.proto), folded into EXPLAIN ANALYZE by
     plan/explain.py (the execstats/traceanalyzer.go role)."""
 
-    __slots__ = ("batches", "rows", "time_s")
+    __slots__ = ("batches", "rows", "time_s", "bytes")
 
     def __init__(self):
         self.batches = 0
         self.rows = 0
         self.time_s = 0.0  # inclusive wall time in next_batch (incl. children)
+        self.bytes = 0  # logical device bytes emitted (colmem accounting)
 
     def exclusive(self, children: list["Operator"]) -> float:
         return self.time_s - sum(c.stats.time_s for c in children)
@@ -73,8 +74,11 @@ class Operator:
             # row counting forces a device sync, so exact per-operator times
             # and rows are an EXPLAIN ANALYZE-only cost (like the reference's
             # stats collection wrappers in colflow/stats.go)
+            from .memory import batch_bytes
+
             self.stats.rows += int(np.asarray(b.mask).sum())
             self.stats.batches += 1
+            self.stats.bytes += batch_bytes(b)
         self.stats.time_s += time.perf_counter() - t0
         return b
 

@@ -528,21 +528,27 @@ class AggregateOp(OneInputOperator):
 
     def _spool(self):
         """Spool per-tile partial states (fused with the streaming chain
-        beneath); merge down only when the spool exceeds workmem."""
+        beneath); merge down only when the spool exceeds workmem (rows or
+        accounted bytes — the colmem.Allocator discipline)."""
         from ..utils import settings
+        from .memory import batch_bytes
 
         budget = settings.get("sql.distsql.workmem_rows")
+        byte_budget = settings.get("sql.distsql.workmem_bytes")
         if self.mode == "final":
             tile_raw, tile_jit = _identity_fn, _identity_fn
         else:
             tile_raw, tile_jit = self._partial_raw, self._partial_fn
         spooled = 0
+        spooled_bytes = 0
         for part in _consume(self, "partial", tile_raw, tile_jit):
             self._tiles.append(part)
             spooled += part.capacity
-            if spooled > budget:
+            spooled_bytes += batch_bytes(part)
+            if spooled > budget or spooled_bytes > byte_budget:
                 self._tiles = [self._merge_down()]
                 spooled = self._tiles[0].capacity
+                spooled_bytes = batch_bytes(self._tiles[0])
 
     def _merge_down(self) -> Batch:
         cap = _spool_cap(self._tiles)
@@ -653,6 +659,7 @@ class SortOp(OneInputOperator):
 
     def _next(self):
         from ..utils import settings
+        from .memory import Allocator, batch_bytes
 
         if self._emitted:
             return None
@@ -661,12 +668,15 @@ class SortOp(OneInputOperator):
         tiles = []
         total = 0
         budget = settings.get("sql.distsql.workmem_rows")
+        alloc = Allocator("sort spool")
         for b in _consume(self, "spool", _identity_fn):
+            nb = batch_bytes(b)
             tiles.append(b)
             total += b.capacity
-            if total > budget:
+            if total > budget or alloc.would_exceed(nb):
                 # spill: hand the spooled tiles + the rest of the input to
-                # the external range-partitioned sort (disk_spiller swap)
+                # the external range-partitioned sort (disk_spiller swap) —
+                # triggered by the ROW budget or the byte ACCOUNT
                 from .external import ChainOp, ExternalSortOp
 
                 chain = ChainOp(tiles, self.output_schema,
@@ -676,6 +686,7 @@ class SortOp(OneInputOperator):
                 )
                 self._external.init()
                 return self._external.next_batch()
+            alloc.reserve(nb)
         self._emitted = True
         if not tiles:
             return None
@@ -776,6 +787,7 @@ class HashJoinOp(OneInputOperator):
         self.build.init()
         super().init()
         self._built = False
+        self._grace = None
         if hasattr(self, "_build_fn"):
             return
         bschema = self.build.output_schema
@@ -838,9 +850,31 @@ class HashJoinOp(OneInputOperator):
             self._out_cap = 0
 
     def _ensure_built(self):
+        from .memory import Allocator, batch_bytes
+
         if self._built:
             return
-        tiles = list(_consume_op(self.build, "build_spool"))
+        alloc = Allocator("hash join build")
+        tiles = []
+        for b in _consume_op(self.build, "build_spool"):
+            nb = batch_bytes(b)
+            if alloc.would_exceed(nb):
+                # build side exceeds workmem: swap in the Grace hash join
+                # (both sides hash-partition so each partition's build fits
+                # the budget — disk_spiller.go's in-memory->external swap)
+                from .external import ChainOp, GraceHashJoinOp
+
+                chain = ChainOp(tiles + [b], self.build.output_schema,
+                                self.build.dictionaries, self.build)
+                self._grace = GraceHashJoinOp(
+                    self.child, chain, self.probe_keys, self.build_keys,
+                    self.spec,
+                )
+                self._grace.init()
+                self._built = True
+                return
+            alloc.reserve(nb)
+            tiles.append(b)
         if not tiles:
             from ..coldata.batch import empty_batch
 
@@ -872,6 +906,8 @@ class HashJoinOp(OneInputOperator):
 
         if self._probe_raw is None:
             return None
+        if getattr(self, "_grace", None) is not None:
+            return None  # spilled: the Grace join drives the probe itself
         if self.fused_depth() > settings.get("sql.distsql.max_fused_joins"):
             # compile-size safety valve: very deep probe pipelines split at
             # this join (it runs as its own per-operator jit) so one fused
@@ -883,6 +919,8 @@ class HashJoinOp(OneInputOperator):
         if not self._initialized:
             self.init()
         self._ensure_built()
+        if getattr(self, "_grace", None) is not None:
+            return None  # the build spilled while spooling
         src, cfn, cargs = parts
         chain = getattr(self, "_chain_fn", None)
         if chain is None or getattr(self, "_chain_base", None) is not cfn:
@@ -898,6 +936,8 @@ class HashJoinOp(OneInputOperator):
 
     def _next(self):
         self._ensure_built()
+        if getattr(self, "_grace", None) is not None:
+            return self._grace._next()
         p = self.child.next_batch()
         if p is None:
             return None

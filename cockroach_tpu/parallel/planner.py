@@ -466,13 +466,21 @@ class _Lowering:
         return _LNode(emit, schema, ln.dicts, ln.replicated, ln.cap)
 
     def _lower_limit(self, plan: S.Limit) -> _LNode:
+        from ..coldata.batch import compact
+
         ln = self.lower(plan.input)
         limit, offset, inner = plan.limit, plan.offset, ln.emit
+        # shrink the tile to the limit: a top-k feeding a Gather then moves
+        # D*pow2(k) rows over ICI, not the whole per-device result
+        out_cap = min(ln.cap, _pow2(limit + offset))
 
         def emit(env):
-            return sort_ops.limit_mask(inner(env), limit, offset)
+            b = sort_ops.limit_mask(inner(env), limit, offset)
+            if out_cap < b.capacity:
+                b = compact(b, capacity=out_cap)  # order-preserving
+            return b
 
-        return _LNode(emit, ln.schema, ln.dicts, ln.replicated, ln.cap)
+        return _LNode(emit, ln.schema, ln.dicts, ln.replicated, out_cap)
 
     def _lower_union(self, plan: S.Union) -> _LNode:
         from ..coldata.batch import concat

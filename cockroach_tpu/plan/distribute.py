@@ -129,6 +129,21 @@ def _rewrite(plan, catalog, broadcast_rows):
         return (S.MergeJoin(probe, _broadcast(build, brep), plan.probe_key,
                             plan.build_key, plan.spec), prep)
 
+    if isinstance(plan, S.Limit) and isinstance(plan.input, S.Sort):
+        # distributed top-k (sorttopk.go + OrderedSynchronizer roles): each
+        # device sorts ITS shard and keeps only limit+offset rows, the
+        # gather moves D*(limit+offset) rows instead of the full result,
+        # and one final sorted-merge + limit runs replicated
+        sort = plan.input
+        child, rep = _rewrite(sort.input, catalog, broadcast_rows)
+        if rep:
+            return S.Limit(S.Sort(child, sort.keys), plan.limit,
+                           plan.offset), True
+        k = plan.limit + plan.offset
+        local = S.Limit(S.Sort(child, sort.keys), k, 0)
+        merged = S.Sort(S.Gather(local), sort.keys)
+        return S.Limit(merged, plan.limit, plan.offset), True
+
     if isinstance(plan, S.Sort):
         child, rep = _rewrite(plan.input, catalog, broadcast_rows)
         return S.Sort(_gather(child, rep), plan.keys), True

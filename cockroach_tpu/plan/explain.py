@@ -98,6 +98,7 @@ def explain_analyze(plan: S.PlanNode, root_op) -> str:
         lines.append(
             "  " * depth + "-> " + _node_label(n)
             + f"  [rows={st.rows} batches={st.batches} "
+            f"bytes={st.bytes} "
             f"time={st.time_s*1e3:.1f}ms self={excl*1e3:.1f}ms]"
         )
         for c, co in zip(_children(n), op.children()):

@@ -158,6 +158,13 @@ WORKMEM_ROWS = register_int(
     "threshold (disk_spiller.go:103 analog)",
     lo=1024,
 )
+WORKMEM_BYTES = register_int(
+    "sql.distsql.workmem_bytes", 2 << 30,
+    "per-operator device-byte budget for buffering spools (colmem.Allocator "
+    "against mon.BytesMonitor analog); exceeding it swaps in the external "
+    "operator variant (disk_spiller.go:103)",
+    lo=1 << 16,
+)
 SCAN_STREAM_ROWS = register_int(
     "sql.distsql.scan_stream_rows", 1 << 23,
     "tables larger than this stream host->device tile by tile with "

@@ -187,3 +187,26 @@ def test_explain_distributed_stages(cat):
     # Q3 = 3-table join + group-by + sort: every stage class must appear
     assert "exchange" in txt or "broadcast" in txt
     assert "gather" in txt  # final ordered fan-in
+
+
+def test_distributed_topk_avoids_full_gather(cat, mesh):
+    """ORDER BY + LIMIT distributes as per-device top-k + small gather +
+    sorted merge — the sorttopk.go/OrderedSynchronizer pattern. The plan
+    must NOT gather the full result, and results must match exactly."""
+    for qname in ("q3", "q18"):
+        rel = Q.QUERIES[qname](cat)
+        txt = rel.explain_distributed()
+        assert "gather" in txt.lower()
+        # structural check: plan is Limit(Sort(Gather(Limit(Sort(...)))))
+        # — the gather moves per-device top-k rows, not the full result
+        from cockroach_tpu.plan import spec as S
+        from cockroach_tpu.plan.distribute import distribute
+
+        d = distribute(rel.plan, cat)
+        assert isinstance(d, S.Limit) and isinstance(d.input, S.Sort)
+        assert isinstance(d.input.input, S.Gather)
+        inner = d.input.input.input
+        assert isinstance(inner, S.Limit) and isinstance(inner.input, S.Sort)
+        want = rel.run()
+        got = rel.run_distributed(mesh)
+        _assert_same(got, want)
