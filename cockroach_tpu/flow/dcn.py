@@ -1,0 +1,173 @@
+"""Cross-host flow streams — the colrpc Outbox/Inbox over DCN.
+
+Reference: a remote DistSQL flow streams Arrow-encoded batches over gRPC
+FlowStream (pkg/sql/colflow/colrpc/outbox.go:44 serializes via colserde at
+:280; inbox.go:48 is an Operator whose Next() reads the stream; the service
+is execinfrapb/api.proto:143-166 SetupFlow/FlowStream). The TPU mapping
+(SURVEY §2.3): in-slice shuffles ride ICI collectives (parallel/shuffle.py);
+ACROSS slices/hosts batches travel as Arrow IPC over the data-center
+network. This module is that DCN lane:
+
+- ``FlowOutbox``: drives a local operator and streams its batches as Arrow
+  IPC messages over a socket (length-prefixed), then an end-of-stream
+  marker.
+- ``FlowInbox``: a SourceOperator whose next_batch() reads one Arrow
+  message from the socket and uploads it as a device Batch — downstream
+  operators cannot tell it from a local scan.
+- ``FlowServer``: listens for SetupFlow-style requests naming a registered
+  flow (a callable returning an Operator) and answers with the stream —
+  the ServerImpl.SetupFlow reduction (one request per connection; the
+  FlowRegistry/StreamID matching arrives with the full control plane).
+
+Framing: 4-byte little-endian length + Arrow IPC stream bytes per batch;
+length 0 terminates. Arrow IPC is self-describing, so schema and
+dictionaries travel with the data (colserde's RecordBatchSerializer role).
+"""
+
+from __future__ import annotations
+
+import io
+import socket
+import struct
+import threading
+
+import pyarrow as pa
+
+from ..coldata import arrow as arrow_mod
+from ..coldata.batch import Batch, Dictionary
+from ..coldata.types import Schema
+from .operator import Operator, SourceOperator
+
+_LEN = struct.Struct("<I")
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("flow stream closed mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> bytes | None:
+    n = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
+    if n == 0:
+        return None
+    return _recv_exact(sock, n)
+
+
+def _encode_batch(b: Batch, schema: Schema, dictionaries) -> bytes:
+    rb = arrow_mod.batch_to_arrow(b, schema, dictionaries)
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    return sink.getvalue()
+
+
+def _decode_batch(payload: bytes):
+    with pa.ipc.open_stream(io.BytesIO(payload)) as r:
+        rb = r.read_next_batch()
+    return arrow_mod.batch_from_arrow(rb)
+
+
+class FlowOutbox:
+    """Stream every batch of `op` over the socket (outbox.go:44 role)."""
+
+    def __init__(self, op: Operator, sock: socket.socket):
+        self.op = op
+        self.sock = sock
+
+    def run(self) -> int:
+        sent = 0
+        self.op.init()
+        while True:
+            b = self.op.next_batch()
+            if b is None:
+                break
+            payload = _encode_batch(
+                b, self.op.output_schema, self.op.dictionaries
+            )
+            _send_msg(self.sock, payload)
+            sent += 1
+        self.sock.sendall(_LEN.pack(0))  # end of stream
+        self.op.close()
+        return sent
+
+
+class FlowInbox(SourceOperator):
+    """An Operator fed by a remote flow stream (inbox.go:48 role). The
+    schema arrives with the first Arrow message; callers that need it
+    before pulling can pass the expected schema up front."""
+
+    def __init__(self, sock: socket.socket, schema: Schema,
+                 dictionaries: dict[int, Dictionary] | None = None):
+        super().__init__()
+        self.sock = sock
+        self.output_schema = schema
+        self.dictionaries = dict(dictionaries or {})
+        self._done = False
+
+    def _next(self):
+        if self._done:
+            return None
+        payload = _recv_msg(self.sock)
+        if payload is None:
+            self._done = True
+            return None
+        b, schema, dicts = _decode_batch(payload)
+        # remote dictionaries override (codes are stream-relative)
+        self.dictionaries.update(dicts)
+        return b
+
+
+class FlowServer:
+    """Answers SetupFlow requests: the client sends a flow name (one line),
+    the server streams that flow's batches back. One request per
+    connection — the reduced ServerImpl.SetupFlow/FlowStream pairing."""
+
+    def __init__(self, flows: dict[str, object], host: str = "127.0.0.1",
+                 port: int = 0):
+        self.flows = flows
+        self._srv = socket.create_server((host, port))
+        self.addr = self._srv.getsockname()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def serve_background(self) -> "FlowServer":
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def _serve(self) -> None:
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            try:
+                name = _recv_msg(conn).decode("utf-8")
+                make_op = self.flows[name]
+                FlowOutbox(make_op(), conn).run()
+            finally:
+                conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._srv.close()
+
+
+def setup_remote_flow(addr, name: str, schema: Schema) -> FlowInbox:
+    """Dial a FlowServer and return the Inbox for the named flow — the
+    DistSQLPlanner.setupFlows remote half (distsql_running.go:391)."""
+    sock = socket.create_connection(tuple(addr))
+    _send_msg(sock, name.encode("utf-8"))
+    return FlowInbox(sock, schema)

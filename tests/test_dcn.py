@@ -1,0 +1,149 @@
+"""Cross-host flow streams (DCN skeleton): a table split across TWO
+PROCESSES joins back together through Arrow-over-socket Outbox/Inbox —
+the colrpc FlowStream parity point (outbox.go:44 / inbox.go:48 /
+execinfrapb api.proto SetupFlow), with the second process standing in for
+a remote node."""
+
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.bench import tpch
+from cockroach_tpu.coldata.types import Schema
+from cockroach_tpu.flow import dcn
+from cockroach_tpu.flow.operators import ScanOp, UnionOp
+from cockroach_tpu.flow.runtime import run_operator
+
+
+def _half_catalog(half: int):
+    """Deterministic split: both processes regenerate the same catalog and
+    take complementary halves of `orders` (the range/leaseholder split
+    stand-in)."""
+    cat = tpch.gen_tpch(sf=0.005, seed=23)
+    t = cat.get("orders")
+    n = t.num_rows
+    sel = np.arange(n) % 2 == half
+    t.columns = {k: v[sel] for k, v in t.columns.items()}
+    t.valids = {k: v[sel] for k, v in t.valids.items()}
+    t._device = None
+    t._stats = None
+    return cat
+
+
+def _serve_half(q):
+    """Child process: serve the scan of ITS half of orders as a flow."""
+    from cockroach_tpu.utils.backend import force_cpu_backend
+
+    force_cpu_backend()
+    cat = _half_catalog(1)
+
+    def make_op():
+        return ScanOp(cat.get("orders"))
+
+    srv = dcn.FlowServer({"orders_half": make_op}).serve_background()
+    q.put(srv.addr)
+    # serve until the parent says stop
+    q.get()
+    srv.close()
+
+
+@pytest.fixture(scope="module")
+def remote():
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_serve_half, args=(q,), daemon=True)
+    p.start()
+    addr = q.get(timeout=120)
+    yield addr
+    q.put("stop")
+    p.join(timeout=10)
+    if p.is_alive():
+        p.terminate()
+
+
+def test_two_process_scan_union(remote):
+    """Local half UNION remote half == the whole table."""
+    cat = _half_catalog(0)
+    full = tpch.gen_tpch(sf=0.005, seed=23)
+    orders = full.get("orders")
+
+    local = ScanOp(cat.get("orders"))
+    inbox = dcn.setup_remote_flow(remote, "orders_half",
+                                  cat.get("orders").schema)
+    union = UnionOp((local, inbox))
+    got = run_operator(union)
+    assert len(got["o_orderkey"]) == orders.num_rows
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(got["o_orderkey"])),
+        np.sort(np.asarray(orders.columns["o_orderkey"])),
+    )
+    # totalprice survives the Arrow round trip exactly (decimal codec)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(got["o_totalprice"], dtype=np.float64)),
+        np.sort(orders.columns["o_totalprice"] / 100.0), rtol=0,
+    )
+
+
+def test_two_process_join(remote):
+    """A query whose orders input is split across processes: local half
+    UNION remote inbox, joined + aggregated, equals the single-process
+    result (the cross-host Exchange stage stand-in)."""
+    from cockroach_tpu.ops import expr as ex
+    from cockroach_tpu.sql.rel import Rel
+
+    full = tpch.gen_tpch(sf=0.005, seed=23)
+    want = (
+        Rel.scan(full, "orders", ("o_orderkey", "o_custkey"))
+        .join(Rel.scan(full, "customer", ("c_custkey", "c_nationkey")),
+              on=[("o_custkey", "c_custkey")])
+        .groupby(["c_nationkey"], [("n", "count_rows", None)])
+        .sort([("c_nationkey", False)])
+        .run()
+    )
+
+    cat = _half_catalog(0)
+    local = ScanOp(cat.get("orders"), ("o_orderkey", "o_custkey"))
+    inbox_schema = cat.get("orders").schema
+    inbox = dcn.setup_remote_flow(remote, "orders_half", inbox_schema)
+
+    # project the inbox stream to the two needed columns via plan surface:
+    # simplest is to union full-schema halves, then go through Rel on a
+    # synthetic catalog table built from the unioned host result
+    union = UnionOp((ScanOp(cat.get("orders")), inbox))
+    rows = run_operator(union)
+    import cockroach_tpu.catalog as catalog_mod
+
+    merged = catalog_mod.Catalog()
+    t = full.get("orders")
+    cols = {}
+    for cname in t.schema.names:
+        v = rows[cname]
+        if cname in t.dictionaries:
+            codes = np.array(
+                [t.dictionaries[cname].code_of(str(x)) for x in v],
+                dtype=np.int32,
+            )
+            cols[cname] = codes
+        elif t.schema.type_of(cname).family.name == "DECIMAL":
+            sc = t.schema.type_of(cname).scale
+            cols[cname] = np.round(
+                np.asarray(v, dtype=np.float64) * 10**sc
+            ).astype(np.int64)
+        else:
+            cols[cname] = np.asarray(v)
+    merged.add(catalog_mod.Table(
+        name="orders", schema=t.schema, columns=cols,
+        dictionaries=t.dictionaries,
+    ))
+    merged.add(full.get("customer"))
+    got = (
+        Rel.scan(merged, "orders", ("o_orderkey", "o_custkey"))
+        .join(Rel.scan(merged, "customer", ("c_custkey", "c_nationkey")),
+              on=[("o_custkey", "c_custkey")])
+        .groupby(["c_nationkey"], [("n", "count_rows", None)])
+        .sort([("c_nationkey", False)])
+        .run()
+    )
+    np.testing.assert_array_equal(got["c_nationkey"], want["c_nationkey"])
+    np.testing.assert_array_equal(got["n"], want["n"])
