@@ -307,8 +307,9 @@ def _emit(final: bool) -> None:
             "error": "; ".join(errors) or "no queries ran",
         }), flush=True)
         return
-    vals = [d["rows_per_sec"] for d in detail.values()]
-    ratios = [d["vs_pandas"] for d in detail.values()]
+    queries = [d for d in detail.values() if "vs_pandas" in d]
+    vals = [d["rows_per_sec"] for d in queries]
+    ratios = [d["vs_pandas"] for d in queries]
     geomean = float(np.exp(np.mean(np.log(vals))))
     geomean_ratio = float(np.exp(np.mean(np.log(ratios))))
     out = {
@@ -378,6 +379,24 @@ def main() -> None:
         except Exception as e:  # keep benching the rest of the ladder
             _partial["errors"].append(f"{qname}: {type(e).__name__}: {e}")
             print(f"# {qname} FAILED: {e}", file=sys.stderr, flush=True)
+
+    # BASELINE config #5: YCSB-E at 1M keys (bulk ingest + scan-heavy ops)
+    if os.environ.get("BENCH_YCSB", "1") != "0":
+        try:
+            from cockroach_tpu.bench.ycsb import run_ycsb_e
+
+            y = run_ycsb_e(n_keys=1 << 20, ops=96, scan_len=64)
+            _partial["detail"]["ycsb_e_1m"] = {
+                "load_keys_per_sec": y["load_keys_per_sec"],
+                "scan_rows_per_sec": round(y["rows_per_sec"]),
+                "ops_per_sec": round(y["ops_per_sec"], 1),
+                "compactions": y["compactions"],
+            }
+            print(f"# ycsb-e 1M keys: load {y['load_keys_per_sec']}/s, "
+                  f"scans {y['rows_per_sec']:.0f} rows/s",
+                  file=sys.stderr, flush=True)
+        except Exception as e:
+            _partial["errors"].append(f"ycsb: {type(e).__name__}: {e}")
 
     killer.cancel()
     if not _partial["detail"]:

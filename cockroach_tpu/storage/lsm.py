@@ -50,6 +50,12 @@ _REC_WRITE = 0
 _REC_RESOLVE = 1
 
 
+def _words_to_bytes(words) -> bytes:
+    """Packed big-endian uint64 key words -> the original zero-padded key
+    bytes (inverse of keys.encode_bound's word packing)."""
+    return b"".join(int(w).to_bytes(8, "big") for w in np.asarray(words))
+
+
 def _pad(n: int, align: int = _RUN_ALIGN) -> int:
     """Next power-of-2 capacity >= n (min `align`): blocks take only O(log)
     distinct static shapes, so kernels compile a handful of times total."""
@@ -81,6 +87,18 @@ def _range_mask(block: mvcc.KVBlock, sw, ew):
     words = K.key_words(block.key)
     m = block.mask & K.words_in_range(words, sw, ew)
     return m, jnp.sum(m, dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def _slice_window(block: mvcc.KVBlock, pos, size: int) -> mvcc.KVBlock:
+    """[pos, pos+size) window of a run — the iterator-seek read (O(size)
+    device work regardless of run length)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.dynamic_slice_in_dim(
+            x, jnp.clip(pos, 0, max(0, x.shape[0] - size)), size, axis=0
+        ),
+        block,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("cap",))
@@ -187,6 +205,9 @@ class Engine:
         self._newest_committed: dict[bytes, int] = {}
         # read caches, invalidated by generation counters
         self._gen = 0  # bumps whenever the run set changes
+        # per-run host key bytes for iterator seeks (block-index analog);
+        # keyed by id with a strong run ref so ids can't be reused
+        self._run_key_cache: dict[int, tuple] = {}
         self._runs_view_cache: tuple[int, mvcc.KVBlock] | None = None
         self._mem_cache: tuple[int, mvcc.KVBlock] | None = None
         self._overlay_cache = None  # ((gen, mem len), merged view)
@@ -347,6 +368,59 @@ class Engine:
         self._mem_cache = (n, blk)
         return blk
 
+    def ingest(self, keys: np.ndarray, values: np.ndarray, ts: int,
+               seq: int | None = None) -> None:
+        """Bulk ingest: land pre-built KV arrays as ONE sorted run — the
+        AddSSTable path (kvserver/batcheval/cmd_add_sstable.go role; the
+        reference's bulk loaders build SSTs client-side and link them into
+        the LSM without touching the memtable/WAL). keys: [N, key_width]
+        uint8 zero-padded; values: [N, <=val_width] uint8. All entries land
+        committed at `ts`.
+
+        One device sort builds the run; the WriteTooOld index takes the
+        whole batch in one vectorized pass — per-row put() would pay host
+        encode + append per key (the ingest-vs-write asymmetry the
+        reference's IMPORT exists for)."""
+        n = len(keys)
+        if n == 0:
+            return
+        if keys.shape[1] > self.key_width:
+            raise ValueError("ingest keys wider than engine key width")
+        if values.shape[1] > self.val_width:
+            raise ValueError("ingest values wider than engine val width")
+        if seq is None:
+            seq = self._seq + 1
+        self._seq = max(self._seq, seq)
+        cap = _pad(n)
+        kb = np.zeros((cap, self.key_width), dtype=np.uint8)
+        kb[:n, : keys.shape[1]] = keys
+        vb = np.zeros((cap, self.val_width), dtype=np.uint8)
+        vb[:n, : values.shape[1]] = values
+        blk = mvcc.KVBlock(
+            key=jnp.asarray(kb),
+            ts=jnp.full((cap,), int(ts), jnp.int64),
+            seq=jnp.full((cap,), int(seq), jnp.int64),
+            txn=jnp.zeros((cap,), jnp.int64),
+            tomb=jnp.zeros((cap,), jnp.bool_),
+            value=jnp.asarray(vb),
+            vlen=jnp.full((cap,), int(values.shape[1]), jnp.int32),
+            mask=jnp.asarray(np.arange(cap) < n),
+        )
+        self.runs.insert(0, mvcc.sort_block(blk))
+        self._gen += 1
+        self.stats.flushes += 1
+        self.stats.runs = len(self.runs)
+        # vectorized tscache update (bytes() per row is host work, but one
+        # pass over the batch, not one device trip per key)
+        t = int(ts)
+        nc = self._newest_committed
+        for row in keys:
+            b = row.tobytes().rstrip(b"\x00")
+            if t > nc.get(b, 0):
+                nc[b] = t
+        if len(self.runs) > self.l0_trigger:
+            self.compact(bottom=False)
+
     def flush(self):
         """Memtable -> sorted immutable run (Pebble memtable flush)."""
         self.flush_mem_only()
@@ -438,35 +512,96 @@ class Engine:
         self._overlay_cache = (key, view)
         return view
 
-    def _bounded_view(self, sw, ew) -> mvcc.KVBlock | None:
+    def _bounded_view(self, sw, ew, limit_rows: int | None = None):
         """Candidate view for a bounded read: gather only in-range rows of
         each source into small tiles and merge those — point/short-scan
-        cost scales with matching rows, not total history."""
+        cost scales with matching rows, not total history.
+
+        limit_rows clamps each SORTED run to its first limit_rows in-range
+        entries (the pebbleMVCCScanner pagination discipline): a scan with
+        max_keys must not gather half the keyspace just because its end
+        bound is open. Returns (view, boundary): rows at or past `boundary`
+        (the smallest truncation point across runs) are INCOMPLETE — some
+        of their versions may have been cut — and callers must not emit
+        them. boundary None means nothing was truncated."""
         sources = []
         mb = self._mem_block()
         if mb is not None:
-            sources.append(mb)
-        sources.extend(self.runs)
+            sources.append((mb, False))  # memtable is unsorted: never seek
+        sources.extend((r, True) for r in self.runs)
         swj = None if sw is None else jnp.asarray(sw)
         ewj = None if ew is None else jnp.asarray(ew)
         parts = []
-        for src in sources:
+        boundary: bytes | None = None
+        for src, sorted_run in sources:
+            if limit_rows is not None and sorted_run and sw is not None:
+                # iterator seek: host binary search over the run's cached
+                # key bytes finds the start position, one device
+                # dynamic-slice lands the window — O(window), never
+                # O(run length) (the pebble iterator SeekGE discipline)
+                vkeys, n_live = self._run_keys(src)
+                if n_live == 0:
+                    continue
+                sw_raw = _words_to_bytes(sw)
+                pos = int(np.searchsorted(
+                    vkeys[:n_live],
+                    np.frombuffer(sw_raw, dtype=vkeys.dtype)[0],
+                    side="left",
+                ))
+                if pos >= n_live:
+                    continue
+                size = min(_pad(limit_rows, _CAND_ALIGN), src.capacity)
+                cpos = min(pos, max(0, src.capacity - size))
+                win = _slice_window(src, cpos, size)
+                end_pos = cpos + size
+                if end_pos < n_live:
+                    cut = bytes(vkeys[end_pos - 1].tobytes())
+                    if ew is None or cut < _words_to_bytes(ew):
+                        if boundary is None or cut < boundary:
+                            boundary = cut
+                m, cnt = _range_mask(win, swj, ewj)
+                cnt = int(np.asarray(cnt))
+                if cnt == 0:
+                    continue
+                parts.append(_gather_rows(win, m, _pad(cnt, _CAND_ALIGN)))
+                continue
             m, cnt = _range_mask(src, swj, ewj)
             cnt = int(np.asarray(cnt))
             if cnt == 0:
                 continue
             parts.append(_gather_rows(src, m, _pad(cnt, _CAND_ALIGN)))
         if not parts:
-            return None
+            return None, None
         if len(parts) == 1:
-            return parts[0]
+            return parts[0], boundary
         total = sum(p.capacity for p in parts)
-        return mvcc.merge_blocks(tuple(parts), cap=_pad(total, _CAND_ALIGN))
+        view = mvcc.merge_blocks(tuple(parts), cap=_pad(total, _CAND_ALIGN))
+        return view, boundary
+
+    def _run_keys(self, run: mvcc.KVBlock):
+        """Host copy of a sorted run's key bytes as a void array (memcmp
+        ordering) + its live count — the SST block-index analog backing
+        host-side iterator seeks. Cached per run; stale entries pruned as
+        the run set turns over."""
+        c = self._run_key_cache.get(id(run))
+        if c is None or c[0] is not run:
+            kb = np.asarray(run.key)
+            void = np.ascontiguousarray(kb).view(
+                f"V{kb.shape[1]}").reshape(-1)
+            n_live = int(np.asarray(jnp.sum(run.mask, dtype=jnp.int32)))
+            if len(self._run_key_cache) > 4 * max(1, len(self.runs)):
+                live_ids = {id(r) for r in self.runs}
+                self._run_key_cache = {
+                    k: v for k, v in self._run_key_cache.items()
+                    if k in live_ids
+                }
+            c = self._run_key_cache[id(run)] = (run, void, n_live)
+        return c[1], c[2]
 
     def _view_for(self, sw, ew) -> mvcc.KVBlock | None:
         if sw is None and ew is None:
             return self._merged_view()
-        return self._bounded_view(sw, ew)
+        return self._bounded_view(sw, ew)[0]
 
     # -- reads --------------------------------------------------------------
 
@@ -478,37 +613,63 @@ class Engine:
         txn: int = 0,
         max_keys: int | None = None,
     ) -> list[tuple[bytes, bytes]]:
-        """[start, end) snapshot scan at `ts` -> [(key, value)] host pairs."""
+        """[start, end) snapshot scan at `ts` -> [(key, value)] host pairs.
+
+        With max_keys, candidate gathering is CLAMPED per sorted run
+        (pebbleMVCCScanner pagination): rows at/past the smallest
+        truncation boundary are withheld (their version sets may be
+        incomplete) and the clamp grows geometrically until max_keys
+        complete rows emerge."""
         sw = K.encode_bound(start, self.key_width)
         ew = K.encode_bound(end, self.key_width)
-        view = self._view_for(sw, ew)
-        if view is None:
-            return []
-        sel, conflict = mvcc.mvcc_scan_filter(
-            view, jnp.int64(ts), jnp.int64(txn),
-            None if sw is None else jnp.asarray(sw),
-            None if ew is None else jnp.asarray(ew),
-        )
-        conflict_np = np.asarray(conflict)
-        if conflict_np.any():
-            idx = np.nonzero(conflict_np)[0]
-            ck = K.decode_keys(np.asarray(view.key)[idx])
-            ct = [int(t) for t in np.asarray(view.txn)[idx]]
-            raise WriteIntentError(ck, ct)
-        sel_np = np.asarray(sel)
-        idx = np.nonzero(sel_np)[0]
-        if max_keys is not None:
-            idx = idx[:max_keys]
-        ks = K.decode_keys(np.asarray(view.key)[idx])
-        vals = np.asarray(view.value)[idx]
-        vls = np.asarray(view.vlen)[idx]
-        return [(k, bytes(v[:n])) for k, v, n in zip(ks, vals, vls)]
+        limit = None
+        if max_keys is not None and (sw is not None or ew is not None):
+            limit = max(16, 4 * max_keys)
+        while True:
+            if limit is not None:
+                view, boundary = self._bounded_view(sw, ew, limit)
+            else:
+                view, boundary = self._view_for(sw, ew), None
+            if view is None:
+                return []
+            sel, conflict = mvcc.mvcc_scan_filter(
+                view, jnp.int64(ts), jnp.int64(txn),
+                None if sw is None else jnp.asarray(sw),
+                None if ew is None else jnp.asarray(ew),
+            )
+            conflict_np = np.asarray(conflict)
+            if conflict_np.any():
+                idx = np.nonzero(conflict_np)[0]
+                ck = K.decode_keys(np.asarray(view.key)[idx])
+                ct = [int(t) for t in np.asarray(view.txn)[idx]]
+                raise WriteIntentError(ck, ct)
+            sel_np = np.asarray(sel)
+            idx = np.nonzero(sel_np)[0]
+            if boundary is not None:
+                # emit only rows strictly below the truncation point
+                keys_np = np.asarray(view.key)[idx]
+                below = np.array(
+                    [bytes(k) < boundary for k in keys_np], dtype=bool
+                )
+                kept = idx[below]
+                if max_keys is not None and len(kept) < max_keys:
+                    # truncation occurred and complete rows don't cover the
+                    # limit: more keys may hide past the boundary
+                    limit *= 4
+                    continue
+                idx = kept
+            if max_keys is not None:
+                idx = idx[:max_keys]
+            ks = K.decode_keys(np.asarray(view.key)[idx])
+            vals = np.asarray(view.value)[idx]
+            vls = np.asarray(view.vlen)[idx]
+            return [(k, bytes(v[:n])) for k, v, n in zip(ks, vals, vls)]
 
     def get(self, key: bytes | str, ts: int, txn: int = 0) -> bytes | None:
         b = key.encode() if isinstance(key, str) else bytes(key)
         sw = K.encode_bound(b, self.key_width)
         ew = K.bound_next(sw)
-        view = self._bounded_view(sw, ew)
+        view, _ = self._bounded_view(sw, ew)
         if view is None:
             return None
         sel, conflict = mvcc.mvcc_scan_filter(
