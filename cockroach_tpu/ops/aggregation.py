@@ -37,15 +37,21 @@ from ..coldata.types import FLOAT64, INT64, Family, Schema, SQLType
 
 @dataclass(frozen=True)
 class AggSpec:
-    func: str  # sum | count | count_rows | min | max | avg | any_not_null
+    # sum | count | count_rows | min | max | avg | any_not_null
+    # | var | stddev | var_pop | stddev_pop | sum_sq (internal state)
+    func: str
     col: int | None = None  # input column index (None for count_rows)
     name: str = ""
+
+
+# statistical aggregates decompose into (sum, sum of squares, count) states
+STAT_FUNCS = ("var", "stddev", "var_pop", "stddev_pop")
 
 
 def agg_output_type(spec: AggSpec, schema: Schema) -> SQLType:
     if spec.func in ("count", "count_rows"):
         return INT64
-    if spec.func == "avg":
+    if spec.func in ("avg",) + STAT_FUNCS or spec.func == "sum_sq":
         return FLOAT64
     t = schema.types[spec.col]
     if spec.func == "sum":
@@ -78,6 +84,18 @@ def _segment_agg(spec: AggSpec, col: Column | None, live, seg, cap, t: SQLType |
         return data, jnp.ones((cap,), jnp.bool_)
     cnt = jax.ops.segment_sum(contributes.astype(jnp.int32), seg, num_segments=cap)
     nonempty = cnt > 0
+    if spec.func == "sum_f":
+        d = col.data.astype(jnp.float64)
+        if t is not None and t.family is Family.DECIMAL:
+            d = d / (10.0 ** t.scale)
+        vals = jnp.where(contributes, d, 0.0)
+        return jax.ops.segment_sum(vals, seg, num_segments=cap), nonempty
+    if spec.func == "sum_sq":
+        d = col.data.astype(jnp.float64)
+        if t is not None and t.family is Family.DECIMAL:
+            d = d / (10.0 ** t.scale)
+        vals = jnp.where(contributes, d * d, 0.0)
+        return jax.ops.segment_sum(vals, seg, num_segments=cap), nonempty
     if spec.func in ("sum", "avg"):
         if t.family is Family.FLOAT or spec.func == "avg":
             vals = jnp.where(contributes, col.data.astype(jnp.float64), 0.0)
@@ -202,6 +220,8 @@ def groupby_output_schema(
 
 _MERGE_FUNC = {
     "sum": "sum",
+    "sum_f": "sum",
+    "sum_sq": "sum",
     "count": "sum",
     "count_rows": "sum",
     "min": "min",
@@ -222,7 +242,13 @@ def partial_layout(
     partial_specs: list[AggSpec] = []
     final_map = []
     for spec in aggs:
-        if spec.func == "avg":
+        if spec.func in STAT_FUNCS:
+            si = len(partial_specs)
+            partial_specs.append(AggSpec("sum_f", spec.col, f"_s{si}"))
+            partial_specs.append(AggSpec("sum_sq", spec.col, f"_q{si}"))
+            partial_specs.append(AggSpec("count", spec.col, f"_c{si}"))
+            final_map.append((spec.func, si, si + 1, si + 2))
+        elif spec.func == "avg":
             si = len(partial_specs)
             t = schema.types[spec.col]
             partial_specs.append(AggSpec("sum", spec.col, f"_s{si}"))
@@ -258,6 +284,23 @@ def finalize_states(state: Batch, final_map, num_keys: int) -> Batch:
     k = num_keys
     cols = list(state.cols[:k])
     for fm in final_map:
+        if fm[0] in STAT_FUNCS:
+            func, si, qi, ci = fm
+            sm = state.cols[k + si].data.astype(jnp.float64)
+            sq = state.cols[k + qi].data.astype(jnp.float64)
+            n = state.cols[k + ci].data.astype(jnp.float64)
+            safe_n = jnp.where(n > 0, n, 1.0)
+            mean = sm / safe_n
+            if func.endswith("_pop"):
+                var = jnp.maximum(sq / safe_n - mean * mean, 0.0)
+                valid = state.cols[k + ci].data > 0
+            else:
+                denom = jnp.where(n > 1, n - 1.0, 1.0)
+                var = jnp.maximum((sq - n * mean * mean) / denom, 0.0)
+                valid = state.cols[k + ci].data > 1
+            d = jnp.sqrt(var) if func.startswith("stddev") else var
+            cols.append(Column(data=d, valid=valid & state.mask))
+            continue
         if fm[0] == "avg":
             _, si, ci, t = fm
             s = state.cols[k + si]
@@ -451,6 +494,14 @@ def scalar_tile_states(batch: Batch, aggs: tuple[AggSpec, ...], base: Schema):
             vals = jnp.where(m, c.data, sent)
             red = jnp.min(vals) if is_min else jnp.max(vals)
             out.append((red, cnt > 0))
+        elif spec.func in STAT_FUNCS:
+            d = c.data.astype(jnp.float64)
+            if t.family is Family.DECIMAL:
+                d = d / (10.0 ** t.scale)
+            s_ = jnp.sum(jnp.where(m, d, 0.0))
+            q_ = jnp.sum(jnp.where(m, d * d, 0.0))
+            ok = cnt > 0 if spec.func.endswith("_pop") else cnt > 1
+            out.append(((s_, q_, cnt), ok))
         else:
             raise ValueError(spec.func)
     return out
@@ -465,6 +516,10 @@ def scalar_merge_states(aggs: tuple[AggSpec, ...], acc, new):
             out.append((a + n, av | nv))
         elif spec.func == "avg":
             out.append(((a[0] + n[0], a[1] + n[1]), av | nv))
+        elif spec.func in STAT_FUNCS:
+            cnt = a[2] + n[2]
+            ok = cnt > 0 if spec.func.endswith("_pop") else cnt > 1
+            out.append(((a[0] + n[0], a[1] + n[1], cnt), ok))
         elif spec.func == "min":
             out.append((jnp.minimum(a, n), av | nv))
         elif spec.func == "max":
@@ -489,6 +544,20 @@ def scalar_result_batch(aggs: tuple[AggSpec, ...], base: Schema,
                 v = jnp.zeros((1,), jnp.bool_)
         else:
             (val, valid) = acc.pop(0)  # states consumed in agg order
+            if spec.func in STAT_FUNCS:
+                sm, sq, c = val
+                n = c.astype(jnp.float64)
+                safe_n = jnp.where(n > 0, n, 1.0)
+                mean = sm / safe_n
+                if spec.func.endswith("_pop"):
+                    var = jnp.maximum(sq / safe_n - mean * mean, 0.0)
+                else:
+                    denom = jnp.where(n > 1, n - 1.0, 1.0)
+                    var = jnp.maximum((sq - n * mean * mean) / denom, 0.0)
+                d = (jnp.sqrt(var) if spec.func.startswith("stddev")
+                     else var)[None]
+                cols.append(Column(data=d, valid=jnp.asarray(valid)[None]))
+                continue
             if spec.func == "avg":
                 s, c = val
                 base_t = base.types[spec.col]
@@ -525,6 +594,6 @@ def agg_output_schema(
         types = [base.types[i] for i in group_cols]
     for spec, fm in zip(aggs, final_map):
         names.append(spec.name or spec.func)
-        types.append(FLOAT64 if fm[0] == "avg"
+        types.append(FLOAT64 if fm[0] in ("avg",) + STAT_FUNCS
                      else agg_output_type(spec, base))
     return Schema(tuple(names), tuple(types))

@@ -30,7 +30,12 @@ from ..ops import expr as ex
 from . import parser as P
 from .rel import Rel
 
-AGG_FUNCS = {"sum", "avg", "min", "max", "count"}
+AGG_FUNCS = {"sum", "avg", "min", "max", "count", "stddev", "stddev_samp",
+             "stddev_pop", "variance", "var_samp", "var_pop"}
+
+# SQL spellings -> kernel aggregate names (sample variants are the defaults,
+# matching CockroachDB/Postgres)
+_AGG_CANON = {"variance": "var", "var_samp": "var", "stddev_samp": "stddev"}
 
 
 class BindError(Exception):
@@ -1172,7 +1177,7 @@ class Binder:
             rel2 = rel.project(pre).distinct()
         else:
             for fc, name in aggs.items():
-                func = fc.name
+                func = _AGG_CANON.get(fc.name, fc.name)
                 if func == "count" and (
                     not fc.args or isinstance(fc.args[0], P.Star)
                 ):

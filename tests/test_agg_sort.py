@@ -291,3 +291,35 @@ def test_external_sort_bool_key(rng):
     u = np.asarray(_primary_u64(batch, schema, SortKey(0)))
     assert len(np.unique(u)) == 2, "bool ordering bit must survive packing"
     assert u[bv].min() > u[~bv].max()  # False < True in SQL order
+
+
+def test_stddev_variance_aggregates():
+    """var/stddev (sample + population) via (sum, sum_sq, count) states —
+    grouped, scalar, and merged across tiles; oracle numpy."""
+    import numpy as np
+
+    from cockroach_tpu.bench import tpch
+    from cockroach_tpu.sql import sql
+
+    cat = tpch.gen_tpch(sf=0.005, seed=3)
+    li = tpch.to_pandas(cat, "lineitem")
+
+    got = sql(cat, """
+        select l_returnflag, variance(l_quantity) as v,
+               stddev(l_quantity) as s,
+               var_pop(l_quantity) as vp, stddev_pop(l_quantity) as sp
+        from lineitem group by l_returnflag order by l_returnflag
+    """).run()
+    g = li.groupby("l_returnflag").l_quantity
+    np.testing.assert_allclose(np.asarray(got["v"], np.float64),
+                               g.var(ddof=1).to_numpy(), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(got["s"], np.float64),
+                               g.std(ddof=1).to_numpy(), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(got["vp"], np.float64),
+                               g.var(ddof=0).to_numpy(), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(got["sp"], np.float64),
+                               g.std(ddof=0).to_numpy(), rtol=1e-9)
+
+    got = sql(cat, "select stddev(l_extendedprice) as s from lineitem").run()
+    np.testing.assert_allclose(float(got["s"][0]),
+                               li.l_extendedprice.std(ddof=1), rtol=1e-9)
