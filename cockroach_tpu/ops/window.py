@@ -31,6 +31,7 @@ from . import sort as sort_ops
 WINDOW_FUNCS = (
     "row_number", "rank", "dense_rank", "lag", "lead",
     "sum", "count", "min", "max", "avg", "first_value", "last_value",
+    "ntile", "percent_rank", "cume_dist",
 )
 
 
@@ -48,9 +49,9 @@ class WindowSpec:
 
 
 def window_output_type(spec: WindowSpec, schema: Schema) -> SQLType:
-    if spec.func in ("row_number", "rank", "dense_rank", "count"):
+    if spec.func in ("row_number", "rank", "dense_rank", "count", "ntile"):
         return INT64
-    if spec.func == "avg":
+    if spec.func in ("avg", "percent_rank", "cume_dist"):
         return FLOAT64
     return schema.types[spec.col]
 
@@ -135,6 +136,40 @@ def compute_windows(
                 pb = jnp.cumsum(peer_boundary.astype(jnp.int64))
                 d = pb - pb[start_of] + 1
             v = b.mask
+        elif spec.func in ("ntile", "percent_rank", "cume_dist"):
+            n = jax.ops.segment_sum(
+                b.mask.astype(jnp.int64), seg, num_segments=cap
+            )[seg]  # partition row count, per row
+            idx = (pos - start_of).astype(jnp.int64)  # 0-based in partition
+            if spec.func == "ntile":
+                # SQL ntile(k): first (n mod k) buckets get one extra row
+                k = jnp.int64(max(1, spec.offset))
+                q = n // k
+                r = n % k
+                big = r * (q + 1)  # rows covered by the larger buckets
+                d = jnp.where(
+                    q == 0,
+                    idx + 1,
+                    jnp.where(idx < big, idx // jnp.maximum(q + 1, 1) + 1,
+                              r + (idx - big) // jnp.maximum(q, 1) + 1),
+                )
+                v = b.mask
+            else:
+                head_pos = jnp.where(peer_boundary, pos, 0)
+                head = jax.lax.associative_scan(jnp.maximum, head_pos)
+                rank = (head - start_of + 1).astype(jnp.float64)
+                if spec.func == "percent_rank":
+                    denom = jnp.maximum(n - 1, 1).astype(jnp.float64)
+                    d = jnp.where(n > 1, (rank - 1.0) / denom, 0.0)
+                else:  # cume_dist = rows <= my peer group / partition rows
+                    peer_id = jnp.cumsum(peer_boundary.astype(jnp.int32)) - 1
+                    peer_last = jax.ops.segment_max(
+                        jnp.where(b.mask, pos, -1), peer_id,
+                        num_segments=cap,
+                    )[peer_id]
+                    d = ((peer_last - start_of + 1).astype(jnp.float64)
+                         / jnp.maximum(n, 1).astype(jnp.float64))
+                v = b.mask
         elif spec.func in ("lag", "lead"):
             col = b.cols[spec.col]
             off = spec.offset if spec.func == "lag" else -spec.offset

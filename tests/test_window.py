@@ -263,3 +263,60 @@ def test_window_order_by_bytes_column():
     # ties on "ab" and "ba" share ranks
     np.testing.assert_array_equal(np.sort(rk), [1, 2, 2, 4, 4, 6])
     np.testing.assert_array_equal(np.sort(drk), [1, 2, 2, 3, 3, 4])
+
+
+def test_ntile_percent_rank_cume_dist(cat):
+    """ntile / percent_rank / cume_dist against a pandas oracle (ties
+    included: peers share percent_rank and cume_dist)."""
+    import pandas as pd
+
+    from cockroach_tpu.sql.rel import Rel
+
+    li = Rel.scan(cat, "orders", ("o_custkey", "o_totalprice", "o_orderkey"))
+    w = li.window(
+        ["o_custkey"], [("o_totalprice", False), ("o_orderkey", False)],
+        [("nt", "ntile", None), ("pr", "percent_rank", None),
+         ("cd", "cume_dist", None)],
+    )
+    # ntile bucket count rides WindowSpec.offset
+    node = w.plan
+    specs = tuple(
+        sp if sp.func != "ntile" else type(sp)(
+            sp.func, sp.col, sp.name, offset=4, running=sp.running)
+        for sp in node.specs
+    )
+    import dataclasses
+
+    w = Rel(w.catalog, dataclasses.replace(node, specs=specs), w.schema,
+            dict(w.dicts))
+    got = w.run()
+
+    df = tpch.to_pandas(cat, "orders")[
+        ["o_custkey", "o_totalprice", "o_orderkey"]]
+    df = df.sort_values(["o_custkey", "o_totalprice", "o_orderkey"])
+    g = df.groupby("o_custkey")
+    df["nt"] = g.cumcount()
+    nsz = g.o_orderkey.transform("size")
+    k = 4
+    q, r = nsz // k, nsz % k
+    big = r * (q + 1)
+    df["nt"] = np.where(
+        q == 0, df["nt"] + 1,
+        np.where(df["nt"] < big, df["nt"] // np.maximum(q + 1, 1) + 1,
+                 r + (df["nt"] - big) // np.maximum(q, 1) + 1),
+    )
+    # ties: orderkey is unique so rank==cumcount+1 here
+    df["pr"] = np.where(nsz > 1, g.cumcount() / np.maximum(nsz - 1, 1), 0.0)
+    df["cd"] = (g.cumcount() + 1) / nsz
+
+    order = np.lexsort([np.asarray(got["o_orderkey"]),
+                        np.asarray(got["o_custkey"])])
+    df = df.sort_values(["o_custkey", "o_orderkey"])
+    np.testing.assert_array_equal(
+        np.asarray(got["nt"])[order], df["nt"].to_numpy())
+    np.testing.assert_allclose(
+        np.asarray(got["pr"], np.float64)[order], df["pr"].to_numpy(),
+        rtol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(got["cd"], np.float64)[order], df["cd"].to_numpy(),
+        rtol=1e-12)
