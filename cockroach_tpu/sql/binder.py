@@ -150,12 +150,15 @@ class Source:
 
 
 class Scope:
-    """Resolves Ident -> (source index, column name)."""
+    """Resolves Ident -> (source index, source-local column POSITION).
+
+    Positions (not names) are the only sound currency once a combined
+    source (a bound LEFT JOIN) or a self-join carries duplicate names."""
 
     def __init__(self, sources: list[Source]):
         self.sources = sources
 
-    def resolve(self, ident: P.Ident) -> tuple[int, str]:
+    def resolve(self, ident: P.Ident) -> tuple[int, int]:
         if ident.table is not None:
             for i, s in enumerate(self.sources):
                 if s.alias == ident.table:
@@ -163,25 +166,31 @@ class Scope:
                         raise BindError(
                             f"column {ident.name} not in {ident.table}"
                         )
-                    return i, ident.name
+                    return i, s.cols.index(ident.name)
+                off = 0
                 for sub_alias, sub_cols in s.sub_aliases:
                     if sub_alias == ident.table:
                         if ident.name not in sub_cols:
                             raise BindError(
                                 f"column {ident.name} not in {ident.table}"
                             )
-                        return i, ident.name
+                        return i, off + sub_cols.index(ident.name)
+                    off += len(sub_cols)
             raise BindError(f"unknown table alias {ident.table}")
         hits = [
-            (i, ident.name)
+            (i, p)
             for i, s in enumerate(self.sources)
-            if ident.name in s.cols
+            for p, c in enumerate(s.cols)
+            if c == ident.name
         ]
         if not hits:
             raise BindError(f"unknown column {ident.name}")
         if len(hits) > 1:
-            raise BindError(f"ambiguous column {ident.name}")
+            raise BindError(f"ambiguous column {ident.name}: qualify it")
         return hits[0]
+
+    def name_of(self, i: int, pos: int) -> str:
+        return self.sources[i].cols[pos]
 
     def sources_of(self, e: P.Node) -> set[int]:
         out = set()
@@ -429,10 +438,10 @@ class Binder:
                 continue
             if isinstance(c, P.Cmp) and c.op == "eq" and \
                     isinstance(c.left, P.Ident) and isinstance(c.right, P.Ident):
-                li, ln = scope.resolve(c.left)
-                ri, rn = scope.resolve(c.right)
+                li, lp = scope.resolve(c.left)
+                ri, rp = scope.resolve(c.right)
                 if li != ri:
-                    equi_edges.append((li, ln, ri, rn))
+                    equi_edges.append((li, lp, ri, rp))
                     continue
             srcs = scope.sources_of(c)
             if len(srcs) == 1:
@@ -487,10 +496,12 @@ class Binder:
             return None
 
         def resolve(ident: P.Ident) -> int:
-            i, n = scope.resolve(ident)
-            pos = joined.colmap.get((i, n))
+            i, p = scope.resolve(ident)
+            pos = joined.colmap.get((i, p))
             if pos is None:
-                raise BindError(f"column {n} not available after join")
+                raise BindError(
+                    f"column {ident.name} not available after join"
+                )
             return pos
 
         return resolve
@@ -545,20 +556,25 @@ class Binder:
             raise BindError("nested outer joins not supported")
         left, right = sub_sources
         sub_scope = Scope([left, right])
-        keys: list[tuple[str, str]] = []
+        keys: list[tuple[int, int]] = []
         for c in _conjuncts(it.on):
             c = _fold(c)
             if (isinstance(c, P.Cmp) and c.op == "eq"
                     and isinstance(c.left, P.Ident)
                     and isinstance(c.right, P.Ident)):
-                li, ln = sub_scope.resolve(c.left)
-                ri, rn = sub_scope.resolve(c.right)
+                li, lp = sub_scope.resolve(c.left)
+                ri, rp = sub_scope.resolve(c.right)
                 if {li, ri} == {0, 1}:
-                    keys.append((ln, rn) if li == 0 else (rn, ln))
+                    keys.append((lp, rp) if li == 0 else (rp, lp))
                     continue
             srcs = sub_scope.sources_of(c)
             if srcs == {1}:
-                lower = ExprLowerer(right.rel)
+                def _right_resolver(ident: P.Ident) -> int:
+                    i, p = sub_scope.resolve(ident)
+                    if i != 1:
+                        raise BindError("predicate crossed join sides")
+                    return p
+                lower = ExprLowerer(right.rel, resolver=_right_resolver)
                 right = Source(right.alias, right.rel.filter(lower.lower(c)),
                                right.cols, right.base_rows, right.table)
             else:
@@ -568,14 +584,6 @@ class Binder:
                 )
         if not keys:
             raise BindError("LEFT JOIN requires at least one equi key")
-        dup = set(left.cols) & set(right.cols)
-        if dup:
-            # the combined source resolves columns by NAME; a shared name
-            # (e.g. a self left-join) would silently bind the left copy
-            raise BindError(
-                f"LEFT JOIN sides share column names {sorted(dup)}; "
-                "project/rename one side first"
-            )
         rel = left.rel.join(right.rel, on=keys, how="left",
                             build_unique=False)
         return Source(
@@ -589,35 +597,35 @@ class Binder:
     def _join_sources(self, sources, equi_edges, scope) -> "BoundQuery":
         n = len(sources)
         if n == 1:
-            colmap = {(0, c): i
-                      for i, c in enumerate(sources[0].rel.schema.names)}
+            colmap = {(0, p): p
+                      for p in range(len(sources[0].rel.schema))}
             return BoundQuery(sources[0].rel, {0: sources[0]}, colmap)
         sizes = [s.base_rows for s in sources]
         start = max(range(n), key=lambda i: sizes[i])
         placed = {start}
         rel = sources[start].rel
-        colmap = {(start, c): i for i, c in enumerate(rel.schema.names)}
+        colmap = {(start, p): p for p in range(len(rel.schema))}
         while len(placed) < n:
-            # find edges from placed to unplaced (join keys resolved to
-            # POSITIONS on the probe side via colmap — names can repeat)
-            cand: dict[int, list[tuple[int, str]]] = {}
-            for li, ln, ri, rn in equi_edges:
+            # find edges from placed to unplaced, fully positional: probe
+            # side through colmap, build side source-local
+            cand: dict[int, list[tuple[int, int]]] = {}
+            for li, lp, ri, rp in equi_edges:
                 if li in placed and ri not in placed:
-                    cand.setdefault(ri, []).append((colmap[(li, ln)], rn))
+                    cand.setdefault(ri, []).append((colmap[(li, lp)], rp))
                 elif ri in placed and li not in placed:
-                    cand.setdefault(li, []).append((colmap[(ri, rn)], ln))
+                    cand.setdefault(li, []).append((colmap[(ri, rp)], lp))
             if not cand:
                 raise BindError("cross join required but not supported")
             # smallest build side first
             nxt = min(cand, key=lambda i: sizes[i])
-            on = cand[nxt]  # (probe POSITION, build name) pairs
+            on = cand[nxt]  # (probe joined POSITION, build local POSITION)
             off = len(rel.schema)
-            build_names = sources[nxt].rel.schema.names
+            nb = len(sources[nxt].rel.schema)
             rel = rel.join(
                 sources[nxt].rel, on=on, how="inner", build_unique=False
             )
-            for i, c in enumerate(build_names):
-                colmap[(nxt, c)] = off + i
+            for p in range(nb):
+                colmap[(nxt, p)] = off + p
             placed.add(nxt)
         return BoundQuery(rel, {i: sources[i] for i in placed}, colmap)
 
@@ -726,18 +734,18 @@ class Binder:
                         and isinstance(cj.left, P.Ident)
                         and isinstance(cj.right, P.Ident)):
                     try:
-                        li, ln = scope.resolve(cj.left)
-                        ri, rn = scope.resolve(cj.right)
+                        li, lp = scope.resolve(cj.left)
+                        ri, rp = scope.resolve(cj.right)
                     except BindError:
                         continue
                     if li != ri:
-                        key = ((li, ln), (ri, rn))
+                        key = ((li, lp), (ri, rp))
                         if key[0] > key[1]:
                             key = (key[1], key[0])
                         eqs.add(key)
             per_branch.append(eqs)
         common = set.intersection(*per_branch) if per_branch else set()
-        return [(li, ln, ri, rn) for (li, ln), (ri, rn) in common]
+        return [(li, lp, ri, rp) for (li, lp), (ri, rp) in common]
 
     def _scalar_sub_is_correlated(self, sub: P.ScalarSubquery) -> bool:
         """True when the subquery references columns outside its own FROM."""
@@ -894,7 +902,8 @@ class Binder:
         return v is None or bool(np.asarray(v).all())
 
     def _require_non_nullable(self, ident: P.Ident, scope, what: str) -> None:
-        i, name = scope.resolve(ident)
+        i, pos = scope.resolve(ident)
+        name = scope.name_of(i, pos)
         src = scope.sources[i]
         if src.table is None or not self._base_col_non_nullable(
             src.table, name
@@ -1000,8 +1009,10 @@ class Binder:
             if len(rel.schema) != 1:
                 raise BindError("scalar subquery must produce one column")
             col = res[rel.schema.names[0]]
+            if len(col) == 0:
+                return P.NullLit()  # empty scalar subquery IS NULL
             if len(col) != 1:
-                raise BindError("scalar subquery returned != 1 row")
+                raise BindError("scalar subquery returned more than one row")
             v = col[0]
             if isinstance(v, (str, bytes)):
                 return P.StrLit(v if isinstance(v, str) else v.decode())

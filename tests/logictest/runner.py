@@ -1,0 +1,190 @@
+"""Mini SQL logic-test driver — the pkg/sql/logictest discipline.
+
+Reference: logic.go:4355 RunLogicTest executes datadriven .test files under
+multiple cluster configs (local, fakedist, ...); each `query` directive
+carries a type signature, expected rows, and optional sort mode. This
+runner keeps the same file shape, reduced to the directives the engine
+needs today:
+
+    statement ok
+    CREATE TABLE t (...)
+
+    query IRT nosort|rowsort|valuesort
+    SELECT ...
+    ----
+    expected cell per line (row-major)
+
+    query error <substring>
+    SELECT ...
+
+Type letters: I int, R real (compared at 1e-9), T text, B bool. Every
+query runs TWICE — single-device and distributed over the mesh — and both
+must match the expectation (the local/fakedist config pairing).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Case:
+    kind: str  # statement | query
+    sql: str
+    types: str = ""
+    sort: str = "nosort"
+    expected: list[str] = field(default_factory=list)
+    error: str | None = None
+    line: int = 0
+
+
+def parse_file(path: str) -> list[Case]:
+    cases: list[Case] = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    i = 0
+    while i < len(lines):
+        ln = lines[i].strip()
+        if not ln or ln.startswith("#"):
+            i += 1
+            continue
+        head = ln.split()
+        if head[0] == "statement":
+            ok = head[1] == "ok"
+            err = None if ok else " ".join(head[2:]) or head[1]
+            i += 1
+            sql_lines = []
+            while i < len(lines) and lines[i].strip():
+                sql_lines.append(lines[i])
+                i += 1
+            cases.append(Case("statement", "\n".join(sql_lines),
+                              error=None if ok else err, line=i))
+        elif head[0] == "query":
+            if head[1] == "error":
+                err = " ".join(head[2:])
+                i += 1
+                sql_lines = []
+                while i < len(lines) and lines[i].strip():
+                    sql_lines.append(lines[i])
+                    i += 1
+                cases.append(Case("query", "\n".join(sql_lines), error=err,
+                                  line=i))
+                continue
+            types = head[1]
+            sort = head[2] if len(head) > 2 else "nosort"
+            i += 1
+            sql_lines = []
+            while i < len(lines) and lines[i].strip() != "----":
+                sql_lines.append(lines[i])
+                i += 1
+            i += 1  # skip ----
+            expected = []
+            while i < len(lines) and lines[i].strip():
+                expected.append(lines[i].strip())
+                i += 1
+            cases.append(Case("query", "\n".join(sql_lines), types=types,
+                              sort=sort, expected=expected, line=i))
+        else:
+            raise ValueError(f"{path}:{i}: unknown directive {ln!r}")
+        i += 1
+    return cases
+
+
+def _render(val, t: str) -> str:
+    if val is None:
+        return "NULL"
+    if t == "I":
+        return str(int(val))
+    if t == "R":
+        f = float(val)
+        return f"{f:.6g}"
+    if t == "B":
+        return "true" if bool(val) else "false"
+    return str(val)
+
+
+def _cells(res: dict, types: str, sort: str) -> list[str]:
+    import numpy as np
+
+    names = list(res.keys())
+    assert len(names) == len(types), (
+        f"query returns {len(names)} columns, signature has {len(types)}"
+    )
+    ncols = len(names)
+    nrows = len(res[names[0]]) if ncols else 0
+    rows = []
+    for r in range(nrows):
+        rows.append(tuple(
+            _render(res[names[c]][r], types[c]) for c in range(ncols)
+        ))
+    if sort == "rowsort":
+        rows.sort()
+    cells = [c for row in rows for c in row]
+    if sort == "valuesort":
+        cells.sort()
+    return cells
+
+
+def _compare(got: list[str], want: list[str], types: str, line: int,
+             config: str):
+    assert len(got) == len(want), (
+        f"line {line} [{config}]: {len(got)} cells, expected {len(want)}\n"
+        f"got:  {got}\nwant: {want}"
+    )
+    ncols = max(1, len(types))
+    for i, (g, w) in enumerate(zip(got, want)):
+        t = types[i % ncols] if types else "T"
+        if t == "R" and g != "NULL" and w != "NULL":
+            assert abs(float(g) - float(w)) <= 1e-9 * max(
+                1.0, abs(float(w))
+            ), f"line {line} [{config}] cell {i}: {g} != {w}"
+        else:
+            assert g == w, f"line {line} [{config}] cell {i}: {g!r} != {w!r}"
+
+
+def run_logic_file(path: str, session, mesh=None) -> int:
+    """Execute one .test file through a Session. Queries over static host
+    tables additionally run distributed over `mesh` (fakedist pairing).
+    Returns the number of directives executed."""
+    from cockroach_tpu.sql import BindError, sql as sql_bind
+    from cockroach_tpu.utils.errors import QueryError
+
+    n = 0
+    for case in parse_file(path):
+        n += 1
+        if case.error is not None:
+            try:
+                session.execute(case.sql)
+            except (BindError, QueryError, ValueError, SyntaxError) as e:
+                assert case.error.lower() in str(e).lower(), (
+                    f"line {case.line}: error {e!r} missing "
+                    f"{case.error!r}"
+                )
+            else:
+                raise AssertionError(
+                    f"line {case.line}: expected error {case.error!r}"
+                )
+            continue
+        res = session.execute(case.sql)
+        if case.kind == "statement":
+            continue
+        got = _cells(res, case.types, case.sort)
+        _compare(got, case.expected, case.types, case.line, "local")
+        if mesh is not None:
+            try:
+                rel = sql_bind(session.catalog, case.sql)
+                dres = rel.run_distributed(mesh)
+            except (BindError, TypeError, QueryError):
+                continue  # KV-backed scans don't distribute yet
+            dgot = _cells(dres, case.types, case.sort)
+            _compare(dgot, case.expected, case.types, case.line, "fakedist")
+    return n
+
+
+def logic_files() -> list[str]:
+    d = os.path.join(os.path.dirname(__file__), "testdata")
+    return sorted(
+        os.path.join(d, f) for f in os.listdir(d) if f.endswith(".test")
+    )

@@ -263,3 +263,24 @@ def test_memory_accounting_drives_spills(cat):
     # EXPLAIN ANALYZE surfaces byte accounting per operator
     txt, _ = Q.q1(cat).explain_analyze()
     assert "bytes=" in txt
+
+
+def test_cli_execute_and_render():
+    """The SQL shell (layer-1 CLI analog): statement execution, table
+    rendering, errors as messages not tracebacks."""
+    from cockroach_tpu import cli
+    from cockroach_tpu.sql import Session
+
+    sess = Session()
+    out = cli.execute_and_render(sess, "create table t (a int primary key, "
+                                       "b float)")
+    assert "CREATE TABLE" in out
+    out = cli.execute_and_render(sess, "insert into t values (1, 2.5), "
+                                       "(2, null)")
+    assert "2 row(s)" in out
+    out = cli.execute_and_render(sess, "select a, b from t order by a")
+    assert "NULL" in out and "(2 rows)" in out and "2.5" in out
+    out = cli.execute_and_render(sess, "select nope from t")
+    assert out.startswith("ERROR:")
+    out = cli.execute_and_render(sess, "explain select a from t where a > 1")
+    assert "-> " in out

@@ -1,0 +1,37 @@
+"""Datadriven SQL logic tests (the pkg/sql/logictest reduction): each .test
+file in tests/logictest/testdata runs its statements through a Session and
+every query under BOTH the local flow engine and (where the plan
+distributes) the 8-device mesh — the local/fakedist config pairing of
+logictestbase.go."""
+
+import pytest
+
+from cockroach_tpu.parallel import mesh as mesh_mod
+from cockroach_tpu.sql import Session
+
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "logictest_runner",
+    os.path.join(os.path.dirname(__file__), "logictest", "runner.py"),
+)
+runner = importlib.util.module_from_spec(_spec)
+import sys
+
+sys.modules["logictest_runner"] = runner
+_spec.loader.exec_module(runner)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return mesh_mod.make_mesh(8)
+
+
+@pytest.mark.parametrize(
+    "path", runner.logic_files(),
+    ids=lambda p: p.rsplit("/", 1)[-1].removesuffix(".test"),
+)
+def test_logic_file(path, mesh):
+    n = runner.run_logic_file(path, Session(), mesh=mesh)
+    assert n > 0, "file had no directives"
