@@ -31,9 +31,14 @@ def _splitmix64(x: jax.Array) -> jax.Array:
 
 def _to_u64(data: jax.Array, t: SQLType) -> jax.Array:
     if t.family is Family.FLOAT:
+        from ..utils.backend import require_float_bitcast
+
+        require_float_bitcast("float hash key")
         d = data.astype(jnp.float64)
         d = jnp.where(d == 0.0, 0.0, d)  # canonicalize -0.0
-        return jax.lax.bitcast_convert_type(d, jnp.uint64)
+        parts = jax.lax.bitcast_convert_type(d, jnp.uint32)  # [..., 2]
+        return (parts[..., 1].astype(jnp.uint64) << np.uint64(32)
+                ) | parts[..., 0].astype(jnp.uint64)
     if t.family is Family.BOOL:
         return data.astype(jnp.uint64)
     return data.astype(jnp.int64).astype(jnp.uint64)

@@ -41,9 +41,12 @@ def _u64_key(batch: Batch, key: int, schema: Schema, rank_table=None):
             1 << 63
         )
     elif t.family is Family.FLOAT:
-        # IEEE total-order trick composed from 32-bit lanes — the TPU X64
-        # rewriter rejects 64-bit bitcasts, 32-bit ones are fine. Canonical
+        # IEEE total-order trick composed from 32-bit lanes. Canonical
         # -0.0 == 0.0 and NaN == NaN (Postgres float equality semantics).
+        # Guarded: the axon rewriter miscompiles these for negatives.
+        from ..utils.backend import require_float_bitcast
+
+        require_float_bitcast("float merge-join key")
         f = c.data.astype(jnp.float64)
         f = jnp.where(f == 0.0, 0.0, f)
         f = jnp.where(jnp.isnan(f), jnp.float64(jnp.nan), f)

@@ -140,6 +140,11 @@ def decode_columns(
         if t.family is Family.FLOAT:
             # uint64 -> (lo32, hi32) -> f64: the axon X64 rewriter rejects
             # a direct u64<->f64 bitcast, the u32-pair route compiles
+            # (correctness self-checked at backend init; see
+            # utils/backend.float_bitcast_ok)
+            from ..utils.backend import require_float_bitcast
+
+            require_float_bitcast("FLOAT column decode")
             lo = (raw & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
             hi = (raw >> jnp.uint64(32)).astype(jnp.uint32)
             data = jax.lax.bitcast_convert_type(

@@ -39,6 +39,51 @@ def enable_compile_cache(cache_dir: str | None = None) -> str:
     return cache_dir
 
 
+_F64_BITCAST_OK: bool | None = None
+
+
+def float_bitcast_ok() -> bool:
+    """One-time probe: does this backend compile f64<->u32 bitcasts
+    CORRECTLY? The axon TPU X64 rewriter has been observed to miscompile
+    them for negative doubles (values collapse to f32-NaN bit patterns), so
+    float-keyed joins/hashes must fail LOUDLY rather than silently match
+    wrong rows. CPU and healthy TPU backends pass."""
+    global _F64_BITCAST_OK
+    if _F64_BITCAST_OK is not None:
+        return _F64_BITCAST_OK
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    vals = np.array([-1.5, -0.0, 2.5e-308, -1e300, 3.25], dtype=np.float64)
+    want = vals.view(np.uint64)
+    try:
+        def roundtrip(x):
+            parts = jax.lax.bitcast_convert_type(x, jnp.uint32)  # [..., 2]
+            u = (parts[..., 1].astype(jnp.uint64) << jnp.uint64(32)
+                 ) | parts[..., 0].astype(jnp.uint64)
+            back = jax.lax.bitcast_convert_type(parts, jnp.float64)
+            return u, back
+
+        u, back = jax.jit(roundtrip)(jnp.asarray(vals))
+        ok = (np.array_equal(np.asarray(u), want)
+              and np.array_equal(np.asarray(back).view(np.uint64), want))
+    except Exception:
+        ok = False
+    _F64_BITCAST_OK = bool(ok)
+    return _F64_BITCAST_OK
+
+
+def require_float_bitcast(what: str) -> None:
+    """Raise a clear error when a float-keyed kernel would miscompile."""
+    if not float_bitcast_ok():
+        raise NotImplementedError(
+            f"{what}: this backend miscompiles f64 bitcasts (negative "
+            "doubles collapse); float join/group keys are disabled on it. "
+            "Cast the key to DECIMAL or INT instead."
+        )
+
+
 def force_cpu_backend(n_devices: int | None = None) -> None:
     """Force jax onto the CPU backend, with an optional virtual device count.
 
