@@ -16,7 +16,9 @@ result is asserted equal to the pandas result before timing counts.
 On any unrecoverable failure, still emits one JSON line with an "error" field.
 
 Env knobs: TPCH_SF (default 1.0), BENCH_RUNS (default 3), BENCH_QUERY
-(comma-separated, default "q1,q3,q9,q18"), BENCH_BACKEND_RETRIES,
+(comma-separated, default "q1,q3,q18,q9" — q9's five-way
+join compiles longest and runs last so a cold cache cannot starve the rest
+of the ladder), BENCH_BACKEND_RETRIES,
 BENCH_BACKEND_TIMEOUT (seconds for the subprocess backend probe).
 """
 
@@ -333,7 +335,7 @@ def main() -> None:
     deadline_s = float(os.environ.get("BENCH_TOTAL_S", "2700"))
     # north-star ladder (BASELINE.md): Q3/Q9/Q18 + the Q1 single-table base
     qnames = [q.strip() for q in
-              os.environ.get("BENCH_QUERY", "q1,q3,q9,q18").split(",")
+              os.environ.get("BENCH_QUERY", "q1,q3,q18,q9").split(",")
               if q.strip()]
     _partial["sf"] = sf
     start = time.time()

@@ -36,8 +36,13 @@ def _shrink_for_readback(b):
 
 
 def run_operator(root) -> dict[str, np.ndarray]:
+    import time
+
+    from ..utils import metric
     from ..utils.errors import QueryError, _PASSTHROUGH
 
+    metric.QUERIES.inc()
+    t0 = time.perf_counter()
     outs: list[dict[str, np.ndarray]] = []
     try:
         root.init()
@@ -54,6 +59,7 @@ def run_operator(root) -> dict[str, np.ndarray]:
         # typed query error, never a raw JAX traceback mid-flow
         raise QueryError(f"operator {type(root).__name__}", e) from e
     finally:
+        metric.QUERY_SECONDS.observe(time.perf_counter() - t0)
         root.close()
     if not outs:
         return {n: np.array([]) for n in root.output_schema.names}

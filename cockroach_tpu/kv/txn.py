@@ -136,6 +136,9 @@ class Txn:
             self.txn_id, commit_ts, commit=True
         )
         self._finished = True
+        from ..utils import metric
+
+        metric.TXN_COMMITS.inc()
         for cb in self._commit_hooks:
             cb()
         return commit_ts
@@ -214,6 +217,9 @@ class DB:
                 t.commit()
                 return out
             except TransactionRetryError:
+                from ..utils import metric
+
+                metric.TXN_RETRIES.inc()
                 t.rollback()
                 continue
             except BaseException:

@@ -320,6 +320,9 @@ class Engine:
             raise ValueError(f"key too long ({len(b)} > {self.key_width})")
         if len(v) > self.val_width:
             raise ValueError(f"value too long ({len(v)} > {self.val_width})")
+        from ..utils import metric
+
+        metric.ENGINE_WRITES.inc()
         seq = self._seq + 1
         if self._wal is not None:  # write-ahead: durable before visible
             self._wal_record(_REC_WRITE, b, v, int(ts), seq, int(txn), tomb)
@@ -410,6 +413,10 @@ class Engine:
         self._gen += 1
         self.stats.flushes += 1
         self.stats.runs = len(self.runs)
+        from ..utils import metric
+
+        metric.ENGINE_INGESTS.inc()
+        metric.ENGINE_RUNS.set(len(self.runs))
         # vectorized tscache update (bytes() per row is host work, but one
         # pass over the batch, not one device trip per key)
         t = int(ts)
@@ -437,6 +444,10 @@ class Engine:
         self._gen += 1
         self.stats.flushes += 1
         self.stats.runs = len(self.runs)
+        from ..utils import metric
+
+        metric.ENGINE_FLUSHES.inc()
+        metric.ENGINE_RUNS.set(len(self.runs))
 
     def compact(self, bottom: bool = True):
         """Compaction. bottom=True merges everything and elides bottom-level
@@ -469,6 +480,9 @@ class Engine:
         self.runs = kept
         self._gen += 1
         self.stats.compactions += 1
+        from ..utils import metric
+
+        metric.ENGINE_COMPACTIONS.inc()
         self.stats.runs = len(self.runs)
 
     # -- read views ---------------------------------------------------------
@@ -620,6 +634,9 @@ class Engine:
         truncation boundary are withheld (their version sets may be
         incomplete) and the clamp grows geometrically until max_keys
         complete rows emerge."""
+        from ..utils import metric
+
+        metric.ENGINE_SCANS.inc()
         sw = K.encode_bound(start, self.key_width)
         ew = K.encode_bound(end, self.key_width)
         limit = None

@@ -1,0 +1,141 @@
+"""Metrics registry — the pkg/util/metric analog.
+
+Reference: metric.go:326 defines prometheus-backed Gauge/Counter/Histogram
+types collected into a Registry and exported at /_status/vars; subsystems
+register their metrics at construction. Here the registry is process-local
+(the HTTP exporter arrives with the server layer) with the same three
+types, a prometheus-text dump for scraping/tests, and the engine + flow
+wired in as the first producers.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Counter:
+    """Monotonically increasing value (metric.Counter)."""
+
+    name: str
+    help: str = ""
+    _value: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def inc(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self._value += delta
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+@dataclass
+class Gauge:
+    """Set-to-current value (metric.Gauge)."""
+
+    name: str
+    help: str = ""
+    _value: float = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (metric.Histogram reduced: no windowing)."""
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: tuple[float, ...] = (
+                     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            i = bisect.bisect_left(self.buckets, v)
+            self.counts[i] += 1
+            self.sum += v
+            self.n += 1
+
+
+class Registry:
+    """Named metric collection (metric.Registry). Subsystems register at
+    construction; scrape() renders prometheus text exposition."""
+
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_add(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_add(name, lambda: Gauge(name, help))
+
+    def histogram(self, name: str, help: str = "", **kw) -> Histogram:
+        return self._get_or_add(name, lambda: Histogram(name, help, **kw))
+
+    def _get_or_add(self, name: str, make):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = make()
+            return m
+
+    def scrape(self) -> str:
+        """Prometheus text exposition (the /_status/vars shape)."""
+        out: list[str] = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            if isinstance(m, Counter):
+                out.append(f"# TYPE {name} counter")
+                out.append(f"{name} {m.value:g}")
+            elif isinstance(m, Gauge):
+                out.append(f"# TYPE {name} gauge")
+                out.append(f"{name} {m.value:g}")
+            elif isinstance(m, Histogram):
+                out.append(f"# TYPE {name} histogram")
+                cum = 0
+                for b, c in zip(m.buckets, m.counts):
+                    cum += c
+                    out.append(f'{name}_bucket{{le="{b:g}"}} {cum}')
+                cum += m.counts[-1]
+                out.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                out.append(f"{name}_sum {m.sum:g}")
+                out.append(f"{name}_count {m.n}")
+        return "\n".join(out) + "\n"
+
+
+# the process-default registry (subsystems use this unless injected)
+DEFAULT = Registry()
+
+# engine + flow metrics (first producers; names mirror the reference's
+# storage.*/sql.* metric families)
+ENGINE_FLUSHES = DEFAULT.counter(
+    "storage_flushes", "memtable flushes to sorted runs")
+ENGINE_COMPACTIONS = DEFAULT.counter(
+    "storage_compactions", "size-tiered compaction passes")
+ENGINE_INGESTS = DEFAULT.counter(
+    "storage_ingests", "bulk ingests (AddSSTable path)")
+ENGINE_WRITES = DEFAULT.counter(
+    "storage_writes", "KV write operations (put/delete)")
+ENGINE_SCANS = DEFAULT.counter("storage_scans", "KV scan operations")
+ENGINE_RUNS = DEFAULT.gauge("storage_runs", "sorted runs in the LSM")
+QUERIES = DEFAULT.counter("sql_queries", "queries executed by run_operator")
+QUERY_SECONDS = DEFAULT.histogram(
+    "sql_query_seconds", "end-to-end query latency")
+TXN_COMMITS = DEFAULT.counter("txn_commits", "committed transactions")
+TXN_RETRIES = DEFAULT.counter("txn_retries", "transaction retries")

@@ -284,3 +284,33 @@ def test_cli_execute_and_render():
     assert out.startswith("ERROR:")
     out = cli.execute_and_render(sess, "explain select a from t where a > 1")
     assert "-> " in out
+
+
+def test_metrics_registry():
+    """pkg/util/metric analog: engine/flow/txn producers feed the default
+    registry; scrape() renders prometheus text exposition."""
+    from cockroach_tpu.kv import DB, ManualClock
+    from cockroach_tpu.storage.lsm import Engine
+    from cockroach_tpu.utils import metric
+
+    w0 = metric.ENGINE_WRITES.value
+    c0 = metric.TXN_COMMITS.value
+    db = DB(Engine(key_width=16, val_width=16, memtable_size=8), ManualClock())
+    db.txn(lambda t: [t.put(b"k%d" % i, b"v") for i in range(10)])
+    assert metric.ENGINE_WRITES.value >= w0 + 10
+    assert metric.TXN_COMMITS.value == c0 + 1
+    assert len(db.scan(b"k", b"l")) == 10
+
+    text = metric.DEFAULT.scrape()
+    assert "# TYPE storage_writes counter" in text
+    assert "# TYPE sql_query_seconds histogram" in text
+    assert "storage_flushes" in text
+
+    h = metric.Histogram("x_seconds")
+    h.observe(0.002)
+    h.observe(3.0)
+    r = metric.Registry()
+    r._metrics["x_seconds"] = h
+    out = r.scrape()
+    assert 'x_seconds_bucket{le="+Inf"} 2' in out
+    assert "x_seconds_count 2" in out
