@@ -57,6 +57,10 @@ def run_operator(root) -> dict[str, np.ndarray]:
     except Exception as e:
         # the colexecerror boundary: engine/kernel failures surface as a
         # typed query error, never a raw JAX traceback mid-flow
+        from ..utils import log
+
+        log.error(log.SQL_EXEC, "query failed",
+                  operator=type(root).__name__, error=str(e))
         raise QueryError(f"operator {type(root).__name__}", e) from e
     finally:
         metric.QUERY_SECONDS.observe(time.perf_counter() - t0)

@@ -480,9 +480,11 @@ class Engine:
         self.runs = kept
         self._gen += 1
         self.stats.compactions += 1
-        from ..utils import metric
+        from ..utils import log, metric
 
         metric.ENGINE_COMPACTIONS.inc()
+        log.debug(log.STORAGE, "compaction", runs=len(self.runs),
+                  bottom=bottom)
         self.stats.runs = len(self.runs)
 
     # -- read views ---------------------------------------------------------

@@ -1,0 +1,101 @@
+"""Structured logging — the pkg/util/log analog.
+
+Reference: channelized structured logs (log/channels.go: DEV, OPS, HEALTH,
+STORAGE, SQL_EXEC, ...), JSON sinks with redactable strings, severity
+filtering. Here: the same channel/severity shape over JSON lines, a
+process-default sink (stderr or file), and redaction markers — reduced to
+what a single process needs (fluent/http sinks and the event-proto schema
+arrive with the server layer).
+
+    from cockroach_tpu.utils import log
+    log.info(log.STORAGE, "compaction finished", runs=3, rows=1024)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+# channels (log/channels.go)
+DEV = "DEV"
+OPS = "OPS"
+HEALTH = "HEALTH"
+STORAGE = "STORAGE"
+SQL_EXEC = "SQL_EXEC"
+SENSITIVE_ACCESS = "SENSITIVE_ACCESS"
+
+_SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
+
+
+class Redactable(str):
+    """A value that redacts in logs unless redaction is off — the
+    redact.RedactableString discipline (values wrapped, not formatted)."""
+
+
+class _Sink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._file = None
+        self.min_severity = "INFO"
+        self.redact = False
+
+    def set_file(self, path: str | None) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+            self._file = open(path, "a") if path else None
+
+    def emit(self, record: dict) -> None:
+        line = json.dumps(record, default=str)
+        with self._lock:
+            out = self._file if self._file is not None else sys.stderr
+            print(line, file=out, flush=True)
+
+
+_sink = _Sink()
+
+
+def set_file(path: str | None) -> None:
+    """Route logs to a file (None = stderr)."""
+    _sink.set_file(path)
+
+
+def set_min_severity(sev: str) -> None:
+    assert sev in _SEVERITIES
+    _sink.min_severity = sev
+
+
+def _log(sev: str, channel: str, msg: str, kw: dict) -> None:
+    if _SEVERITIES.index(sev) < _SEVERITIES.index(_sink.min_severity):
+        return
+    fields = {}
+    for k, v in kw.items():
+        if _sink.redact and isinstance(v, Redactable):
+            fields[k] = "<redacted>"
+        else:
+            fields[k] = v
+    _sink.emit({
+        "ts": round(time.time(), 3),
+        "sev": sev,
+        "ch": channel,
+        "msg": msg,
+        **fields,
+    })
+
+
+def debug(channel: str, msg: str, **kw) -> None:
+    _log("DEBUG", channel, msg, kw)
+
+
+def info(channel: str, msg: str, **kw) -> None:
+    _log("INFO", channel, msg, kw)
+
+
+def warning(channel: str, msg: str, **kw) -> None:
+    _log("WARNING", channel, msg, kw)
+
+
+def error(channel: str, msg: str, **kw) -> None:
+    _log("ERROR", channel, msg, kw)

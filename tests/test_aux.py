@@ -314,3 +314,28 @@ def test_metrics_registry():
     out = r.scrape()
     assert 'x_seconds_bucket{le="+Inf"} 2' in out
     assert "x_seconds_count 2" in out
+
+
+def test_structured_logging(tmp_path):
+    """pkg/util/log analog: channelized JSON lines, severity filter,
+    redaction markers."""
+    import json as _json
+
+    from cockroach_tpu.utils import log
+
+    path = str(tmp_path / "out.log")
+    log.set_file(path)
+    try:
+        log.set_min_severity("INFO")
+        log.debug(log.DEV, "dropped")
+        log.info(log.STORAGE, "kept", runs=3)
+        log._sink.redact = True
+        log.warning(log.SENSITIVE_ACCESS, "auth",
+                    user=log.Redactable("alice"))
+    finally:
+        log._sink.redact = False
+        log.set_file(None)
+    lines = [_json.loads(x) for x in open(path).read().splitlines()]
+    assert [x["msg"] for x in lines] == ["kept", "auth"]
+    assert lines[0]["ch"] == "STORAGE" and lines[0]["runs"] == 3
+    assert lines[1]["user"] == "<redacted>"
