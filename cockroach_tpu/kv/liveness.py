@@ -1,0 +1,132 @@
+"""Node liveness — the kvserver/liveness analog.
+
+Reference: liveness.go:241 NodeLiveness heartbeats an epoch-stamped record
+into the KV store; a record whose expiration passed marks the node dead,
+and INCREMENTING ITS EPOCH (by another node) fences any leases the dead
+node held — the failure-detection primitive leases and the allocator build
+on. Here the same record/epoch/fencing state machine runs over the engine's
+KV surface (records in a reserved system keyspace), sized for the current
+single-process topology: multiple NodeLiveness instances sharing one DB
+behave like nodes sharing the liveness range, and the DCN flow server can
+carry heartbeats when multi-host lands.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from .txn import DB, TransactionRetryError
+
+# system keyspace: table id 0's prefix byte (0x01) + a NUL-free tag — the
+# engine's zero-padded fixed-width keys reject 0x00 bytes, so node ids
+# encode as fixed-width decimal ASCII (order-preserving, NUL-free)
+_PREFIX = b"\x01liv"
+_REC = struct.Struct("<qqq")  # epoch, expiration_ts, node_id
+
+
+class StillLiveError(Exception):
+    """increment_epoch refused: the target's record has not expired."""
+
+
+class EpochFencedError(Exception):
+    """The node's epoch was incremented by a peer (it was declared dead):
+    every lease it held under the old epoch is invalid and it must not
+    heartbeat the old epoch back to life."""
+
+
+@dataclass(frozen=True)
+class LivenessRecord:
+    node_id: int
+    epoch: int
+    expiration: int  # hlc timestamp
+
+    def live_at(self, ts: int) -> bool:
+        return ts < self.expiration
+
+
+class NodeLiveness:
+    """One node's view of the shared liveness records."""
+
+    def __init__(self, db: DB, node_id: int,
+                 heartbeat_interval_ms: int = 4500,
+                 ttl_ms: int = 9000):
+        self.db = db
+        self.node_id = int(node_id)
+        self.ttl_ms = ttl_ms
+        self.heartbeat_interval_ms = heartbeat_interval_ms
+        self._my_epoch: int | None = None  # epoch this node believes it owns
+
+    @staticmethod
+    def _key(node_id: int) -> bytes:
+        return _PREFIX + b"%05d" % node_id
+
+    def _read(self, node_id: int) -> LivenessRecord | None:
+        v = self.db.get(self._key(node_id))
+        if v is None:
+            return None
+        epoch, exp, nid = _REC.unpack(v)
+        return LivenessRecord(nid, epoch, exp)
+
+    # -- the node's own record ---------------------------------------------
+
+    def heartbeat(self) -> LivenessRecord:
+        """Extend this node's expiration under the epoch it believes it
+        owns. Raises EpochFencedError if a peer incremented the epoch (the
+        node was declared dead; its old leases are invalid)."""
+        def op(t):
+            cur = self._read(self.node_id)
+            now = self.db.clock.now()
+            from . import hlc
+
+            wall, _ = hlc.unpack(now)
+            exp = hlc.pack(wall + self.ttl_ms, 0)
+            if cur is None:
+                rec = LivenessRecord(self.node_id, 1, exp)
+            elif (self._my_epoch is not None
+                    and cur.epoch != self._my_epoch):
+                raise EpochFencedError(
+                    f"node {self.node_id}: epoch {self._my_epoch} fenced "
+                    f"(record at {cur.epoch})"
+                )
+            else:
+                rec = LivenessRecord(self.node_id, cur.epoch, exp)
+            t.put(self._key(self.node_id),
+                  _REC.pack(rec.epoch, rec.expiration, rec.node_id))
+            return rec
+
+        rec = self.db.txn(op)
+        self._my_epoch = rec.epoch
+        return rec
+
+    # -- other nodes --------------------------------------------------------
+
+    def is_live(self, node_id: int) -> bool:
+        rec = self._read(node_id)
+        return rec is not None and rec.live_at(self.db.clock.now())
+
+    def increment_epoch(self, node_id: int) -> LivenessRecord:
+        """Declare a non-live node dead by bumping its epoch — the fencing
+        write that invalidates its epoch-based leases. Refuses while the
+        record is still live (liveness.go IncrementEpoch contract)."""
+        def op(t):
+            cur = self._read(node_id)
+            if cur is None:
+                raise ValueError(f"no liveness record for node {node_id}")
+            if cur.live_at(self.db.clock.now()):
+                raise StillLiveError(
+                    f"node {node_id} is still live; cannot increment epoch"
+                )
+            rec = LivenessRecord(node_id, cur.epoch + 1, cur.expiration)
+            t.put(self._key(node_id),
+                  _REC.pack(rec.epoch, rec.expiration, rec.node_id))
+            return rec
+
+        return self.db.txn(op)
+
+    def livenesses(self) -> list[LivenessRecord]:
+        out = []
+        for _, v in self.db.scan(_PREFIX, _PREFIX + b"\xff"):
+            epoch, exp, nid = _REC.unpack(v)
+            out.append(LivenessRecord(nid, epoch, exp))
+        return out

@@ -239,3 +239,43 @@ def test_non_txn_write_respects_intents():
     assert db.get("k") == b"txnval"
     db.put("k", "after")  # lock released by commit
     assert db.get("k") == b"after"
+
+
+def test_node_liveness_epochs():
+    """liveness.go analog: heartbeats extend expiration under an epoch;
+    expired records can be fenced by an epoch increment; live ones can't."""
+    from cockroach_tpu.kv import DB, ManualClock
+    from cockroach_tpu.kv.liveness import NodeLiveness, StillLiveError
+    from cockroach_tpu.storage.lsm import Engine
+
+    clock = ManualClock(start=1)
+    db = DB(Engine(key_width=16, val_width=32, memtable_size=256), clock)
+    n1 = NodeLiveness(db, 1, ttl_ms=1000)
+    n2 = NodeLiveness(db, 2, ttl_ms=1000)
+
+    r1 = n1.heartbeat()
+    n2.heartbeat()
+    assert r1.epoch == 1
+    assert n2.is_live(1) and n1.is_live(2)
+    assert {r.node_id for r in n1.livenesses()} == {1, 2}
+
+    # node 1 keeps heartbeating: epoch stays, expiration extends
+    clock.advance(500)
+    r1b = n1.heartbeat()
+    assert r1b.epoch == 1 and r1b.expiration > r1.expiration
+
+    # fencing a LIVE node is refused
+    with pytest.raises(StillLiveError):
+        n2.increment_epoch(1)
+
+    # after expiry, node 2 declares node 1 dead by bumping its epoch
+    clock.advance(5000)
+    assert not n2.is_live(1)
+    fenced = n2.increment_epoch(1)
+    assert fenced.epoch == 2
+
+    # node 1's next heartbeat detects the fence (its old epoch is gone)
+    from cockroach_tpu.kv.liveness import EpochFencedError
+
+    with pytest.raises(EpochFencedError):
+        n1.heartbeat()
