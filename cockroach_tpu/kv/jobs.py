@@ -1,0 +1,143 @@
+"""Jobs framework — the pkg/jobs analog.
+
+Reference: jobs.Registry (registry.go:95) keeps durable job records in
+system tables; a Resumer (registry.go:1417) drives each job type; adoption
+claims unowned jobs (adopt.go) and resumes them from their persisted
+progress — the mechanism every long-running operation (backup, import,
+schema change, changefeed) rides so that a crash resumes instead of
+restarting. Here the same shape over the KV engine:
+
+- job records (id, type, state, payload, progress) persist in a system
+  keyspace through kv transactions;
+- Resumer implementations register per job type and receive (job, progress)
+  on resume — they checkpoint by writing progress back;
+- Registry.run_to_completion drives a job with crash-equivalent resume
+  semantics (tested by killing the resumer mid-run and re-adopting).
+
+States: pending -> running -> succeeded | failed (paused omitted until a
+control surface exists).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from .txn import DB
+
+_PREFIX = b"\x01job"
+
+
+@dataclass
+class Job:
+    job_id: int
+    job_type: str
+    state: str  # pending | running | succeeded | failed
+    payload: dict
+    progress: dict
+    error: str = ""
+
+
+class Registry:
+    """Durable job records + resumer dispatch (jobs.Registry reduction)."""
+
+    def __init__(self, db: DB, node_id: int = 1):
+        self.db = db
+        self.node_id = node_id
+        self._resumers: dict[str, object] = {}
+
+    # -- resumer registration (RegisterConstructor analog) -------------------
+
+    def register(self, job_type: str, resume_fn) -> None:
+        """resume_fn(registry, job) runs/continues the job; it reads
+        job.progress for its checkpoint and calls registry.checkpoint(job)
+        after each unit of work. Return value = final result payload."""
+        self._resumers[job_type] = resume_fn
+
+    # -- record persistence --------------------------------------------------
+
+    @staticmethod
+    def _key(job_id: int) -> bytes:
+        return _PREFIX + b"%08d" % job_id
+
+    def _write(self, t, job: Job) -> None:
+        t.put(self._key(job.job_id), json.dumps({
+            "type": job.job_type, "state": job.state,
+            "payload": job.payload, "progress": job.progress,
+            "error": job.error,
+        }).encode("utf-8"))
+
+    def load(self, job_id: int) -> Job | None:
+        v = self.db.get(self._key(job_id))
+        if v is None:
+            return None
+        d = json.loads(v.decode("utf-8"))
+        return Job(job_id, d["type"], d["state"], d["payload"],
+                   d["progress"], d.get("error", ""))
+
+    def jobs(self) -> list[Job]:
+        out = []
+        for k, v in self.db.scan(_PREFIX, _PREFIX + b"\xff"):
+            d = json.loads(v.decode("utf-8"))
+            out.append(Job(int(k[len(_PREFIX):]), d["type"], d["state"],
+                           d["payload"], d["progress"],
+                           d.get("error", "")))
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def create(self, job_type: str, payload: dict) -> Job:
+        """CreateJob: a durable pending record (one txn)."""
+        existing = [j.job_id for j in self.jobs()]
+        job = Job(max(existing, default=0) + 1, job_type, "pending",
+                  payload, {})
+        self.db.txn(lambda t: self._write(t, job))
+        return job
+
+    def checkpoint(self, job: Job) -> None:
+        """Persist progress mid-run (the backup-manifest-checkpoint shape:
+        a crash after this point resumes from here, not from zero)."""
+        self.db.txn(lambda t: self._write(t, job))
+
+    def adopt_and_resume(self, job_id: int) -> Job:
+        """Claim a pending/running job and drive its resumer to a terminal
+        state. Re-entrant: called again after a crash, the resumer
+        continues from the persisted progress."""
+        job = self.load(job_id)
+        if job is None:
+            raise KeyError(f"no job {job_id}")
+        if job.state in ("succeeded", "failed"):
+            return job
+        resume = self._resumers.get(job.job_type)
+        if resume is None:
+            raise KeyError(f"no resumer for job type {job.job_type!r}")
+        job.state = "running"
+        self.checkpoint(job)
+        try:
+            result = resume(self, job)
+        except Exception as e:
+            job.state = "failed"
+            job.error = f"{type(e).__name__}: {e}"
+            self.checkpoint(job)
+            raise
+        job.state = "succeeded"
+        if isinstance(result, dict):
+            job.progress.update(result)
+        self.checkpoint(job)
+        return job
+
+
+# -- built-in job types ------------------------------------------------------
+
+
+def register_builtin_jobs(registry: Registry) -> None:
+    """The reference runs BACKUP as a job (pkg/backup/backup_processor.go
+    under jobs.Resumer); here the engine checkpoint rides the same frame:
+    durable record -> run -> terminal state, resumable by re-adoption."""
+
+    def backup_resume(reg: Registry, job: Job):
+        path = job.payload["path"]
+        reg.db.engine.checkpoint(path)
+        return {"path": path}
+
+    registry.register("backup", backup_resume)

@@ -279,3 +279,72 @@ def test_node_liveness_epochs():
 
     with pytest.raises(EpochFencedError):
         n1.heartbeat()
+
+
+def test_jobs_resume_from_checkpoint():
+    """pkg/jobs analog: a job killed mid-run re-adopts and RESUMES from its
+    persisted progress instead of restarting (the backup-checkpoint
+    discipline, manifest_handling.go:1401)."""
+    from cockroach_tpu.kv import DB, ManualClock
+    from cockroach_tpu.kv.jobs import Registry
+    from cockroach_tpu.storage.lsm import Engine
+
+    db = DB(Engine(key_width=16, val_width=256, memtable_size=256),
+            ManualClock())
+    reg = Registry(db)
+    work_log: list[int] = []
+    crash_at = {"n": 3}
+
+    def resume(registry, job):
+        done = job.progress.get("done", 0)
+        total = job.payload["total"]
+        for i in range(done, total):
+            if i == crash_at["n"]:
+                crash_at["n"] = -1  # only crash once
+                raise RuntimeError("simulated crash")
+            work_log.append(i)
+            job.progress["done"] = i + 1
+            registry.checkpoint(job)
+        return {"rows": total}
+
+    reg.register("backfill", resume)
+    job = reg.create("backfill", {"total": 6})
+    assert reg.load(job.job_id).state == "pending"
+
+    with pytest.raises(RuntimeError):
+        reg.adopt_and_resume(job.job_id)
+    assert reg.load(job.job_id).state == "failed"
+    assert work_log == [0, 1, 2], "crashed at unit 3"
+
+    # "restart": a fresh registry over the same engine re-adopts; the
+    # failed record still holds progress, so work resumes at unit 3
+    reg2 = Registry(db)
+    reg2.register("backfill", resume)
+    j = reg2.load(job.job_id)
+    j.state = "pending"  # operator-retry (RESUME JOB)
+    reg2.checkpoint(j)
+    out = reg2.adopt_and_resume(job.job_id)
+    assert out.state == "succeeded" and out.progress["rows"] == 6
+    assert work_log == [0, 1, 2, 3, 4, 5], "no unit re-ran"
+
+
+def test_backup_as_a_job(tmp_path):
+    """BACKUP rides the jobs frame: durable record, engine checkpoint,
+    restore from the produced artifact."""
+    from cockroach_tpu.kv import DB, ManualClock
+    from cockroach_tpu.kv.jobs import Registry, register_builtin_jobs
+    from cockroach_tpu.storage.lsm import Engine
+
+    db = DB(Engine(key_width=16, val_width=32, memtable_size=64),
+            ManualClock())
+    db.txn(lambda t: [t.put(b"k%03d" % i, b"v%03d" % i) for i in range(50)])
+    reg = Registry(db)
+    register_builtin_jobs(reg)
+    path = str(tmp_path / "bk")
+    job = reg.create("backup", {"path": path})
+    done = reg.adopt_and_resume(job.job_id)
+    assert done.state == "succeeded" and done.progress["path"] == path
+
+    restored = Engine.open_checkpoint(path)
+    got = restored.scan(b"k", b"l", ts=db.clock.now())
+    assert len(got) == 50 and got[0] == (b"k000", b"v000")
