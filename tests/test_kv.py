@@ -335,7 +335,7 @@ def test_backup_as_a_job(tmp_path):
     from cockroach_tpu.kv.jobs import Registry, register_builtin_jobs
     from cockroach_tpu.storage.lsm import Engine
 
-    db = DB(Engine(key_width=16, val_width=32, memtable_size=64),
+    db = DB(Engine(key_width=16, val_width=256, memtable_size=64),
             ManualClock())
     db.txn(lambda t: [t.put(b"k%03d" % i, b"v%03d" % i) for i in range(50)])
     reg = Registry(db)
