@@ -1,0 +1,103 @@
+"""Changefeeds — the changefeedccl reduction (CDC over MVCC history).
+
+Reference: a changefeed is a job whose processors tail rangefeeds
+(kvclient/rangefeed over MuxRangeFeed), encode changed rows, push them to a
+sink (kafka/cloud/webhook), and checkpoint a RESOLVED timestamp frontier
+into the job record so restarts resume without loss or duplication. Here
+the same loop over the engine's retained MVCC versions:
+
+- ``Engine`` history IS the feed source: ``changes_between(lo, hi)`` lists
+  committed versions in (lo, hi] for a span (the catch-up scan shape,
+  kvserver/rangefeed/catchup_scan.go — polling stands in for the push
+  plumbing until the DCN server carries subscriptions);
+- events encode as JSON lines {key, value|null, ts} (the wire envelope);
+- the feed runs as a JOB: each poll emits events then checkpoints
+  ``resolved`` — crash + re-adoption resumes from the frontier, exactly
+  once per version (verified in tests).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..storage import keys as K
+from .jobs import Job, Registry
+from .txn import DB
+
+
+def changes_between(db: DB, lo_ts: int, hi_ts: int,
+                    start: bytes | None = None,
+                    end: bytes | None = None) -> list[dict]:
+    """Committed versions with lo_ts < ts <= hi_ts in [start, end), ordered
+    by (ts, key) — the catch-up scan. Tombstones emit value None."""
+    eng = db.engine
+    eng.flush_mem_only()
+    view = eng._merged_view()
+    if view is None:
+        return []
+    mask = np.asarray(view.mask)
+    ts = np.asarray(view.ts)
+    txn = np.asarray(view.txn)
+    sel = mask & (txn == 0) & (ts > lo_ts) & (ts <= hi_ts)
+    if start is not None or end is not None:
+        keys_np = np.asarray(view.key)
+        raw = [bytes(k).rstrip(b"\x00") for k in keys_np]
+        inr = np.array([
+            (start is None or k >= start) and (end is None or k < end)
+            for k in raw
+        ])
+        sel = sel & inr
+    idx = np.nonzero(sel)[0]
+    if len(idx) == 0:
+        return []
+    keys = K.decode_keys(np.asarray(view.key)[idx])
+    vals = np.asarray(view.value)[idx]
+    vlens = np.asarray(view.vlen)[idx]
+    tombs = np.asarray(view.tomb)[idx]
+    out = []
+    for k, v, n, tomb, t in zip(keys, vals, vlens, tombs, ts[idx]):
+        out.append({
+            "key": k.decode("utf-8", "replace"),
+            "value": None if tomb else bytes(v[:n]).decode("utf-8",
+                                                           "replace"),
+            "ts": int(t),
+        })
+    out.sort(key=lambda e: (e["ts"], e["key"]))
+    return out
+
+
+class FileSink:
+    """JSON-lines sink (the cloud-storage sink reduction)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def emit(self, events: list[dict]) -> None:
+        with open(self.path, "a") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+
+
+def register_changefeed_job(registry: Registry, polls: int = 1) -> None:
+    """Changefeed as a jobs.Resumer: each poll emits (resolved, now] events
+    to the sink then checkpoints the new resolved frontier."""
+
+    def resume(reg: Registry, job: Job):
+        sink = FileSink(job.payload["sink"])
+        start = job.payload.get("start")
+        end = job.payload.get("end")
+        s = start.encode() if isinstance(start, str) else start
+        e = end.encode() if isinstance(end, str) else end
+        for _ in range(job.payload.get("polls", polls)):
+            resolved = job.progress.get("resolved", 0)
+            now = reg.db.clock.now()
+            events = changes_between(reg.db, resolved, now, s, e)
+            if events:
+                sink.emit(events)
+            job.progress["resolved"] = now
+            reg.checkpoint(job)  # frontier checkpoint: resume point
+        return {"resolved": job.progress["resolved"]}
+
+    registry.register("changefeed", resume)
