@@ -104,6 +104,22 @@ class ExtractYear(Expr):
     arg: Expr  # DATE
 
 
+@dataclass(frozen=True)
+class Func1(Expr):
+    """Unary scalar builtin over a numeric expr (sem/builtins surface:
+    abs | ceil | floor | round | sign | sqrt | exp | ln)."""
+
+    func: str
+    arg: Expr
+
+
+@dataclass(frozen=True)
+class Coalesce(Expr):
+    """COALESCE(a, b, ...): first non-NULL argument."""
+
+    args: tuple[Expr, ...]
+
+
 def lit(value: Any, t: SQLType | None = None) -> Const:
     if t is None:
         if isinstance(value, bool):
@@ -146,6 +162,17 @@ def expr_type(e: Expr, schema: Schema) -> SQLType:
         return e.to
     if isinstance(e, ExtractYear):
         return INT64
+    if isinstance(e, Func1):
+        at = expr_type(e.arg, schema)
+        if e.func in ("sqrt", "exp", "ln"):
+            return FLOAT64
+        if e.func in ("ceil", "floor", "round"):
+            return INT64 if at.family in (Family.INT,) else at
+        if e.func == "sign":
+            return INT64
+        return at  # abs keeps the input type
+    if isinstance(e, Coalesce):
+        return expr_type(e.args[0], schema)
     if isinstance(e, Case):
         return expr_type(e.whens[0][1], schema)
     if isinstance(e, BinOp):
@@ -211,6 +238,47 @@ def eval_expr(e: Expr, cols, schema: Schema):
     if isinstance(e, ExtractYear):
         d, v = eval_expr(e.arg, cols, schema)
         return _year_from_days(d), v
+
+    if isinstance(e, Func1):
+        d, v = eval_expr(e.arg, cols, schema)
+        at = expr_type(e.arg, schema)
+        scale = 10 ** at.scale if at.family is Family.DECIMAL else 1
+        if e.func == "abs":
+            return jnp.abs(d), v
+        if e.func == "sign":
+            return jnp.sign(d).astype(jnp.int64), v
+        if e.func in ("ceil", "floor", "round"):
+            if at.family is Family.FLOAT:
+                f = {"ceil": jnp.ceil, "floor": jnp.floor,
+                     "round": jnp.round}[e.func]
+                return f(d), v
+            if at.family is Family.DECIMAL:
+                # stay in scaled-int space: exact, no float round-trip
+                q, r = d // scale, d % scale
+                if e.func == "ceil":
+                    out = (q + (r > 0)) * scale
+                elif e.func == "floor":
+                    out = q * scale
+                else:  # round half away from zero (SQL numeric rounding)
+                    out = (q + (r * 2 >= scale)) * scale
+                return out, v
+            return d, v  # ints are already integral
+        f64 = d.astype(jnp.float64) / scale
+        if e.func == "sqrt":
+            return jnp.sqrt(f64), v & (f64 >= 0)
+        if e.func == "exp":
+            return jnp.exp(f64), v
+        if e.func == "ln":
+            return jnp.log(f64), v & (f64 > 0)
+        raise ValueError(f"unknown builtin {e.func}")
+
+    if isinstance(e, Coalesce):
+        d, v = eval_expr(e.args[0], cols, schema)
+        for a in e.args[1:]:
+            d1, v1 = eval_expr(a, cols, schema)
+            d = jnp.where(v, d, d1.astype(d.dtype))
+            v = v | v1
+        return d, v
 
     if isinstance(e, IsNull):
         _, v = eval_expr(e.arg, cols, schema)

@@ -336,6 +336,25 @@ class ExprLowerer:
             raise BindError(
                 f"aggregate {e.name} not allowed in this context"
             )
+        if (isinstance(e, P.FuncCall) and len(e.args) == 1
+                and e.name in ("abs", "ceil", "ceiling", "floor", "round",
+                               "sign", "sqrt", "exp", "ln")):
+            name = "ceil" if e.name == "ceiling" else e.name
+            return ex.Func1(name, self.lower(e.args[0]))
+        if isinstance(e, P.FuncCall) and e.name == "coalesce" and e.args:
+            return ex.Coalesce(tuple(self.lower(a) for a in e.args))
+        if (isinstance(e, P.FuncCall)
+                and e.name in ("length", "char_length")
+                and len(e.args) == 1):
+            i = self._is_string_col(e.args[0])
+            if i is None:
+                raise BindError(f"{e.name} requires a string column")
+            d = self.rel.dicts[i]
+            table = np.array([len(str(v)) for v in d.values],
+                             dtype=np.int64)
+            if len(table) == 0:
+                table = np.zeros(1, np.int64)
+            return ex.CodeLookup(col=i, table=table, out_type=INT64)
         raise BindError(f"cannot lower expression {e}")
 
     def lower_cmp(self, e: P.Cmp) -> ex.Expr:
@@ -1098,8 +1117,16 @@ class Binder:
         """String-valued functions of a STRING column (substring) — host-
         evaluated per dictionary entry, a code-remap gather on device.
         Returns (expr, Dictionary) or None."""
-        if not (isinstance(e, P.FuncCall) and e.name == "substring"
-                and len(e.args) == 3 and isinstance(e.args[0], P.Ident)):
+        if not (isinstance(e, P.FuncCall) and len(e.args) >= 1
+                and isinstance(e.args[0], P.Ident)):
+            return None
+        if e.name == "substring" and len(e.args) == 3:
+            start = int(e.args[1].value) - 1
+            n = int(e.args[2].value)
+            fn = lambda s: s[start:start + n]  # noqa: E731
+        elif e.name in ("upper", "lower") and len(e.args) == 1:
+            fn = (str.upper if e.name == "upper" else str.lower)
+        else:
             return None
         i = lower.idx(e.args[0])
         if rel.schema.types[i].family is not Family.STRING:
@@ -1107,10 +1134,8 @@ class Binder:
         from ..coldata.batch import Dictionary
         from ..coldata.types import STRING
 
-        start = int(e.args[1].value) - 1
-        n = int(e.args[2].value)
         d = rel.dicts[i]
-        mapped = np.array([str(v)[start:start + n] for v in d.values],
+        mapped = np.array([fn(str(v)) for v in d.values],
                           dtype=object)
         if len(mapped):
             uvals, codes = np.unique(mapped.astype(str), return_inverse=True)

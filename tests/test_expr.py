@@ -103,3 +103,46 @@ def test_code_lookup_string_predicate():
     b = cd.from_host(schema, {"s": np.array([0, 1, 2, 1], dtype=np.int32)}, capacity=8)
     m = ex.filter_mask(b, schema, ex.CodeLookup(col=0, table=table))
     np.testing.assert_array_equal(np.asarray(m)[:4], [False, True, True, True])
+
+
+def test_scalar_builtins_sql():
+    """sem/builtins surface: abs/ceil/floor/round/sign/sqrt/exp/ln,
+    coalesce, length, upper/lower — oracle numpy/pandas."""
+    import numpy as np
+
+    from cockroach_tpu.bench import tpch
+    from cockroach_tpu.sql import sql
+
+    cat = tpch.gen_tpch(sf=0.002, seed=9)
+    li = tpch.to_pandas(cat, "lineitem")
+
+    got = sql(cat, """
+        select abs(l_quantity - 25.0) as a, ceil(l_discount) as c,
+               floor(l_tax) as f, round(l_extendedprice) as r,
+               sqrt(l_quantity) as s,
+               coalesce(l_quantity, 0) as co
+        from lineitem order by l_orderkey, l_linenumber limit 50
+    """).run()
+    df = li.sort_values(["l_orderkey", "l_linenumber"]).head(50)
+    np.testing.assert_allclose(np.asarray(got["a"], np.float64),
+                               (df.l_quantity - 25.0).abs(), rtol=0, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(got["c"], np.float64),
+                               np.ceil(df.l_discount), rtol=0)
+    np.testing.assert_allclose(np.asarray(got["f"], np.float64),
+                               np.floor(df.l_tax), rtol=0)
+    np.testing.assert_allclose(np.asarray(got["r"], np.float64),
+                               np.floor(df.l_extendedprice + 0.5), rtol=0)
+    np.testing.assert_allclose(np.asarray(got["s"], np.float64),
+                               np.sqrt(df.l_quantity), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(got["co"], np.float64),
+                               df.l_quantity, rtol=0)
+
+    got = sql(cat, """
+        select length(l_shipmode) as n, upper(l_shipmode) as u,
+               lower(l_shipmode) as lo
+        from lineitem order by l_orderkey, l_linenumber limit 10
+    """).run()
+    df = li.sort_values(["l_orderkey", "l_linenumber"]).head(10)
+    assert list(got["n"]) == [len(s) for s in df.l_shipmode]
+    assert list(got["u"]) == [s.upper() for s in df.l_shipmode]
+    assert list(got["lo"]) == [s.lower() for s in df.l_shipmode]
