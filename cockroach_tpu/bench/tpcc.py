@@ -1,0 +1,184 @@
+"""TPC-C workload (reduced) — the pkg/workload/tpcc analog.
+
+Reference: pkg/workload/tpcc generates the 9-table schema and drives
+NewOrder/Payment/OrderStatus/Delivery/StockLevel in their spec mix;
+roachtest's tpcc check asserts the consistency invariants (3.3.2.x: e.g.
+W_YTD == sum(D_YTD)). This reduction keeps the transactional heart —
+NewOrder and Payment as MULTI-STATEMENT KV TRANSACTIONS with contention on
+the district cursor — over the Session/KVTable surface, plus the two
+invariants those transactions maintain. Out of scope until the schema layer
+grows composite primary keys: item/stock tables (order lines price from a
+deterministic item function), carrier/delivery queues.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..kv.txn import TransactionRetryError
+from ..sql import Session
+
+W_YTD_START = 30000_00  # cents, spec initial warehouse ytd
+
+
+def load(sess: Session, warehouses: int = 1, districts: int = 10,
+         customers: int = 30) -> None:
+    """CREATE + populate the reduced schema (ids flattened into single-int
+    primary keys: district pk = w*100+d, customer pk = (w*100+d)*10000+c)."""
+    sess.execute("""
+        create table warehouse (
+            w_id int primary key, w_tax decimal(4, 4),
+            w_ytd decimal(12, 2))
+    """)
+    sess.execute("""
+        create table district (
+            d_pk int primary key, d_w_id int, d_id int,
+            d_tax decimal(4, 4), d_ytd decimal(12, 2),
+            d_next_o_id int)
+    """)
+    sess.execute("""
+        create table customer (
+            c_pk int primary key, c_w_id int, c_d_id int, c_id int,
+            c_balance decimal(12, 2), c_ytd_payment decimal(12, 2),
+            c_payment_cnt int, c_delivery_cnt int)
+    """)
+    sess.execute("""
+        create table orders (
+            o_pk int primary key, o_w_id int, o_d_id int, o_c_id int,
+            o_ol_cnt int, o_entry_d int, o_total decimal(12, 2))
+    """)
+    rng = np.random.default_rng(7)
+    for w in range(1, warehouses + 1):
+        sess.execute(
+            f"insert into warehouse values ({w}, 0.1000, 30000.00)")
+        rows = ", ".join(
+            f"({w * 100 + d}, {w}, {d}, 0.0500, 3000.00, 1)"
+            for d in range(1, districts + 1)
+        )
+        sess.execute(f"insert into district values {rows}")
+        crows = []
+        for d in range(1, districts + 1):
+            for c in range(1, customers + 1):
+                pk = (w * 100 + d) * 10000 + c
+                crows.append(f"({pk}, {w}, {d}, {c}, -10.00, 10.00, 1, 0)")
+        sess.execute(f"insert into customer values {', '.join(crows)}")
+    del rng
+
+
+def _district(sess: Session, w: int, d: int) -> dict:
+    t = sess.catalog.tables["district"]
+    return t.get_row(w * 100 + d)
+
+
+def new_order(sess: Session, w: int, d: int, c: int, ol_cnt: int,
+              entry_day: int) -> int:
+    """NewOrder: allocate the district's next order id (THE contended
+    write), insert the order with a deterministic total. Returns o_id."""
+    dt = sess.catalog.tables["district"]
+    ot = sess.catalog.tables["orders"]
+
+    def op(txn):
+        drow = dt.get_row(w * 100 + d)
+        o_id = drow["d_next_o_id"]
+        drow["d_next_o_id"] = o_id + 1
+        dt.insert(txn, drow)  # MVCC: new version of the district cursor
+        total = sum(100 + ((o_id * 7 + i) % 900) for i in range(ol_cnt))
+        ot.insert(txn, {
+            "o_pk": (w * 100 + d) * 1000000 + o_id,
+            "o_w_id": w, "o_d_id": d, "o_c_id": c, "o_ol_cnt": ol_cnt,
+            "o_entry_d": entry_day, "o_total": total,
+        })
+        return o_id
+
+    return sess.db.txn(op)
+
+
+def payment(sess: Session, w: int, d: int, c: int, amount_cents: int):
+    """Payment: W_YTD += h, D_YTD += h, customer balance += h / counters —
+    three tables in ONE transaction (the invariant-bearing write set)."""
+    wt = sess.catalog.tables["warehouse"]
+    dt = sess.catalog.tables["district"]
+    ct = sess.catalog.tables["customer"]
+
+    def op(txn):
+        wrow = wt.get_row(w)
+        wrow["w_ytd"] += amount_cents
+        wt.insert(txn, wrow)
+        drow = dt.get_row(w * 100 + d)
+        drow["d_ytd"] += amount_cents
+        dt.insert(txn, drow)
+        cpk = (w * 100 + d) * 10000 + c
+        crow = ct.get_row(cpk)
+        crow["c_balance"] -= amount_cents
+        crow["c_ytd_payment"] += amount_cents
+        crow["c_payment_cnt"] += 1
+        ct.insert(txn, crow)
+
+    sess.db.txn(op)
+
+
+def check_consistency(sess: Session, warehouses: int = 1,
+                      districts: int = 10) -> None:
+    """The tpcc 3.3.2 invariants this reduction maintains:
+    (1) W_YTD == W_YTD_START + sum of district YTD deltas;
+    (2) D_NEXT_O_ID - 1 == max order id in the district."""
+    res = sess.execute(
+        "select w_id, w_ytd from warehouse order by w_id")
+    dres = sess.execute(
+        "select d_w_id, sum(d_ytd) as s from district group by d_w_id "
+        "order by d_w_id")
+    for w_ytd, dsum in zip(res["w_ytd"], dres["s"]):
+        lhs = round(float(w_ytd) * 100)
+        rhs = round(W_YTD_START + (float(dsum) * 100
+                                   - districts * 3000_00))
+        assert lhs == rhs, f"W_YTD {lhs} != 30000.00 + district deltas {rhs}"
+    for w in range(1, warehouses + 1):
+        for d in range(1, districts + 1):
+            drow = _district(sess, w, d)
+            res = sess.execute(
+                f"select max(o_pk) as m, count(*) as n from orders "
+                f"where o_w_id = {w} and o_d_id = {d}")
+            n = int(res["n"][0])
+            if n == 0:
+                assert drow["d_next_o_id"] == 1
+                continue
+            max_oid = int(res["m"][0]) - (w * 100 + d) * 1000000
+            assert drow["d_next_o_id"] - 1 == max_oid, (
+                f"district cursor {drow['d_next_o_id']} vs max order "
+                f"{max_oid}"
+            )
+
+
+def run_mix(sess: Session, txns: int = 40, warehouses: int = 1,
+            districts: int = 10, customers: int = 30,
+            seed: int = 0) -> dict:
+    """Drive the NewOrder/Payment mix (~45/43 of the spec mix, renormalized
+    to the two implemented transactions); returns tpmC-style throughput."""
+    rng = np.random.default_rng(seed)
+    new_orders = 0
+    retries = 0
+    t0 = time.time()
+    for i in range(txns):
+        w = int(rng.integers(1, warehouses + 1))
+        d = int(rng.integers(1, districts + 1))
+        c = int(rng.integers(1, customers + 1))
+        try:
+            if rng.random() < 0.51:  # 45/(45+43)
+                new_order(sess, w, d, c, ol_cnt=int(rng.integers(5, 16)),
+                          entry_day=20000 + i)
+                new_orders += 1
+            else:
+                payment(sess, w, d, c,
+                        amount_cents=int(rng.integers(100, 500000)))
+        except TransactionRetryError:
+            retries += 1
+    el = time.time() - t0
+    return {
+        "txns": txns,
+        "new_orders": new_orders,
+        "retries": retries,
+        "tpmC": new_orders / el * 60 if el > 0 else 0.0,
+        "elapsed_s": el,
+    }

@@ -1,0 +1,47 @@
+"""TPC-C (reduced) over the transactional KV layer: NewOrder/Payment as
+multi-statement transactions with the 3.3.2-style consistency invariants
+(reference: pkg/workload/tpcc + roachtest's tpcc check)."""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.bench import tpcc
+from cockroach_tpu.sql import Session
+
+
+@pytest.fixture
+def sess():
+    s = Session(val_width=256)
+    tpcc.load(s, warehouses=2, districts=4, customers=6)
+    return s
+
+
+def test_new_order_allocates_sequential_ids(sess):
+    ids = [tpcc.new_order(sess, 1, 2, 3, ol_cnt=5, entry_day=20000 + i)
+           for i in range(4)]
+    assert ids == [1, 2, 3, 4], "district cursor must allocate sequentially"
+    # another district's cursor is independent
+    assert tpcc.new_order(sess, 2, 1, 1, 5, 20010) == 1
+    tpcc.check_consistency(sess, warehouses=2, districts=4)
+
+
+def test_payment_maintains_w_ytd_invariant(sess):
+    for i in range(6):
+        tpcc.payment(sess, 1 + i % 2, 1 + i % 4, 1 + i % 6,
+                     amount_cents=1000 * (i + 1))
+    tpcc.check_consistency(sess, warehouses=2, districts=4)
+    # customer balances moved
+    res = sess.execute(
+        "select sum(c_ytd_payment) as s from customer")
+    assert float(res["s"][0]) > 2 * 4 * 6 * 10.0 - 1
+
+
+def test_mix_and_invariants(sess):
+    out = tpcc.run_mix(sess, txns=30, warehouses=2, districts=4,
+                       customers=6)
+    assert out["new_orders"] > 0 and out["txns"] == 30
+    tpcc.check_consistency(sess, warehouses=2, districts=4)
+    # order totals queryable through SQL
+    res = sess.execute(
+        "select count(*) as n, sum(o_total) as s from orders")
+    assert int(res["n"][0]) == out["new_orders"]
