@@ -27,6 +27,8 @@ def load(sess: Session, warehouses: int = 1, districts: int = 10,
          customers: int = 30) -> None:
     """CREATE + populate the reduced schema (ids flattened into single-int
     primary keys: district pk = w*100+d, customer pk = (w*100+d)*10000+c)."""
+    assert districts <= 99 and customers <= 9999, \
+        "pk packing bounds: districts <= 99, customers <= 9999"
     sess.execute("""
         create table warehouse (
             w_id int primary key, w_tax decimal(4, 4),
@@ -49,7 +51,6 @@ def load(sess: Session, warehouses: int = 1, districts: int = 10,
             o_pk int primary key, o_w_id int, o_d_id int, o_c_id int,
             o_ol_cnt int, o_entry_d int, o_total decimal(12, 2))
     """)
-    rng = np.random.default_rng(7)
     for w in range(1, warehouses + 1):
         sess.execute(
             f"insert into warehouse values ({w}, 0.1000, 30000.00)")
@@ -64,7 +65,6 @@ def load(sess: Session, warehouses: int = 1, districts: int = 10,
                 pk = (w * 100 + d) * 10000 + c
                 crows.append(f"({pk}, {w}, {d}, {c}, -10.00, 10.00, 1, 0)")
         sess.execute(f"insert into customer values {', '.join(crows)}")
-    del rng
 
 
 def _district(sess: Session, w: int, d: int) -> dict:
@@ -80,8 +80,9 @@ def new_order(sess: Session, w: int, d: int, c: int, ol_cnt: int,
     ot = sess.catalog.tables["orders"]
 
     def op(txn):
-        drow = dt.get_row(w * 100 + d)
+        drow = dt.get_row_txn(txn, w * 100 + d)
         o_id = drow["d_next_o_id"]
+        assert o_id < 1_000_000, "order id exceeds pk packing bound"
         drow["d_next_o_id"] = o_id + 1
         dt.insert(txn, drow)  # MVCC: new version of the district cursor
         total = sum(100 + ((o_id * 7 + i) % 900) for i in range(ol_cnt))
@@ -103,14 +104,14 @@ def payment(sess: Session, w: int, d: int, c: int, amount_cents: int):
     ct = sess.catalog.tables["customer"]
 
     def op(txn):
-        wrow = wt.get_row(w)
+        wrow = wt.get_row_txn(txn, w)
         wrow["w_ytd"] += amount_cents
         wt.insert(txn, wrow)
-        drow = dt.get_row(w * 100 + d)
+        drow = dt.get_row_txn(txn, w * 100 + d)
         drow["d_ytd"] += amount_cents
         dt.insert(txn, drow)
         cpk = (w * 100 + d) * 10000 + c
-        crow = ct.get_row(cpk)
+        crow = ct.get_row_txn(txn, cpk)
         crow["c_balance"] -= amount_cents
         crow["c_ytd_payment"] += amount_cents
         crow["c_payment_cnt"] += 1
@@ -134,17 +135,17 @@ def check_consistency(sess: Session, warehouses: int = 1,
         rhs = round(W_YTD_START + (float(dsum) * 100
                                    - districts * 3000_00))
         assert lhs == rhs, f"W_YTD {lhs} != 30000.00 + district deltas {rhs}"
+    per = sess.execute(
+        "select o_w_id, o_d_id, max(o_pk) as m, count(*) as n "
+        "from orders group by o_w_id, o_d_id")
+    seen = {
+        (int(wd), int(dd)): int(m) - (int(wd) * 100 + int(dd)) * 1000000
+        for wd, dd, m in zip(per["o_w_id"], per["o_d_id"], per["m"])
+    }
     for w in range(1, warehouses + 1):
         for d in range(1, districts + 1):
             drow = _district(sess, w, d)
-            res = sess.execute(
-                f"select max(o_pk) as m, count(*) as n from orders "
-                f"where o_w_id = {w} and o_d_id = {d}")
-            n = int(res["n"][0])
-            if n == 0:
-                assert drow["d_next_o_id"] == 1
-                continue
-            max_oid = int(res["m"][0]) - (w * 100 + d) * 1000000
+            max_oid = seen.get((w, d), 0)
             assert drow["d_next_o_id"] - 1 == max_oid, (
                 f"district cursor {drow['d_next_o_id']} vs max order "
                 f"{max_oid}"
@@ -156,9 +157,12 @@ def run_mix(sess: Session, txns: int = 40, warehouses: int = 1,
             seed: int = 0) -> dict:
     """Drive the NewOrder/Payment mix (~45/43 of the spec mix, renormalized
     to the two implemented transactions); returns tpmC-style throughput."""
+    from ..utils import metric
+
     rng = np.random.default_rng(seed)
     new_orders = 0
-    retries = 0
+    give_ups = 0
+    retries0 = metric.TXN_RETRIES.value
     t0 = time.time()
     for i in range(txns):
         w = int(rng.integers(1, warehouses + 1))
@@ -173,12 +177,13 @@ def run_mix(sess: Session, txns: int = 40, warehouses: int = 1,
                 payment(sess, w, d, c,
                         amount_cents=int(rng.integers(100, 500000)))
         except TransactionRetryError:
-            retries += 1
+            give_ups += 1  # DB.txn exhausted ITS retries and dropped the txn
     el = time.time() - t0
     return {
         "txns": txns,
         "new_orders": new_orders,
-        "retries": retries,
+        "retries": int(metric.TXN_RETRIES.value - retries0),
+        "give_ups": give_ups,
         "tpmC": new_orders / el * 60 if el > 0 else 0.0,
         "elapsed_s": el,
     }

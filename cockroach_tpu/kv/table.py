@@ -205,6 +205,23 @@ class KVTable:
     def delete_pk(self, t: Txn, pk: int) -> None:
         t.delete(rowcodec.encode_pk(self.table_id, int(pk)))
 
+    def get_row_txn(self, t: Txn, pk: int) -> dict | None:
+        """Transactional row read: goes through Txn.get so the read lands in
+        the txn's read spans (commit-time refresh validation), observes the
+        txn's snapshot, and converts intent conflicts to retryable errors —
+        the difference between a real multi-statement transaction and a
+        dirty read (kv.Txn.Get semantics)."""
+        v = t.get(rowcodec.encode_pk(self.table_id, int(pk)))
+        if v is None:
+            return None
+        row = rowcodec.decode_row(self.schema, v)
+        for i in self._string_cols:
+            name = self.schema.names[i]
+            code = row.get(name)
+            if code is not None:
+                row[name] = self._dicts[i].values[int(code)]
+        return row
+
     def get_row(self, pk: int, ts: int | None = None) -> dict | None:
         v = self.db.get(rowcodec.encode_pk(self.table_id, int(pk)), ts=ts)
         if v is None:

@@ -30,10 +30,11 @@ def test_payment_maintains_w_ytd_invariant(sess):
         tpcc.payment(sess, 1 + i % 2, 1 + i % 4, 1 + i % 6,
                      amount_cents=1000 * (i + 1))
     tpcc.check_consistency(sess, warehouses=2, districts=4)
-    # customer balances moved
+    # the customer leg: initial sum (48 x 10.00) + injected payments
+    # (1000..6000 cents = 210.00 dollars), exactly
     res = sess.execute(
         "select sum(c_ytd_payment) as s from customer")
-    assert float(res["s"][0]) > 2 * 4 * 6 * 10.0 - 1
+    assert abs(float(res["s"][0]) - (2 * 4 * 6 * 10.0 + 210.0)) < 1e-6
 
 
 def test_mix_and_invariants(sess):
