@@ -146,3 +146,108 @@ def test_scalar_builtins_sql():
     assert list(got["n"]) == [len(s) for s in df.l_shipmode]
     assert list(got["u"]) == [s.upper() for s in df.l_shipmode]
     assert list(got["lo"]) == [s.lower() for s in df.l_shipmode]
+
+
+def test_random_expression_fuzz():
+    """sqlsmith-lite: random arithmetic/comparison/boolean/CASE expressions
+    over lineitem evaluated by the engine vs a numpy oracle interpreter —
+    the vectorized-vs-row cross-check pattern of
+    distsql/columnar_operators_test.go, aimed at expression lowering."""
+    import numpy as np
+
+    from cockroach_tpu.bench import tpch
+    from cockroach_tpu.coldata.types import FLOAT64, Family
+    from cockroach_tpu.flow.runtime import run_operator
+    from cockroach_tpu.ops import expr as ex
+    from cockroach_tpu.plan import builder as plan_builder
+    from cockroach_tpu.sql.rel import Rel
+
+    cat = tpch.gen_tpch(sf=0.002, seed=21)
+    base = Rel.scan(cat, "lineitem", (
+        "l_quantity", "l_extendedprice", "l_discount", "l_tax",
+        "l_linenumber",
+    ))
+    df = tpch.to_pandas(cat, "lineitem")
+    cols = {
+        0: df.l_quantity.to_numpy(dtype=np.float64),
+        1: df.l_extendedprice.to_numpy(dtype=np.float64),
+        2: df.l_discount.to_numpy(dtype=np.float64),
+        3: df.l_tax.to_numpy(dtype=np.float64),
+        4: df.l_linenumber.to_numpy(dtype=np.float64),
+    }
+    rng = np.random.default_rng(99)
+
+    def gen_num(depth):
+        r = rng.random()
+        if depth >= 3 or r < 0.35:
+            if rng.random() < 0.5:
+                return ("col", int(rng.integers(0, 5)))
+            return ("lit", float(np.round(rng.uniform(-5, 5), 2)))
+        if r < 0.8:
+            op = rng.choice(["+", "-", "*"])
+            return ("bin", str(op), gen_num(depth + 1), gen_num(depth + 1))
+        if r < 0.9:
+            return ("func", str(rng.choice(["abs", "floor", "ceil"])),
+                    gen_num(depth + 1))
+        return ("case", gen_bool(depth + 1), gen_num(depth + 1),
+                gen_num(depth + 1))
+
+    def gen_bool(depth):
+        if depth >= 3 or rng.random() < 0.6:
+            op = rng.choice(["lt", "le", "gt", "ge", "eq", "ne"])
+            return ("cmp", str(op), gen_num(depth + 1), gen_num(depth + 1))
+        op = rng.choice(["and", "or"])
+        return ("bool", str(op), gen_bool(depth + 1), gen_bool(depth + 1))
+
+    def to_engine(t):
+        k = t[0]
+        if k == "col":
+            # engine sees typed columns (decimal etc.); cast to float so
+            # engine and oracle share one numeric domain
+            return ex.Cast(ex.ColRef(t[1]), FLOAT64)
+        if k == "lit":
+            return ex.Const(t[1], FLOAT64)
+        if k == "bin":
+            return ex.BinOp(t[1], to_engine(t[2]), to_engine(t[3]))
+        if k == "func":
+            return ex.Func1(t[1], to_engine(t[2]))
+        if k == "case":
+            return ex.Case(((to_engine(t[1]), to_engine(t[2])),),
+                           to_engine(t[3]))
+        if k == "cmp":
+            return ex.Cmp(t[1], to_engine(t[2]), to_engine(t[3]))
+        if k == "bool":
+            return ex.BoolOp(t[1], (to_engine(t[2]), to_engine(t[3])))
+        raise AssertionError(k)
+
+    def oracle(t):
+        k = t[0]
+        if k == "col":
+            return cols[t[1]]
+        if k == "lit":
+            return np.full(len(cols[0]), t[1])
+        if k == "bin":
+            a, b = oracle(t[2]), oracle(t[3])
+            return {"+": a + b, "-": a - b, "*": a * b}[t[1]]
+        if k == "func":
+            f = {"abs": np.abs, "floor": np.floor, "ceil": np.ceil}[t[1]]
+            return f(oracle(t[2]))
+        if k == "case":
+            return np.where(oracle(t[1]), oracle(t[2]), oracle(t[3]))
+        if k == "cmp":
+            a, b = oracle(t[2]), oracle(t[3])
+            return {"lt": a < b, "le": a <= b, "gt": a > b,
+                    "ge": a >= b, "eq": a == b, "ne": a != b}[t[1]]
+        if k == "bool":
+            a, b = oracle(t[2]), oracle(t[3])
+            return a & b if t[1] == "and" else a | b
+        raise AssertionError(k)
+
+    for trial in range(25):
+        tree = gen_num(0)
+        rel = base.project([("out", to_engine(tree))])
+        got = run_operator(plan_builder.build(rel.plan, cat))["out"]
+        want = oracle(tree)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float64), want, rtol=1e-9, atol=1e-9,
+            err_msg=f"trial {trial}: {tree}")
