@@ -342,6 +342,13 @@ def main() -> None:
 
     jax, platform = _init_backend()
     _partial["platform"] = platform
+    if platform == "cpu" and "TPCH_SF" not in os.environ:
+        # TPU unreachable: record a complete CPU ladder at a scale the
+        # deadline can hold rather than a partial one at SF1
+        sf = 0.2
+        _partial["sf"] = sf
+        print(f"# cpu fallback: dropping to sf={sf}", file=sys.stderr,
+              flush=True)
 
     from cockroach_tpu.utils.backend import enable_compile_cache
 
