@@ -141,3 +141,47 @@ def register_builtin_jobs(registry: Registry) -> None:
         return {"path": path}
 
     registry.register("backup", backup_resume)
+
+
+def register_import_job(registry: Registry, catalog) -> None:
+    """IMPORT INTO <table> CSV DATA (file) as a job: parse the CSV on the
+    host, bulk-load through the AddSSTable path (KVTable.bulk_load), record
+    row counts in progress — the pkg/sql/importer reduction."""
+    import csv as _csv
+
+    import numpy as np
+
+    from ..coldata.types import Family
+
+    def import_resume(reg: Registry, job: Job):
+        table = catalog.tables[job.payload["table"]]
+        path = job.payload["path"]
+        with open(path, newline="") as f:
+            rows = list(_csv.DictReader(f))
+        cols: dict[str, np.ndarray] = {}
+        valids: dict[str, np.ndarray] = {}
+        for name, t in zip(table.schema.names, table.schema.types):
+            raw = [r.get(name, "") for r in rows]
+            missing = np.array([x == "" for x in raw])
+            if t.family is Family.STRING:
+                cols[name] = np.array(
+                    [x if x else "" for x in raw], dtype=object)
+            elif t.family is Family.FLOAT:
+                cols[name] = np.array(
+                    [float(x) if x else 0.0 for x in raw])
+            elif t.family is Family.DECIMAL:
+                cols[name] = np.array([
+                    int(round(float(x) * 10**t.scale)) if x else 0
+                    for x in raw], dtype=np.int64)
+            elif t.family is Family.BOOL:
+                cols[name] = np.array(
+                    [x.lower() == "true" for x in raw])
+            else:
+                cols[name] = np.array(
+                    [int(x) if x else 0 for x in raw], dtype=np.int64)
+            if missing.any():
+                valids[name] = ~missing
+        n = table.bulk_load(cols, valids)
+        return {"rows": n}
+
+    registry.register("import", import_resume)

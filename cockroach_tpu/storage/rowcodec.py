@@ -108,6 +108,53 @@ def decode_row(schema: Schema, value: bytes) -> dict:
     return out
 
 
+def encode_pk_batch(table_id: int, pks: np.ndarray) -> np.ndarray:
+    """Vectorized encode_pk: [N] int64 -> [N, KEY_BYTES] uint8 (the bulk
+    write path's key encoder — one numpy pass, no per-row host loop)."""
+    assert 0 <= table_id <= MAX_TABLE_ID
+    u = (pks.astype(np.int64).astype(np.uint64)
+         ^ np.uint64(1 << 63))
+    n = len(pks)
+    out = np.empty((n, KEY_BYTES), dtype=np.uint8)
+    out[:, 0] = 0x01 + table_id
+    for i in range(PK_BYTES):
+        shift = np.uint64(7 * (PK_BYTES - 1 - i))
+        out[:, 1 + i] = ((u >> shift) & np.uint64(0x7F)).astype(
+            np.uint8) + 0x01
+    return out
+
+
+def encode_rows(schema: Schema, columns: dict[str, np.ndarray],
+                valids: dict[str, np.ndarray] | None = None) -> np.ndarray:
+    """Vectorized encode_row: typed host columns -> [N, value_width] uint8
+    payloads (the colenc analog: the write path's columnar encoder; the
+    per-row encode_row remains for single-row DML)."""
+    valids = valids or {}
+    ncols = len(schema)
+    nullbytes = (ncols + 7) // 8
+    n = len(next(iter(columns.values())))
+    out = np.zeros((n, nullbytes + 8 * ncols), dtype=np.uint8)
+    for i, (name, t) in enumerate(zip(schema.names, schema.types)):
+        a = np.asarray(columns[name])
+        v = valids.get(name)
+        if t.family is Family.FLOAT:
+            bits = a.astype(np.float64).view(np.uint64)
+        elif t.family is Family.BOOL:
+            bits = a.astype(np.uint64)
+        else:
+            bits = a.astype(np.int64).view(np.uint64)
+        lanes = bits.astype("<u8").view(np.uint8).reshape(n, 8)
+        off = nullbytes + 8 * i
+        if v is None:
+            out[:, i // 8] |= np.uint8(1 << (i % 8))
+            out[:, off:off + 8] = lanes
+        else:
+            vb = np.asarray(v, dtype=bool)
+            out[vb, i // 8] |= np.uint8(1 << (i % 8))
+            out[vb, off:off + 8] = lanes[vb]
+    return out
+
+
 # -- device-side columnar decode (read path: the cFetcher kernel) -----------
 
 
