@@ -395,20 +395,46 @@ def _to_float(d, t: SQLType):
     return d.astype(jnp.float64)
 
 
+def _div_half_away(d, s: int):
+    """Scaled-int division rounding half away from zero (SQL numeric
+    rounding on precision reduction)."""
+    pos = (d + s // 2) // s
+    neg = -((-d + s // 2) // s)
+    return jnp.where(d >= 0, pos, neg)
+
+
+def _div_trunc(d, s: int):
+    """Scaled-int division truncating toward zero (SQL cast to INT)."""
+    return jnp.where(d >= 0, d // s, -((-d) // s))
+
+
 def _cast(d, ft: SQLType, to: SQLType):
     if to.family is Family.FLOAT:
         return _to_float(d, ft)
     if to.family is Family.DECIMAL:
         if ft.family is Family.DECIMAL:
             diff = to.scale - ft.scale
-            return d * (10**diff) if diff >= 0 else d // (10**-diff)
+            if diff >= 0:
+                return d * (10**diff)
+            return _div_half_away(d, 10**-diff)  # scale cut ROUNDS
         if ft.family is Family.FLOAT:
             return jnp.round(d * 10.0**to.scale).astype(jnp.int64)
         return d.astype(jnp.int64) * (10**to.scale)
     if to.family is Family.INT:
         if ft.family is Family.DECIMAL:
-            return (d // (10**ft.scale)).astype(to.dtype)
+            # SQL casts numeric -> int by ROUNDING (Postgres semantics)
+            return _div_half_away(d, 10**ft.scale).astype(to.dtype)
+        if ft.family is Family.FLOAT:
+            return jnp.round(d).astype(to.dtype)
         return d.astype(to.dtype)
+    if to.family is Family.TIMESTAMP and ft.family is Family.DATE:
+        return d.astype(jnp.int64) * (86400 * 1000000)
+    if to.family is Family.DATE and ft.family is Family.TIMESTAMP:
+        return (d // (86400 * 1000000)).astype(jnp.int32)
+    if to.family is Family.BOOL:
+        if ft.family is Family.DECIMAL:
+            return d != 0
+        return d.astype(jnp.bool_)
     return d.astype(to.dtype)
 
 

@@ -319,11 +319,18 @@ class ExprLowerer:
                 otherwise = self.lower(e.otherwise)
             return ex.Case(whens, otherwise)
         if isinstance(e, P.Cast):
+            from ..coldata.types import BOOL as _BOOL
+            from ..coldata.types import DATE as _DATE
+            from ..coldata.types import TIMESTAMP as _TS
+
             to = {
                 "int": INT64, "integer": INT64, "bigint": INT64,
+                "smallint": SQLType(Family.INT, width=16),
                 "float": FLOAT64, "double": FLOAT64, "real": FLOAT64,
                 "decimal": SQLType(Family.DECIMAL, precision=38, scale=2),
                 "numeric": SQLType(Family.DECIMAL, precision=38, scale=2),
+                "bool": _BOOL, "boolean": _BOOL,
+                "date": _DATE, "timestamp": _TS,
             }.get(e.to)
             if to is None:
                 raise BindError(f"unsupported cast target {e.to}")

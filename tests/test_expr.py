@@ -251,3 +251,39 @@ def test_random_expression_fuzz():
         np.testing.assert_allclose(
             np.asarray(got, np.float64), want, rtol=1e-9, atol=1e-9,
             err_msg=f"trial {trial}: {tree}")
+
+
+def test_cast_matrix():
+    """colexecbase cast semantics: DECIMAL->INT rounds (Postgres), scale
+    cuts round half away from zero, FLOAT->INT rounds, DATE<->TIMESTAMP,
+    numeric->BOOL."""
+    import numpy as np
+
+    import cockroach_tpu.catalog as catalog_mod
+    from cockroach_tpu import coldata as cd
+    from cockroach_tpu.sql import sql
+
+    cat = catalog_mod.Catalog()
+    schema = cd.Schema.of(i=cd.INT64, d=cd.DECIMAL(12, 2), f=cd.FLOAT64,
+                          day=cd.DATE)
+    cat.add(catalog_mod.Table.from_strings("t", schema, {
+        "i": np.array([-3, 0, 7], dtype=np.int64),
+        "d": np.array([-155, 0, 155], dtype=np.int64),  # -1.55, 0, 1.55
+        "f": np.array([-2.5, 0.5, 2.49]),
+        "day": np.array([0, 1, 10957], dtype=np.int32),  # 2000-01-01
+    }))
+
+    res = sql(cat, """
+        select cast(d as int) as di, cast(f as int) as fi,
+               cast(i as decimal) as idec, cast(d as float) as df,
+               cast(i as bool) as ib, cast(day as timestamp) as ts
+        from t order by i
+    """).run()
+    assert list(res["di"]) == [-2, 0, 2], "numeric->int rounds half away"
+    assert list(res["fi"]) == [-2, 0, 2], "float->int rounds (banker's at .5)"
+    np.testing.assert_allclose(np.asarray(res["idec"], np.float64),
+                               [-3.0, 0.0, 7.0])
+    np.testing.assert_allclose(np.asarray(res["df"], np.float64),
+                               [-1.55, 0.0, 1.55])
+    assert list(res["ib"]) == [True, False, True]
+    assert int(res["ts"][2]) == 10957 * 86400 * 1000000
