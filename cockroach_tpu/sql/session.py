@@ -79,6 +79,9 @@ class Session:
     # -- dispatch ------------------------------------------------------------
 
     def execute(self, text: str):
+        handled = self._maybe_settings_stmt(text)
+        if handled is not None:
+            return handled
         stmt = P.parse_statement(text)
         if isinstance(stmt, P.Select):
             return Binder(self.catalog).bind(stmt).run()
@@ -91,6 +94,56 @@ class Session:
         if isinstance(stmt, P.Delete):
             return self._delete(stmt)
         raise BindError(f"unsupported statement {type(stmt).__name__}")
+
+    @staticmethod
+    def _maybe_settings_stmt(text: str):
+        """SET CLUSTER SETTING name = value / SHOW CLUSTER SETTING[S] — the
+        pkg/settings SQL surface (registry.go; settings are SQL-updatable
+        in the reference and gossiped; process-local here)."""
+        import re as _re
+
+        from ..utils import settings as _settings
+
+        t = text.strip().rstrip(";")
+        m = _re.match(
+            r"(?is)^set\s+cluster\s+setting\s+([a-z0-9_.]+)\s*=\s*(.+)$", t)
+        if m:
+            name, raw = m.group(1), m.group(2).strip()
+            reg = _settings.all_settings()
+            if name not in reg:
+                raise BindError(f"unknown cluster setting {name!r}")
+            kind = reg[name].kind
+            if kind == "bool":
+                val = raw.lower() in ("true", "on", "1")
+            elif kind == "int":
+                val = int(raw)
+            elif kind == "float":
+                val = float(raw)
+            else:
+                val = raw.strip("'")
+            _settings.set(name, val)
+            return {"set": name}
+        m = _re.match(r"(?is)^show\s+cluster\s+setting\s+([a-z0-9_.]+)$", t)
+        if m:
+            name = m.group(1)
+            reg = _settings.all_settings()
+            if name not in reg:
+                raise BindError(f"unknown cluster setting {name!r}")
+            import numpy as _np
+
+            return {"variable": _np.array([name], dtype=object),
+                    "value": _np.array([str(reg[name].get())], dtype=object)}
+        if _re.match(r"(?is)^show\s+cluster\s+settings$", t):
+            import numpy as _np
+
+            reg = _settings.all_settings()
+            names = sorted(reg)
+            return {
+                "variable": _np.array(names, dtype=object),
+                "value": _np.array([str(reg[n].get()) for n in names],
+                                   dtype=object),
+            }
+        return None
 
     # -- DDL -----------------------------------------------------------------
 

@@ -200,3 +200,21 @@ def test_string_dictionary_rolls_back_with_txn(sess):
                        t.dict_table_id)
     assert reopened._dicts[1].values == ["kept", "doomed"]
     assert reopened.get_row(3)["tag"] == "doomed"
+
+
+def test_cluster_settings_sql_surface(sess):
+    """SET/SHOW CLUSTER SETTING (pkg/settings SQL surface)."""
+    from cockroach_tpu.utils import settings
+
+    try:
+        r = sess.execute("set cluster setting sql.distsql.tile_size = 8192")
+        assert r == {"set": "sql.distsql.tile_size"}
+        assert settings.get("sql.distsql.tile_size") == 8192
+        r = sess.execute("show cluster setting sql.distsql.tile_size")
+        assert list(r["value"]) == ["8192"]
+        r = sess.execute("show cluster settings")
+        assert "sql.distsql.workmem_bytes" in list(r["variable"])
+        with pytest.raises(BindError):
+            sess.execute("set cluster setting nope.nope = 1")
+    finally:
+        settings.reset("sql.distsql.tile_size")
