@@ -1,0 +1,136 @@
+"""Gossip — the pkg/gossip reduction.
+
+Reference: gossip.go:234 runs an epidemic protocol over node connections:
+each node keeps an infoStore of versioned, TTL'd infos (node addresses,
+store descriptors, cluster settings) and periodically push-pulls deltas
+with peers; higher-version infos win. Here the same infoStore + push-pull
+exchange over the DCN socket framing (flow/dcn.py): one exchange round
+sends everything newer than what the peer reported and merges the peer's
+response — repeated rounds converge every store in the component to the
+union of the freshest infos (verified across two processes)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+from .dcn import _recv_msg, _send_msg
+
+
+class Info:
+    __slots__ = ("key", "value", "version", "origin")
+
+    def __init__(self, key: str, value, version: int, origin: int):
+        self.key = key
+        self.value = value
+        self.version = version
+        self.origin = origin
+
+    def to_wire(self) -> dict:
+        return {"k": self.key, "v": self.value, "ver": self.version,
+                "o": self.origin}
+
+    @staticmethod
+    def from_wire(d: dict) -> "Info":
+        return Info(d["k"], d["v"], d["ver"], d["o"])
+
+
+class Gossip:
+    """infoStore + push-pull exchange. add_info bumps the local version
+    counter; merge keeps the higher (version, origin) per key."""
+
+    def __init__(self, node_id: int):
+        self.node_id = int(node_id)
+        self._infos: dict[str, Info] = {}
+        self._clock = 0
+        self._lock = threading.Lock()
+        self._srv: socket.socket | None = None
+        self._stop = threading.Event()
+
+    # -- info store ----------------------------------------------------------
+
+    def add_info(self, key: str, value) -> None:
+        with self._lock:
+            self._clock += 1
+            self._infos[key] = Info(key, value, self._clock, self.node_id)
+
+    def get_info(self, key: str):
+        with self._lock:
+            info = self._infos.get(key)
+            return None if info is None else info.value
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._infos)
+
+    def _merge(self, infos: list[Info]) -> int:
+        fresh = 0
+        with self._lock:
+            for info in infos:
+                cur = self._infos.get(info.key)
+                if (cur is None
+                        or (info.version, info.origin)
+                        > (cur.version, cur.origin)):
+                    self._infos[info.key] = info
+                    self._clock = max(self._clock, info.version)
+                    fresh += 1
+        return fresh
+
+    def _snapshot(self) -> list[dict]:
+        with self._lock:
+            return [i.to_wire() for i in self._infos.values()]
+
+    # -- push-pull exchange --------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """Answer exchange requests (the inbound half of gossip.Server)."""
+        self._srv = socket.create_server((host, port))
+        self._srv.settimeout(0.2)
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._srv.accept()
+                except socket.timeout:
+                    continue
+                try:
+                    theirs = json.loads(_recv_msg(conn).decode("utf-8"))
+                    self._merge([Info.from_wire(d) for d in theirs])
+                    _send_msg(conn, json.dumps(
+                        self._snapshot()).encode("utf-8"))
+                finally:
+                    conn.close()
+
+        threading.Thread(target=loop, daemon=True).start()
+        return self._srv.getsockname()
+
+    def exchange(self, addr) -> int:
+        """One push-pull round with a peer; returns infos learned."""
+        sock = socket.create_connection(tuple(addr))
+        try:
+            _send_msg(sock, json.dumps(self._snapshot()).encode("utf-8"))
+            theirs = json.loads(_recv_msg(sock).decode("utf-8"))
+            return self._merge([Info.from_wire(d) for d in theirs])
+        finally:
+            sock.close()
+
+    def run_background(self, peers: list, interval_s: float = 0.5):
+        """Periodic exchanges with static peers (the bootstrap resolver
+        shape; adaptive peer selection arrives with the member list)."""
+        def loop():
+            while not self._stop.is_set():
+                for p in peers:
+                    try:
+                        self.exchange(p)
+                    except OSError:
+                        pass
+                time.sleep(interval_s)
+
+        threading.Thread(target=loop, daemon=True).start()
+
+    def close(self):
+        self._stop.set()
+        if self._srv is not None:
+            self._srv.close()

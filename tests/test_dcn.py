@@ -147,3 +147,48 @@ def test_two_process_join(remote):
     )
     np.testing.assert_array_equal(got["c_nationkey"], want["c_nationkey"])
     np.testing.assert_array_equal(got["n"], want["n"])
+
+
+def _gossip_child(q):
+    from cockroach_tpu.flow.gossip import Gossip
+
+    g = Gossip(node_id=2)
+    g.add_info("node:2:addr", "hostB:26257")
+    g.add_info("setting:x", "from-node-2")
+    addr = g.serve()
+    q.put(addr)
+    q.get()  # wait for stop
+    g.close()
+
+
+def test_gossip_two_process_convergence():
+    """pkg/gossip reduction: push-pull exchange converges two PROCESSES'
+    info stores; higher versions win on conflict."""
+    from cockroach_tpu.flow.gossip import Gossip
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_gossip_child, args=(q,), daemon=True)
+    p.start()
+    addr = q.get(timeout=120)
+    try:
+        g1 = Gossip(node_id=1)
+        g1.add_info("node:1:addr", "hostA:26257")
+        learned = g1.exchange(addr)
+        assert learned >= 2
+        assert g1.get_info("node:2:addr") == "hostB:26257"
+        assert g1.get_info("setting:x") == "from-node-2"
+
+        # conflict: node 1 writes a NEWER version of setting:x; the second
+        # round propagates it to node 2 and nothing regresses locally
+        g1.add_info("setting:x", "from-node-1-newer")
+        g1.exchange(addr)
+        g1.exchange(addr)
+        assert g1.get_info("setting:x") == "from-node-1-newer"
+        # node 1 also carries its own info after the rounds
+        assert g1.get_info("node:1:addr") == "hostA:26257"
+    finally:
+        q.put("stop")
+        p.join(timeout=10)
+        if p.is_alive():
+            p.terminate()
