@@ -580,19 +580,27 @@ class DistributedQuery:
             if spec not in self._scan_cache:
                 tname, names, local_cap = spec
                 t = self.catalog.get(tname)
-                if not hasattr(t, "columns"):
-                    raise TypeError(
-                        f"table {tname!r} is KV-engine-backed; distributed "
-                        "scans read host-resident tables only (partitioned "
-                        "engine scans arrive with the range/leaseholder "
-                        "placement model)"
-                    )
-                sub = t.schema.select(
-                    tuple(t.schema.index(n) for n in names))
-                arrays = {n: np.asarray(t.columns[n]) for n in names}
-                valids = {n: t.valids[n] for n in names if n in t.valids}
-                gb = from_host(sub, arrays, valids=valids,
-                               capacity=local_cap * self.D)
+                if hasattr(t, "columns"):
+                    sub = t.schema.select(
+                        tuple(t.schema.index(n) for n in names))
+                    arrays = {n: np.asarray(t.columns[n]) for n in names}
+                    valids = {n: t.valids[n]
+                              for n in names if n in t.valids}
+                    gb = from_host(sub, arrays, valids=valids,
+                                   capacity=local_cap * self.D)
+                else:
+                    # KV-engine-backed table: snapshot the newest-visible
+                    # rows through the direct columnar scan, then row-shard
+                    # the snapshot like any other input (the
+                    # range/leaseholder placement model would instead read
+                    # per-device spans; one-snapshot-then-shard keeps the
+                    # same SPMD program shape meanwhile)
+                    from ..coldata.batch import compact
+
+                    gb = t.device_batch(tuple(names))
+                    # local_cap was planned from num_rows (live count), so
+                    # every live row fits the sharded capacity
+                    gb = compact(gb, capacity=local_cap * self.D)
                 self._scan_cache[spec] = shard_batch(gb, self.mesh)
             self._scan_batches.append(self._scan_cache[spec])
 
