@@ -1,0 +1,106 @@
+"""Admission control — the pkg/util/admission reduction.
+
+Reference: GrantCoordinator (grant_coordinator.go:297) grants slots/tokens
+to a priority-ordered WorkQueue (work_queue.go:280); IO tokens refill from
+Pebble L0 health (io_load_listener.go) so writers slow down before the LSM
+inverts. Here the same two pieces at single-process scale:
+
+- ``WorkQueue``: bounded concurrency slots granted strictly by (priority,
+  arrival) order; released slots wake the highest-priority waiter.
+- ``IOGovernor``: watches the engine's L0 run count and computes a token
+  delay for write work once the LSM falls behind compaction (the
+  io_load_listener shape: back-pressure proportional to overload).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+
+# work priorities (admissionpb ordering)
+LOW = 0
+NORMAL = 10
+HIGH = 20
+
+
+class WorkQueue:
+    """Priority-ordered admission with bounded slots (WorkQueue +
+    slot-based GrantCoordinator)."""
+
+    def __init__(self, slots: int = 4):
+        self._slots = slots
+        self._used = 0
+        self._lock = threading.Lock()
+        self._waiters: list = []  # heap of (-priority, seq, event)
+        self._seq = itertools.count()
+        self.admitted = 0
+        self.waited = 0
+
+    def admit(self, priority: int = NORMAL, timeout: float | None = None
+              ) -> bool:
+        """Block until a slot is granted (higher priority first)."""
+        with self._lock:
+            if self._used < self._slots and not self._waiters:
+                self._used += 1
+                self.admitted += 1
+                return True
+            ev = threading.Event()
+            heapq.heappush(self._waiters,
+                           (-priority, next(self._seq), ev))
+            self.waited += 1
+        if not ev.wait(timeout):
+            with self._lock:
+                # withdraw if still queued (timeout)
+                for i, (_, _, w) in enumerate(self._waiters):
+                    if w is ev:
+                        self._waiters.pop(i)
+                        heapq.heapify(self._waiters)
+                        return False
+            # granted between timeout and lock: keep the slot
+            return True
+        with self._lock:
+            self.admitted += 1
+        return True
+
+    def release(self) -> None:
+        with self._lock:
+            if self._waiters:
+                _, _, ev = heapq.heappop(self._waiters)
+                ev.set()  # hand the slot directly to the waiter
+            else:
+                self._used = max(0, self._used - 1)
+
+    def __enter__(self):
+        self.admit()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class IOGovernor:
+    """L0-health write back-pressure (io_load_listener reduction): when the
+    engine's run count exceeds the healthy threshold, write work pays a
+    delay proportional to the overload before proceeding."""
+
+    def __init__(self, engine, healthy_runs: int | None = None,
+                 delay_per_run_s: float = 0.001):
+        self.engine = engine
+        self.healthy_runs = (healthy_runs if healthy_runs is not None
+                             else engine.l0_trigger)
+        self.delay_per_run_s = delay_per_run_s
+        self.throttled = 0
+
+    def write_delay_s(self) -> float:
+        over = len(self.engine.runs) - self.healthy_runs
+        return max(0, over) * self.delay_per_run_s
+
+    def pace_write(self) -> float:
+        d = self.write_delay_s()
+        if d > 0:
+            self.throttled += 1
+            time.sleep(d)
+        return d

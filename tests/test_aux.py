@@ -339,3 +339,59 @@ def test_structured_logging(tmp_path):
     assert [x["msg"] for x in lines] == ["kept", "auth"]
     assert lines[0]["ch"] == "STORAGE" and lines[0]["runs"] == 3
     assert lines[1]["user"] == "<redacted>"
+
+
+def test_admission_work_queue_priorities():
+    """util/admission reduction: slots grant strictly by priority order;
+    releases hand slots to the highest-priority waiter."""
+    import threading
+
+    from cockroach_tpu.utils import admission
+
+    q = admission.WorkQueue(slots=1)
+    assert q.admit(admission.NORMAL)
+    order = []
+    done = []
+
+    def worker(prio, tag):
+        q.admit(prio)
+        order.append(tag)
+        q.release()
+        done.append(tag)
+
+    threads = [
+        threading.Thread(target=worker, args=(admission.LOW, "low")),
+        threading.Thread(target=worker, args=(admission.HIGH, "high")),
+        threading.Thread(target=worker, args=(admission.NORMAL, "normal")),
+    ]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.2)  # all three queued behind the held slot
+    q.release()
+    for t in threads:
+        t.join(timeout=5)
+    assert order == ["high", "normal", "low"], order
+    assert q.waited == 3
+
+
+def test_admission_io_governor():
+    """Write pacing follows L0 run count (io_load_listener shape)."""
+    from cockroach_tpu.storage.lsm import Engine
+    from cockroach_tpu.utils import admission
+
+    eng = Engine(key_width=16, val_width=16, memtable_size=16,
+                 l0_trigger=64)  # don't auto-compact during the test
+    gov = admission.IOGovernor(eng, healthy_runs=2,
+                               delay_per_run_s=0.0001)
+    assert gov.write_delay_s() == 0
+    for i in range(16 * 4):
+        eng.put(b"k%04d" % i, b"v", ts=i + 1)
+    eng.flush_mem_only()
+    assert len(eng.runs) >= 3
+    assert gov.write_delay_s() > 0
+    gov.pace_write()
+    assert gov.throttled == 1
+    eng.compact(bottom=True)
+    assert gov.write_delay_s() == 0
