@@ -59,6 +59,7 @@ class WorkQueue:
                         heapq.heapify(self._waiters)
                         return False
             # granted between timeout and lock: keep the slot
+            self.admitted += 1
             return True
         with self._lock:
             self.admitted += 1
@@ -89,8 +90,12 @@ class IOGovernor:
     def __init__(self, engine, healthy_runs: int | None = None,
                  delay_per_run_s: float = 0.001):
         self.engine = engine
+        # default BELOW the compaction trigger: the engine compacts once
+        # runs exceed l0_trigger, so pacing must engage while the LSM is
+        # catching up, not only after (io_load_listener's point is to slow
+        # writers BEFORE the inversion)
         self.healthy_runs = (healthy_runs if healthy_runs is not None
-                             else engine.l0_trigger)
+                             else max(1, engine.l0_trigger // 2))
         self.delay_per_run_s = delay_per_run_s
         self.throttled = 0
 

@@ -368,12 +368,15 @@ def test_admission_work_queue_priorities():
         t.start()
     import time
 
-    time.sleep(0.2)  # all three queued behind the held slot
+    deadline = time.time() + 10
+    while q.waited < 3 and time.time() < deadline:
+        time.sleep(0.01)  # deterministic: wait until all three queued
+    assert q.waited == 3
     q.release()
     for t in threads:
         t.join(timeout=5)
     assert order == ["high", "normal", "low"], order
-    assert q.waited == 3
+    assert sorted(done) == ["high", "low", "normal"]
 
 
 def test_admission_io_governor():
