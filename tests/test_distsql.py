@@ -243,3 +243,27 @@ def test_kv_backed_table_distributes(mesh):
     want = rel.run()
     got = rel.run_distributed(mesh)
     _assert_same(got, want)
+
+
+def test_distributed_statistical_aggregates(cat, mesh):
+    """var/stddev ride the partial (sum, sum_sq, count) staging across the
+    Exchange: distributed == single-device to fp tolerance."""
+    from cockroach_tpu.sql import sql
+
+    rel = sql(cat, """
+        select l_returnflag, stddev(l_quantity) as s,
+               var_pop(l_extendedprice) as vp
+        from lineitem group by l_returnflag order by l_returnflag
+    """)
+    want = rel.run()
+    got = rel.run_distributed(mesh)
+    assert list(got["l_returnflag"]) == list(want["l_returnflag"])
+    # fp note: shard-order float summation + the sumsq - n*mean^2
+    # cancellation bound the distributed/local agreement near 1e-7 relative
+    # (the reference's float aggregates carry the same non-determinism
+    # across plan placements)
+    np.testing.assert_allclose(np.asarray(got["s"], np.float64),
+                               np.asarray(want["s"], np.float64), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["vp"], np.float64),
+                               np.asarray(want["vp"], np.float64),
+                               rtol=1e-6)
