@@ -372,7 +372,8 @@ class Engine:
         return blk
 
     def ingest(self, keys: np.ndarray, values: np.ndarray, ts: int,
-               seq: int | None = None) -> None:
+               seq: int | None = None,
+               vlens: np.ndarray | None = None) -> None:
         """Bulk ingest: land pre-built KV arrays as ONE sorted run — the
         AddSSTable path (kvserver/batcheval/cmd_add_sstable.go role; the
         reference's bulk loaders build SSTs client-side and link them into
@@ -406,7 +407,11 @@ class Engine:
             txn=jnp.zeros((cap,), jnp.int64),
             tomb=jnp.zeros((cap,), jnp.bool_),
             value=jnp.asarray(vb),
-            vlen=jnp.full((cap,), int(values.shape[1]), jnp.int32),
+            vlen=jnp.asarray(np.concatenate([
+                (np.asarray(vlens, dtype=np.int32) if vlens is not None
+                 else np.full(n, values.shape[1], np.int32)),
+                np.zeros(cap - n, np.int32),
+            ])),
             mask=jnp.asarray(np.arange(cap) < n),
         )
         self.runs.insert(0, mvcc.sort_block(blk))

@@ -385,3 +385,64 @@ def test_changefeed_exactly_once_resume(tmp_path):
     assert len(lines) == 4, "exactly once per version, no re-emission"
     assert (lines[2]["key"], lines[2]["value"]) == ("u001", "alice2")
     assert (lines[3]["key"], lines[3]["value"]) == ("u002", None)
+
+
+def test_kvnemesis_with_ingest_and_limited_scans():
+    """kvnemesis extension over the round-3 paths: bulk INGEST runs
+    interleave with transactional RMWs and LIMITED scans (iterator seeks +
+    pagination boundaries); every read must match a sequential dict model."""
+    from cockroach_tpu.storage.lsm import Engine as Eng
+
+    db = DB(Engine(key_width=16, val_width=16, memtable_size=32),
+            ManualClock())
+    rng = np.random.default_rng(11)
+    model: dict[bytes, bytes] = {}
+
+    def key(i: int) -> bytes:
+        return b"n%05d" % i
+
+    for step in range(80):
+        kind = rng.random()
+        if kind < 0.25:
+            # bulk ingest a contiguous strip (AddSSTable path)
+            lo = int(rng.integers(0, 400))
+            width = int(rng.integers(1, 40))
+            idx = np.arange(lo, lo + width)
+            keys = np.zeros((width, 16), dtype=np.uint8)
+            for j, i in enumerate(idx):
+                kb = key(int(i))
+                keys[j, :len(kb)] = np.frombuffer(kb, dtype=np.uint8)
+            vals = np.zeros((width, 16), dtype=np.uint8)
+            payload = b"g%03d" % step
+            vals[:, :len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+            db.engine.ingest(keys, vals, ts=db.clock.now(),
+                             vlens=np.full(width, len(payload)))
+            for i in idx:
+                model[key(int(i))] = payload
+        elif kind < 0.6:
+            # transactional RMW
+            k = key(int(rng.integers(0, 400)))
+
+            def op(t, k=k, step=step):
+                cur = t.get(k) or b""
+                t.put(k, b"t%03d" % step)
+                return cur
+
+            db.txn(op)
+            model[k] = b"t%03d" % step
+        elif kind < 0.75:
+            k = key(int(rng.integers(0, 400)))
+            db.delete(k)
+            model.pop(k, None)
+        else:
+            # limited scan from a random start: must equal the model's
+            # first `limit` keys at/after start (pagination correctness)
+            start = key(int(rng.integers(0, 400)))
+            limit = int(rng.integers(1, 25))
+            got = db.scan(start, None, max_keys=limit)
+            want = sorted(
+                (k, v) for k, v in model.items() if k >= start
+            )[:limit]
+            assert got == want, f"step {step}: scan from {start!r}"
+    got = dict(db.scan(None, None))
+    assert got == model
