@@ -398,3 +398,40 @@ def test_admission_io_governor():
     assert gov.throttled == 1
     eng.compact(bottom=True)
     assert gov.write_delay_s() == 0
+
+
+def test_timeseries_db():
+    """pkg/ts reduction: metric snapshots persist in KV, query/downsample/
+    prune over wall-clock ranges."""
+    from cockroach_tpu.kv import DB, ManualClock
+    from cockroach_tpu.kv.tsdb import TimeSeriesDB
+    from cockroach_tpu.storage.lsm import Engine
+    from cockroach_tpu.utils import metric
+
+    clock = ManualClock(start=1)
+    db = DB(Engine(key_width=48, val_width=32, memtable_size=256), clock)
+    ts = TimeSeriesDB(db)
+    reg = metric.Registry()
+    g = reg.gauge("lsm_runs")
+    c = reg.counter("writes")
+
+    for i in range(10):
+        g.set(i)
+        c.inc(5)
+        ts.record(reg)
+        clock.advance(1000)  # 1s per sample
+
+    series = ts.query("writes")
+    assert len(series) == 10
+    assert [v for _, v in series] == [5.0 * (i + 1) for i in range(10)]
+
+    # downsample 5s buckets, avg of gauge values 0..4 and 5..9
+    ds = ts.downsample("lsm_runs", bucket_ms=5000, agg="avg")
+    assert len(ds) in (2, 3)
+    assert abs(ds[0][1] - np.mean(range(5))) < 2.0
+
+    # retention: prune the first half
+    half = series[5][0]
+    dropped = ts.prune("writes", keep_after_ms=half)
+    assert dropped == 5
+    assert len(ts.query("writes")) == 5
