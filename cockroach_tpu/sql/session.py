@@ -80,6 +80,8 @@ class Session:
 
     def execute(self, text: str):
         handled = self._maybe_settings_stmt(text)
+        if handled is None:
+            handled = self._maybe_admin_stmt(text)
         if handled is not None:
             return handled
         stmt = P.parse_statement(text)
@@ -144,6 +146,56 @@ class Session:
                                    dtype=object),
             }
         return None
+
+    def _maybe_admin_stmt(self, text: str):
+        """BACKUP TO '<path>' / RESTORE FROM '<path>' / SHOW JOBS — the
+        jobs-backed admin surface (BACKUP runs as a job, exactly the
+        reference's shape; RESTORE swaps the engine state in from the
+        checkpoint and reloads table dictionaries)."""
+        import re as _re
+
+        t = text.strip().rstrip(";")
+        m = _re.match(r"(?is)^backup\s+to\s+'([^']+)'$", t)
+        if m:
+            from ..kv.jobs import Registry, register_builtin_jobs
+
+            reg = self._jobs_registry()
+            register_builtin_jobs(reg)
+            job = reg.create("backup", {"path": m.group(1)})
+            done = reg.adopt_and_resume(job.job_id)
+            return {"job_id": done.job_id, "state": done.state}
+        m = _re.match(r"(?is)^restore\s+from\s+'([^']+)'$", t)
+        if m:
+            from ..storage.lsm import Engine as _Engine
+
+            eng = _Engine.open_checkpoint(m.group(1))
+            self.db.engine = eng
+            for tbl in self.catalog.tables.values():
+                if isinstance(tbl, KVTable):
+                    tbl._count_cache = None
+                    tbl._dicts = {}
+                    if tbl._string_cols:
+                        tbl._load_dicts()
+            return {"restored": m.group(1)}
+        if _re.match(r"(?is)^show\s+jobs$", t):
+            import numpy as _np
+
+            reg = self._jobs_registry()
+            jobs = reg.jobs()
+            return {
+                "job_id": _np.array([j.job_id for j in jobs]),
+                "job_type": _np.array([j.job_type for j in jobs],
+                                      dtype=object),
+                "state": _np.array([j.state for j in jobs], dtype=object),
+            }
+        return None
+
+    def _jobs_registry(self):
+        from ..kv.jobs import Registry
+
+        if getattr(self, "_jobs", None) is None:
+            self._jobs = Registry(self.db)
+        return self._jobs
 
     # -- DDL -----------------------------------------------------------------
 

@@ -218,3 +218,27 @@ def test_cluster_settings_sql_surface(sess):
             sess.execute("set cluster setting nope.nope = 1")
     finally:
         settings.reset("sql.distsql.tile_size")
+
+
+def test_backup_restore_sql_surface(tmp_path):
+    """BACKUP TO / RESTORE FROM / SHOW JOBS through the session: state
+    written after the backup disappears on restore (engine-checkpoint
+    semantics), string dictionaries reload from the restored spans."""
+    sess = Session(val_width=256)
+    sess.execute("create table t (a int primary key, tag string)")
+    sess.execute("insert into t values (1, 'keep'), (2, 'keep2')")
+    path = str(tmp_path / "bk")
+    r = sess.execute(f"backup to '{path}'")
+    assert r["state"] == "succeeded"
+    sess.execute("insert into t values (3, 'lost-after-restore')")
+    assert int(sess.execute("select count(*) as n from t")["n"][0]) == 3
+
+    r = sess.execute(f"restore from '{path}'")
+    assert r["restored"] == path
+    res = sess.execute("select a, tag from t order by a")
+    assert list(res["a"]) == [1, 2]
+    assert list(res["tag"]) == ["keep", "keep2"]
+
+    jobs = sess.execute("show jobs")
+    # the backup job record itself was part of the backed-up state
+    assert "backup" in list(jobs["job_type"])
