@@ -8,17 +8,23 @@ from .session import Session
 
 
 def explain(catalog, text: str) -> str:
-    """EXPLAIN / EXPLAIN ANALYZE over SQL text. Accepts the statement with or
-    without the leading EXPLAIN keywords."""
+    """EXPLAIN / EXPLAIN ANALYZE / EXPLAIN (DISTSQL) over SQL text. Accepts
+    the statement with or without the leading EXPLAIN keywords."""
     t = text.strip()
     low = t.lower()
     analyze = False
+    distsql = False
     if low.startswith("explain"):
         t = t[len("explain"):].lstrip()
+        if t.lower().startswith("(distsql)"):
+            distsql = True
+            t = t[len("(distsql)"):].lstrip()
         if t.lower().startswith("analyze"):
             analyze = True
             t = t[len("analyze"):].lstrip()
     rel = sql(catalog, t)
+    if distsql:
+        return rel.explain_distributed()
     if analyze:
         rendered, _ = rel.explain_analyze()
         return rendered

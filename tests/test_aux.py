@@ -435,3 +435,20 @@ def test_timeseries_db():
     dropped = ts.prune("writes", keep_after_ms=half)
     assert dropped == 5
     assert len(ts.query("writes")) == 5
+
+
+def test_explain_distsql(cat):
+    """EXPLAIN (DISTSQL) renders the distribution stages (Exchange /
+    broadcast / gather placements) from SQL text."""
+    from cockroach_tpu.sql import explain
+
+    txt = explain(cat, "explain (distsql) "
+                       "select l_returnflag, count(*) from lineitem "
+                       "group by l_returnflag")
+    assert "scan lineitem" in txt
+    txt2 = explain(
+        cat, "explain (distsql) "
+             "select o_orderkey, count(*) as c from orders, lineitem "
+             "where o_orderkey = l_orderkey group by o_orderkey "
+             "order by c desc limit 5")
+    assert "gather" in txt2.lower() or "exchange" in txt2.lower()
