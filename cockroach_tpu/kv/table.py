@@ -380,6 +380,68 @@ class KVTable:
         return batch
 
 
+_DESC_PREFIX = b"\x01desc"
+
+
+def _descriptor_key(table_id: int, chunk: int) -> bytes:
+    return _DESC_PREFIX + b"%03d|%03d" % (table_id, chunk)
+
+
+def write_descriptor(db: DB, t: KVTable) -> None:
+    """Persist the table descriptor in the system keyspace (the
+    system.descriptor discipline: schemas are data, so a fresh process over
+    the same engine rediscovers every table). The JSON chunks across rows
+    so descriptors fit any engine value width."""
+    import json
+
+    desc = {
+        "name": t.name,
+        "names": list(t.schema.names),
+        "types": [
+            {"family": ty.family.name, "width": ty.width,
+             "precision": ty.precision, "scale": ty.scale}
+            for ty in t.schema.types
+        ],
+        "pk": t.pk,
+        "table_id": t.table_id,
+        "dict_table_id": t.dict_table_id,
+    }
+    blob = json.dumps(desc).encode("utf-8")
+    step = max(1, db.engine.val_width - 1)
+    for ci in range(0, (len(blob) + step - 1) // step):
+        db.put(_descriptor_key(t.table_id, ci),
+               blob[ci * step:(ci + 1) * step])
+
+
+def load_catalog_from_engine(catalog, db: DB) -> list[str]:
+    """Rebuild KVTable entries from persisted descriptors (the catalog
+    bootstrap / lease-free resolution path). Returns the table names."""
+    import json
+
+    from ..coldata.types import Family as F
+    from ..coldata.types import Schema as S
+    from ..coldata.types import SQLType
+
+    blobs: dict[bytes, list[tuple[bytes, bytes]]] = {}
+    for k, v in db.scan(_DESC_PREFIX, _DESC_PREFIX + b"\xff"):
+        tid = k[len(_DESC_PREFIX):].split(b"|")[0]
+        blobs.setdefault(tid, []).append((k, v))
+    out = []
+    for tid in sorted(blobs):
+        chunks = b"".join(v for _, v in sorted(blobs[tid]))
+        desc = json.loads(chunks.decode("utf-8"))
+        types = tuple(
+            SQLType(F[d["family"]], width=d["width"],
+                    precision=d["precision"], scale=d["scale"])
+            for d in desc["types"]
+        )
+        t = KVTable(db, desc["name"], S(tuple(desc["names"]), types),
+                    desc["pk"], desc["table_id"], desc["dict_table_id"])
+        catalog.tables[desc["name"]] = t
+        out.append(desc["name"])
+    return out
+
+
 def create_kv_table(catalog, db: DB, name: str, schema: Schema, pk: str,
                     table_id: int | None = None) -> KVTable:
     """Create + register a KV-backed table in the catalog so sql()/Rel
@@ -402,4 +464,5 @@ def create_kv_table(catalog, db: DB, name: str, schema: Schema, pk: str,
         dict_table_id = max(used, default=0) + 1
     t = KVTable(db, name, schema, pk, table_id, dict_table_id)
     catalog.tables[name] = t
+    write_descriptor(db, t)
     return t

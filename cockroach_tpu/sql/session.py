@@ -75,6 +75,12 @@ class Session:
                    memtable_size=4096),
             Clock(),
         )
+        if db is not None:
+            # opening over an existing store: rediscover persisted tables
+            # from their descriptors (the catalog bootstrap path)
+            from ..kv.table import load_catalog_from_engine
+
+            load_catalog_from_engine(self.catalog, self.db)
 
     # -- dispatch ------------------------------------------------------------
 
@@ -170,12 +176,15 @@ class Session:
 
             eng = _Engine.open_checkpoint(m.group(1))
             self.db.engine = eng
-            for tbl in self.catalog.tables.values():
-                if isinstance(tbl, KVTable):
-                    tbl._count_cache = None
-                    tbl._dicts = {}
-                    if tbl._string_cols:
-                        tbl._load_dicts()
+            # schemas are data: rebuild the catalog from the restored
+            # descriptors (tables created after the backup disappear;
+            # tables present in the backup return even into a fresh session)
+            from ..kv.table import load_catalog_from_engine
+
+            for name in [n for n, tbl in self.catalog.tables.items()
+                         if isinstance(tbl, KVTable)]:
+                del self.catalog.tables[name]
+            load_catalog_from_engine(self.catalog, self.db)
             return {"restored": m.group(1)}
         if _re.match(r"(?is)^show\s+jobs$", t):
             import numpy as _np

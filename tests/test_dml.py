@@ -242,3 +242,26 @@ def test_backup_restore_sql_surface(tmp_path):
     jobs = sess.execute("show jobs")
     # the backup job record itself was part of the backed-up state
     assert "backup" in list(jobs["job_type"])
+
+
+def test_catalog_descriptors_survive_restart(tmp_path):
+    """Schemas are data: a FRESH session over the same engine (or a restored
+    checkpoint) rediscovers tables from persisted descriptors — the
+    system.descriptor / catalog-bootstrap discipline."""
+    sess = Session(val_width=256)
+    sess.execute("create table t (a int primary key, tag string)")
+    sess.execute("insert into t values (1, 'x'), (2, 'y')")
+
+    # restart: new Session over the same DB, empty catalog
+    sess2 = Session(db=sess.db)
+    res = sess2.execute("select a, tag from t order by a")
+    assert list(res["a"]) == [1, 2] and list(res["tag"]) == ["x", "y"]
+    sess2.execute("insert into t values (3, 'z')")
+
+    # backup in one session, restore into a COMPLETELY fresh one
+    path = str(tmp_path / "bk")
+    sess2.execute(f"backup to '{path}'")
+    fresh = Session(val_width=256)
+    fresh.execute(f"restore from '{path}'")
+    res = fresh.execute("select count(*) as n from t")
+    assert int(res["n"][0]) == 3
