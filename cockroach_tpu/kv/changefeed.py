@@ -29,18 +29,22 @@ from .txn import DB
 
 def changes_between(db: DB, lo_ts: int, hi_ts: int,
                     start: bytes | None = None,
-                    end: bytes | None = None) -> list[dict]:
-    """Committed versions with lo_ts < ts <= hi_ts in [start, end), ordered
-    by (ts, key) — the catch-up scan. Tombstones emit value None."""
+                    end: bytes | None = None) -> tuple[list[dict], int]:
+    """Committed versions with lo_ts < ts <= RESOLVED in [start, end),
+    ordered by (ts, key), plus the RESOLVED frontier itself — the catch-up
+    scan with the closed-timestamp discipline (kvserver/closedts): the
+    frontier must not advance past an UNRESOLVED intent in the span, or its
+    eventual commit timestamp would fall behind an already-emitted resolved
+    checkpoint and the event would be skipped forever. Tombstones emit
+    value None. Returns (events, resolved)."""
     eng = db.engine
-    eng.flush_mem_only()
-    view = eng._merged_view()
+    view = eng._merged_view()  # overlays the memtable; read-only
     if view is None:
-        return []
+        return [], hi_ts
     mask = np.asarray(view.mask)
     ts = np.asarray(view.ts)
     txn = np.asarray(view.txn)
-    sel = mask & (txn == 0) & (ts > lo_ts) & (ts <= hi_ts)
+    in_span = mask
     if start is not None or end is not None:
         keys_np = np.asarray(view.key)
         raw = [bytes(k).rstrip(b"\x00") for k in keys_np]
@@ -48,10 +52,16 @@ def changes_between(db: DB, lo_ts: int, hi_ts: int,
             (start is None or k >= start) and (end is None or k < end)
             for k in raw
         ])
-        sel = sel & inr
+        in_span = in_span & inr
+    # the resolved frontier holds below the oldest unresolved intent
+    intents = in_span & (txn != 0)
+    resolved = int(hi_ts)
+    if intents.any():
+        resolved = min(resolved, int(ts[intents].min()) - 1)
+    sel = in_span & (txn == 0) & (ts > lo_ts) & (ts <= resolved)
     idx = np.nonzero(sel)[0]
     if len(idx) == 0:
-        return []
+        return [], resolved
     keys = K.decode_keys(np.asarray(view.key)[idx])
     vals = np.asarray(view.value)[idx]
     vlens = np.asarray(view.vlen)[idx]
@@ -65,7 +75,7 @@ def changes_between(db: DB, lo_ts: int, hi_ts: int,
             "ts": int(t),
         })
     out.sort(key=lambda e: (e["ts"], e["key"]))
-    return out
+    return out, resolved
 
 
 class FileSink:
@@ -93,11 +103,101 @@ def register_changefeed_job(registry: Registry, polls: int = 1) -> None:
         for _ in range(job.payload.get("polls", polls)):
             resolved = job.progress.get("resolved", 0)
             now = reg.db.clock.now()
-            events = changes_between(reg.db, resolved, now, s, e)
+            events, new_resolved = changes_between(
+                reg.db, resolved, now, s, e)
             if events:
                 sink.emit(events)
-            job.progress["resolved"] = now
+            job.progress["resolved"] = new_resolved
             reg.checkpoint(job)  # frontier checkpoint: resume point
         return {"resolved": job.progress["resolved"]}
 
     registry.register("changefeed", resume)
+
+
+class RangefeedServer:
+    """Push rangefeed events over the DCN framing — the MuxRangeFeed
+    reduction (kvpb api.proto:3700): a subscriber names a span and a start
+    timestamp; the server streams JSON event frames as new versions commit
+    (poll-driven tailer standing in for the raft-apply hook), interleaved
+    with resolved-timestamp checkpoints."""
+
+    def __init__(self, db: DB, poll_interval_s: float = 0.05):
+        import socket
+        import threading
+
+        self.db = db
+        self.poll_interval_s = poll_interval_s
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self._srv.settimeout(0.2)
+        self.addr = self._srv.getsockname()
+        self._stop = threading.Event()
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        import socket
+        import threading
+
+        from ..flow.dcn import _recv_msg
+
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # server socket closed
+            req = json.loads(_recv_msg(conn).decode("utf-8"))
+            threading.Thread(target=self._tail, args=(conn, req),
+                             daemon=True).start()
+
+    def _tail(self, conn, req):
+        from ..flow.dcn import _send_msg
+
+        start = req.get("start")
+        end = req.get("end")
+        s = start.encode() if isinstance(start, str) else start
+        e = end.encode() if isinstance(end, str) else end
+        resolved = int(req.get("since", 0))
+        try:
+            while not self._stop.is_set():
+                now = self.db.clock.now()
+                events, new_resolved = changes_between(
+                    self.db, resolved, now, s, e)
+                for ev in events:
+                    _send_msg(conn, json.dumps(ev).encode("utf-8"))
+                resolved = new_resolved
+                _send_msg(conn, json.dumps(
+                    {"resolved": resolved}).encode("utf-8"))
+                self._stop.wait(self.poll_interval_s)
+        except OSError:
+            pass  # subscriber went away
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop.set()
+        self._srv.close()
+
+
+def subscribe_rangefeed(addr, start=None, end=None, since: int = 0):
+    """Dial a RangefeedServer; returns (socket, iterator of frames).
+    Frames are events ({key, value, ts}) or checkpoints ({resolved})."""
+    import socket
+
+    from ..flow.dcn import _recv_msg, _send_msg
+
+    sock = socket.create_connection(tuple(addr))
+    _send_msg(sock, json.dumps({
+        "start": start.decode() if isinstance(start, bytes) else start,
+        "end": end.decode() if isinstance(end, bytes) else end,
+        "since": since,
+    }).encode("utf-8"))
+
+    def frames():
+        while True:
+            msg = _recv_msg(sock)
+            if msg is None:
+                return
+            yield json.loads(msg.decode("utf-8"))
+
+    return sock, frames()

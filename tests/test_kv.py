@@ -446,3 +446,43 @@ def test_kvnemesis_with_ingest_and_limited_scans():
             assert got == want, f"step {step}: scan from {start!r}"
     got = dict(db.scan(None, None))
     assert got == model
+
+
+def test_rangefeed_push_subscription():
+    """MuxRangeFeed reduction: a subscriber receives committed versions as
+    events plus resolved checkpoints, across writes made AFTER subscribing
+    (push, not poll-from-client)."""
+    from cockroach_tpu.kv.changefeed import (
+        RangefeedServer, subscribe_rangefeed,
+    )
+
+    db = DB(Engine(key_width=16, val_width=64, memtable_size=64),
+            ManualClock())
+    db.txn(lambda t: t.put(b"w1", b"before"))
+    srv = RangefeedServer(db, poll_interval_s=0.02)
+    try:
+        sock, frames = subscribe_rangefeed(srv.addr, start=b"w", end=b"x")
+        got = []
+        resolved = 0
+        import time as _time
+
+        deadline = _time.time() + 10
+        wrote = False
+        for f in frames:
+            if "resolved" in f:
+                resolved = f["resolved"]
+                if not wrote:
+                    db.txn(lambda t: (t.put(b"w2", b"after"),
+                                      t.delete(b"w1")))
+                    wrote = True
+            else:
+                got.append((f["key"], f["value"]))
+            if len(got) >= 3 or _time.time() > deadline:
+                break
+        sock.close()
+        assert ("w1", "before") in got, "catch-up scan event"
+        assert ("w2", "after") in got, "post-subscribe write pushed"
+        assert ("w1", None) in got, "delete surfaces as NULL"
+        assert resolved > 0
+    finally:
+        srv.close()
