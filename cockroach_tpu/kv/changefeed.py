@@ -46,13 +46,34 @@ def changes_between(db: DB, lo_ts: int, hi_ts: int,
     txn = np.asarray(view.txn)
     in_span = mask
     if start is not None or end is not None:
-        keys_np = np.asarray(view.key)
-        raw = [bytes(k).rstrip(b"\x00") for k in keys_np]
-        inr = np.array([
-            (start is None or k >= start) and (end is None or k < end)
-            for k in raw
-        ])
-        in_span = in_span & inr
+        # vectorized bound compare: pack key bytes into big-endian uint64
+        # word lanes (the engine's own key-order encoding) and compare
+        # lexicographically word by word — no per-row Python loop on the
+        # hot poll path
+        keys_np = np.ascontiguousarray(np.asarray(view.key))
+        n, kw = keys_np.shape
+        shifts = (np.arange(7, -1, -1, dtype=np.uint64)
+                  * np.uint64(8))
+        words = (keys_np.reshape(n, kw // 8, 8).astype(np.uint64)
+                 << shifts).sum(axis=-1, dtype=np.uint64)
+
+        def bound_words(b: bytes):
+            bb = np.frombuffer(b.ljust(kw, b"\x00"), dtype=np.uint8)
+            return (bb.reshape(kw // 8, 8).astype(np.uint64)
+                    << shifts).sum(axis=-1, dtype=np.uint64)
+
+        def cmp_ge(bw):
+            ge = np.zeros(n, dtype=bool)
+            eq = np.ones(n, dtype=bool)
+            for j in range(words.shape[1]):
+                ge |= eq & (words[:, j] > bw[j])
+                eq &= words[:, j] == bw[j]
+            return ge | eq
+
+        if start is not None:
+            in_span = in_span & cmp_ge(bound_words(bytes(start)))
+        if end is not None:
+            in_span = in_span & ~cmp_ge(bound_words(bytes(end)))
     # the resolved frontier holds below the oldest unresolved intent
     intents = in_span & (txn != 0)
     resolved = int(hi_ts)
@@ -107,7 +128,10 @@ def register_changefeed_job(registry: Registry, polls: int = 1) -> None:
                 reg.db, resolved, now, s, e)
             if events:
                 sink.emit(events)
-            job.progress["resolved"] = new_resolved
+            # the frontier never regresses: a txn that began before the
+            # last checkpoint may lay intents below it, but re-emitting
+            # (old_resolved, new_resolved] would duplicate events
+            job.progress["resolved"] = max(resolved, new_resolved)
             reg.checkpoint(job)  # frontier checkpoint: resume point
         return {"resolved": job.progress["resolved"]}
 
@@ -146,9 +170,26 @@ class RangefeedServer:
                 continue
             except OSError:
                 return  # server socket closed
-            req = json.loads(_recv_msg(conn).decode("utf-8"))
-            threading.Thread(target=self._tail, args=(conn, req),
+            threading.Thread(target=self._handshake, args=(conn,),
                              daemon=True).start()
+
+    def _handshake(self, conn):
+        """Per-connection handshake off the accept loop: a slow, broken or
+        malicious client can neither stall new subscriptions nor kill the
+        server thread."""
+        from ..flow.dcn import _recv_msg
+
+        try:
+            conn.settimeout(10.0)
+            msg = _recv_msg(conn)
+            if msg is None:
+                raise ConnectionError("empty handshake")
+            req = json.loads(msg.decode("utf-8"))
+            conn.settimeout(None)
+        except (OSError, ValueError, ConnectionError):
+            conn.close()
+            return
+        self._tail(conn, req)
 
     def _tail(self, conn, req):
         from ..flow.dcn import _send_msg
@@ -165,7 +206,7 @@ class RangefeedServer:
                     self.db, resolved, now, s, e)
                 for ev in events:
                     _send_msg(conn, json.dumps(ev).encode("utf-8"))
-                resolved = new_resolved
+                resolved = max(resolved, new_resolved)  # never regress
                 _send_msg(conn, json.dumps(
                     {"resolved": resolved}).encode("utf-8"))
                 self._stop.wait(self.poll_interval_s)
@@ -195,7 +236,10 @@ def subscribe_rangefeed(addr, start=None, end=None, since: int = 0):
 
     def frames():
         while True:
-            msg = _recv_msg(sock)
+            try:
+                msg = _recv_msg(sock)
+            except (ConnectionError, OSError):
+                return  # server closed the stream: end of feed
             if msg is None:
                 return
             yield json.loads(msg.decode("utf-8"))

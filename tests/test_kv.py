@@ -462,6 +462,7 @@ def test_rangefeed_push_subscription():
     srv = RangefeedServer(db, poll_interval_s=0.02)
     try:
         sock, frames = subscribe_rangefeed(srv.addr, start=b"w", end=b"x")
+        sock.settimeout(15)  # a stalled server fails the test, not hangs it
         got = []
         resolved = 0
         import time as _time
