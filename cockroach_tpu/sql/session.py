@@ -186,6 +186,23 @@ class Session:
                 del self.catalog.tables[name]
             load_catalog_from_engine(self.catalog, self.db)
             return {"restored": m.group(1)}
+        if _re.match(r"(?is)^show\s+tables$", t):
+            import numpy as _np
+
+            names = sorted(self.catalog.tables)
+            return {"table_name": _np.array(names, dtype=object)}
+        m = _re.match(r"(?is)^show\s+columns\s+from\s+([a-z0-9_]+)$", t)
+        if m:
+            import numpy as _np
+
+            tbl = self.catalog.tables.get(m.group(1))
+            if tbl is None:
+                raise BindError(f"unknown table {m.group(1)!r}")
+            return {
+                "column_name": _np.array(tbl.schema.names, dtype=object),
+                "data_type": _np.array(
+                    [str(ty) for ty in tbl.schema.types], dtype=object),
+            }
         if _re.match(r"(?is)^show\s+jobs$", t):
             import numpy as _np
 

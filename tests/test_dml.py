@@ -265,3 +265,14 @@ def test_catalog_descriptors_survive_restart(tmp_path):
     fresh.execute(f"restore from '{path}'")
     res = fresh.execute("select count(*) as n from t")
     assert int(res["n"][0]) == 3
+
+
+def test_show_tables_and_columns(sess):
+    sess.execute("create table t (a int primary key, b decimal(10, 2))")
+    r = sess.execute("show tables")
+    assert "t" in list(r["table_name"])
+    r = sess.execute("show columns from t")
+    assert list(r["column_name"]) == ["a", "b"]
+    assert list(r["data_type"]) == ["INT64", "DECIMAL(10,2)"]
+    with pytest.raises(BindError):
+        sess.execute("show columns from nope")
