@@ -152,9 +152,26 @@ class FlowServer:
             except socket.timeout:
                 continue
             try:
-                name = _recv_msg(conn).decode("utf-8")
-                make_op = self.flows[name]
+                # a bad client (empty handshake, unknown flow, mid-stream
+                # reset) must not kill the accept loop — per-connection
+                # errors are that connection's problem (the RangefeedServer
+                # handshake discipline)
+                msg = _recv_msg(conn)
+                if msg is None:
+                    continue
+                name = msg.decode("utf-8", errors="replace")
+                make_op = self.flows.get(name)
+                if make_op is None:
+                    continue
                 FlowOutbox(make_op(), conn).run()
+            except Exception as e:
+                # operator/stream errors too: one connection's failure
+                # (including a flow whose operator raises mid-stream) must
+                # never take down the accept loop
+                from ..utils import log
+
+                log.warning(log.OPS, "flow connection failed",
+                            error=f"{type(e).__name__}: {e}")
             finally:
                 conn.close()
 

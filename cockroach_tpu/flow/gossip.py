@@ -34,7 +34,10 @@ class Info:
 
     @staticmethod
     def from_wire(d: dict) -> "Info":
-        return Info(d["k"], d["v"], d["ver"], d["o"])
+        # coerce BEFORE anything merges: a peer sending a malformed info
+        # (e.g. version as a string) must fail decode, not poison the
+        # infoStore with values later comparisons choke on
+        return Info(str(d["k"]), d["v"], int(d["ver"]), int(d["o"]))
 
 
 class Gossip:
@@ -96,10 +99,18 @@ class Gossip:
                 except socket.timeout:
                     continue
                 try:
-                    theirs = json.loads(_recv_msg(conn).decode("utf-8"))
+                    # malformed or truncated exchanges must not kill the
+                    # server loop — drop the connection and keep accepting
+                    msg = _recv_msg(conn)
+                    if msg is None:
+                        continue
+                    theirs = json.loads(msg.decode("utf-8",
+                                                   errors="replace"))
                     self._merge([Info.from_wire(d) for d in theirs])
                     _send_msg(conn, json.dumps(
                         self._snapshot()).encode("utf-8"))
+                except (OSError, ValueError, KeyError, TypeError):
+                    pass
                 finally:
                     conn.close()
 
@@ -124,7 +135,9 @@ class Gossip:
                 for p in peers:
                     try:
                         self.exchange(p)
-                    except OSError:
+                    except (OSError, ValueError, TypeError, KeyError):
+                        # a bad peer must not kill the gossip thread; the
+                        # next round retries
                         pass
                 time.sleep(interval_s)
 

@@ -26,6 +26,10 @@ from dataclasses import dataclass
 from .txn import DB
 
 _PREFIX = b"\x01job"
+# id-sequence key OUTSIDE the record prefix: create()'s allocation is a
+# point read/write, so concurrent job-record writes (checkpoints) never
+# invalidate a create's refresh span (and jobs() scans never parse it)
+_SEQ_KEY = b"\x01jbsq"
 
 
 @dataclass
@@ -87,12 +91,27 @@ class Registry:
     # -- lifecycle -----------------------------------------------------------
 
     def create(self, job_type: str, payload: dict) -> Job:
-        """CreateJob: a durable pending record (one txn)."""
-        existing = [j.job_id for j in self.jobs()]
-        job = Job(max(existing, default=0) + 1, job_type, "pending",
-                  payload, {})
-        self.db.txn(lambda t: self._write(t, job))
-        return job
+        """CreateJob: a durable pending record (one txn). The id comes from
+        a sequence key read/written INSIDE the txn — a point span, so two
+        registries over the same DB cannot allocate the same id (the
+        conflicting create retries) and concurrent job-record writes don't
+        invalidate the allocation's refresh."""
+        def op(t):
+            v = t.get(_SEQ_KEY)
+            if v is not None:
+                top = int(v)
+            else:
+                # one-time migration from pre-sequence stores: seed from
+                # the existing records' max id
+                top = 0
+                for k, _ in t.scan(_PREFIX, _PREFIX + b"\xff"):
+                    top = max(top, int(k[len(_PREFIX):]))
+            t.put(_SEQ_KEY, b"%d" % (top + 1))
+            job = Job(top + 1, job_type, "pending", payload, {})
+            self._write(t, job)
+            return job
+
+        return self.db.txn(op)
 
     def checkpoint(self, job: Job) -> None:
         """Persist progress mid-run (the backup-manifest-checkpoint shape:

@@ -61,8 +61,13 @@ class NodeLiveness:
     def _key(node_id: int) -> bytes:
         return _PREFIX + b"%05d" % node_id
 
-    def _read(self, node_id: int) -> LivenessRecord | None:
-        v = self.db.get(self._key(node_id))
+    def _read(self, node_id: int, reader=None) -> LivenessRecord | None:
+        """reader: pass the open Txn inside txn closures so the read lands
+        in the txn's read spans (commit-time refresh validates it) and a
+        concurrent writer's intent converts to TransactionRetryError rather
+        than surfacing WriteIntentError out of db.get."""
+        v = (reader if reader is not None else self.db).get(
+            self._key(node_id))
         if v is None:
             return None
         epoch, exp, nid = _REC.unpack(v)
@@ -75,7 +80,7 @@ class NodeLiveness:
         owns. Raises EpochFencedError if a peer incremented the epoch (the
         node was declared dead; its old leases are invalid)."""
         def op(t):
-            cur = self._read(self.node_id)
+            cur = self._read(self.node_id, t)
             now = self.db.clock.now()
             from . import hlc
 
@@ -110,7 +115,7 @@ class NodeLiveness:
         write that invalidates its epoch-based leases. Refuses while the
         record is still live (liveness.go IncrementEpoch contract)."""
         def op(t):
-            cur = self._read(node_id)
+            cur = self._read(node_id, t)
             if cur is None:
                 raise ValueError(f"no liveness record for node {node_id}")
             if cur.live_at(self.db.clock.now()):

@@ -381,12 +381,23 @@ class Session:
                 const_sets[col] = self._literal(e, t.schema.type_of(col))
             except NotALiteral:
                 computed_sets.append((col, e))
-        res = self._affected(t, stmt.where, computed_sets)
-        n = len(res[t.pk])
         computed = {c for c, _ in computed_sets}
+        pk_t = t.schema.type_of(t.pk)
 
         def op(txn):
+            # the affected-row scan runs INSIDE the txn closure so a retry
+            # recomputes it, and each row is re-read through the txn
+            # (get_row_txn tracks the read span) — a writer interleaving
+            # between scan and commit fails the commit-time refresh and
+            # retries instead of being silently overwritten (lost update)
+            res = self._affected(t, stmt.where, computed_sets)
+            n = len(res[t.pk])
+            written = 0
             for i in range(n):
+                pk = _from_result(res[t.pk][i], pk_t)
+                cur = t.get_row_txn(txn, pk)
+                if cur is None:
+                    continue  # deleted since the scan; refresh validates
                 row = {}
                 for cname, typ in zip(t.schema.names, t.schema.types):
                     if cname in computed:
@@ -395,24 +406,33 @@ class Session:
                     elif cname in const_sets:
                         row[cname] = const_sets[cname]
                     else:
-                        row[cname] = _from_result(res[cname][i], typ)
+                        # unmodified columns come from the TRACKED read,
+                        # not the untracked scan snapshot
+                        row[cname] = cur[cname]
                 t.insert(txn, row)  # MVCC: a new version at the txn ts
+                written += 1
+            return written
 
-        self.db.txn(op)
+        n = self.db.txn(op)
         return {"rows_affected": n}
 
     def _delete(self, stmt: P.Delete):
         t = self._kv_table(stmt.table)
-        res = self._affected(t, stmt.where)
         pk_t = t.schema.type_of(t.pk)
-        pks = [_from_result(v, pk_t) for v in res[t.pk]]
 
         def op(txn):
-            for pk in pks:
+            res = self._affected(t, stmt.where)
+            deleted = 0
+            for v in res[t.pk]:
+                pk = _from_result(v, pk_t)
+                if t.get_row_txn(txn, pk) is None:
+                    continue  # already gone; the tracked read validates
                 t.delete_pk(txn, pk)
+                deleted += 1
+            return deleted
 
-        self.db.txn(op)
-        return {"rows_affected": len(pks)}
+        n = self.db.txn(op)
+        return {"rows_affected": n}
 
 
 def _from_result(v, t: T.SQLType):

@@ -192,3 +192,30 @@ def test_gossip_two_process_convergence():
         p.join(timeout=10)
         if p.is_alive():
             p.terminate()
+
+
+def test_flow_server_survives_bad_clients(remote):
+    """A misbehaving client (empty handshake, unknown flow name, garbage
+    frame) must not kill the accept loop: the next well-formed request
+    still gets its stream (per-connection error isolation, the
+    RangefeedServer handshake discipline)."""
+    import socket
+
+    # 1: connect and immediately close (empty handshake)
+    s = socket.create_connection(tuple(remote))
+    s.close()
+    # 2: unknown flow name
+    s = socket.create_connection(tuple(remote))
+    dcn._send_msg(s, b"no-such-flow")
+    s.close()
+    # 3: garbage bytes that are not a full frame
+    s = socket.create_connection(tuple(remote))
+    s.sendall(b"\xff\xff")
+    s.close()
+
+    # the server still answers a real request
+    cat = _half_catalog(1)
+    inbox = dcn.setup_remote_flow(remote, "orders_half",
+                                  cat.get("orders").schema)
+    got = run_operator(inbox)
+    assert len(got["o_orderkey"]) == cat.get("orders").num_rows
