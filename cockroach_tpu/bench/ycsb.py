@@ -43,10 +43,16 @@ def run_ycsb_e(
     insert_frac: float = 0.05,
     seed: int = 0,
     ingest_chunk: int = 1 << 17,
+    concurrency: int = 64,
 ) -> dict:
     """Bulk-load n_keys (chunked ingest -> compaction churn), then run
     `ops` operations (scan_len-row scans + insert_frac inserts). Returns
-    load + op throughputs."""
+    load + op throughputs.
+
+    Scans issue through Engine.scan_batch in groups of `concurrency` — the
+    vectorized analog of the reference's concurrent YCSB workers (pkg/
+    workload/ycsb runs many goroutines against one store; over a remote-
+    attached TPU, batching is the only way past the 1/RTT serial floor)."""
     rng = np.random.default_rng(seed)
     eng = Engine(key_width=16, val_width=16, memtable_size=4096)
     t_load = time.time()
@@ -62,20 +68,32 @@ def run_ycsb_e(
         ts += 1
     load_s = time.time() - t_load
     # warm the merged view + compile the scan kernel before timing
-    eng.scan(_key(0), None, ts=ts, max_keys=scan_len)
+    eng.scan_batch([_key(0)] * concurrency, ts=ts, max_keys=scan_len)
 
     next_pk = n_keys
     rows = 0
     t0 = time.time()
-    for op in range(ops):
-        if rng.random() < insert_frac:
-            eng.put(_key(next_pk), b"v%08d" % next_pk, ts=ts)
-            next_pk += 1
-            ts += 1
-        else:
-            start = int(rng.integers(0, n_keys))
-            got = eng.scan(_key(start), None, ts=ts, max_keys=scan_len)
+    done = 0
+    while done < ops:
+        group = min(concurrency, ops - done)
+        starts = []
+        n_scans = 0
+        for _ in range(group):
+            if rng.random() < insert_frac:
+                eng.put(_key(next_pk), b"v%08d" % next_pk, ts=ts)
+                next_pk += 1
+                ts += 1
+            else:
+                starts.append(_key(int(rng.integers(0, n_keys))))
+                n_scans += 1
+        # pad to a FIXED batch shape (multi_scan jit-specializes on B;
+        # ragged tails would each compile their own kernel)
+        while len(starts) < concurrency:
+            starts.append(_key(0))
+        for got in eng.scan_batch(starts, ts=ts,
+                                  max_keys=scan_len)[:n_scans]:
             rows += len(got)
+        done += group
     el = time.time() - t0
     return {
         "n_keys": n_keys,

@@ -72,6 +72,45 @@ class Table:
             self._stats = stats
         return self._stats
 
+    def dense_key_info(self) -> dict[str, tuple[int, int]]:
+        """{column: (lo, fanout)} for integer columns whose value IS an
+        affine function of the row index: col == repeat(arange(lo, lo+n/f), f).
+
+        fanout 1 covers surrogate primary keys (TPC-H o_orderkey = 1..N and
+        friends — the reference reads the same structure out of its index
+        key prefix, pkg/sql/colfetcher/cfetcher.go:230); fanout f covers
+        clustered child tables (partsupp: exactly 4 contiguous rows per
+        part). Joins against such a column need no hash table and no sorted
+        index: the matching row index is arithmetic (ops/join.py
+        DenseAnalytic). Host-verified once, cached."""
+        cached = getattr(self, "_dense_keys", None)
+        if cached is not None:
+            return cached
+        info: dict[str, tuple[int, int]] = {}
+        n = self.num_rows
+        for name, t in zip(self.schema.names, self.schema.types):
+            if t.family not in (Family.INT, Family.DECIMAL, Family.DATE,
+                                Family.TIMESTAMP, Family.INTERVAL):
+                continue
+            if name in self.valids or n == 0:
+                continue  # NULLs break the bijection
+            a = np.asarray(self.columns[name])
+            if a.ndim != 1 or a.dtype.kind not in ("i", "u"):
+                continue
+            lo = int(a[0])
+            hi = int(a[-1])
+            distinct = hi - lo + 1
+            if distinct <= 0 or n % distinct != 0:
+                continue
+            fanout = n // distinct
+            if np.array_equal(
+                a, np.repeat(np.arange(lo, lo + distinct, dtype=a.dtype),
+                             fanout)
+            ):
+                info[name] = (lo, fanout)
+        self._dense_keys = info
+        return info
+
     def device_batch(self, names: tuple[str, ...] | None = None) -> Batch:
         """Device-resident batch of the requested columns. Cached per column,
         so a query never uploads columns it does not scan."""

@@ -329,6 +329,7 @@ class ProjectOp(OneInputOperator):
     def __init__(self, child: Operator, exprs: tuple[ex.Expr, ...],
                  names: tuple[str, ...], dict_overrides: tuple = ()):
         super().__init__(child)
+        self.exprs = exprs  # JoinOp's dense-build walk maps keys through these
         schema = child.output_schema
         types = tuple(ex.expr_type(e, schema) for e in exprs)
         self.output_schema = Schema(tuple(names), types)
@@ -782,12 +783,74 @@ class HashJoinOp(OneInputOperator):
             have_remaps=True,
         )
         self._built = False
+        # existence probes (semi/anti) and unique-build probes have static
+        # probe-aligned output shapes: fusable, and eligible for the dense
+        # direct-addressing strategies picked in _ensure_built
+        self._fusable = (
+            spec.build_unique or spec.join_type in ("semi", "anti")
+        )
+        self._analytic = None
+
+    def _plan_analytic(self):
+        """Dense analytic build detection: the build side is a position-
+        preserving chain (Scan + Filter/Project only — masks, never row
+        movement) over a table whose first build-key column is an affine
+        function of the row index (catalog Table.dense_key_info). Probing
+        such a build is pure arithmetic + one liveness gather — no hash
+        table, no sorted index, no build-spool sync (ops/join.py rationale).
+        """
+        if not self._fusable:
+            return None
+        key = self.build_keys[0]
+        op = self.build
+        while not isinstance(op, ScanOp):
+            if isinstance(op, ProjectOp):
+                e = op.exprs[key]
+                if not isinstance(e, ex.ColRef):
+                    return None
+                key = e.idx
+                op = op.child
+            elif isinstance(op, FilterOp):
+                op = op.child
+            else:
+                return None
+        table = op.table
+        dense_fn = getattr(table, "dense_key_info", None)
+        if not callable(dense_fn):
+            return None
+        name = table.schema.names[op.col_idxs[key]]
+        got = dense_fn().get(name)
+        if got is None:
+            return None
+        lo, fanout = got
+        if (self.spec.build_unique and fanout > 1
+                and len(self.build_keys) < 2):
+            return None  # fanout rows share the first key: not unique by it
+        # the analytic build materializes the WHOLE table (plus projection-
+        # derived columns) on device with no spill path — honor the workmem
+        # byte budget the Grace-join spool enforces, falling back to the
+        # metered hash path when the table is too big to pin
+        from ..utils import settings
+
+        row_bytes = sum(
+            ((t.width or 8) if t.family is Family.BYTES
+             else t.dtype.itemsize) + 1
+            for t in self.build.output_schema.types
+        ) + 1  # +1s: valid bitmaps and the row mask (bool each)
+        if table.num_rows * row_bytes > settings.get(
+            "sql.distsql.workmem_bytes"
+        ):
+            return None
+        return join_ops.DenseAnalytic(
+            key_lo=lo, fanout=fanout, build_rows=table.num_rows
+        )
 
     def init(self):
         self.build.init()
         super().init()
         self._built = False
         self._grace = None
+        self._analytic = self._plan_analytic()
         if hasattr(self, "_build_fn"):
             return
         bschema = self.build.output_schema
@@ -805,39 +868,20 @@ class HashJoinOp(OneInputOperator):
             return big, index
 
         self._build_fn = build_fn
-        pschema = self.child.output_schema
-        pkeys = self.probe_keys
-        pht = self.probe_hash_tables or None
-        remaps = self.build_code_remaps or None
-        spec = self.spec
 
-        if spec.build_unique:
+        @functools.partial(jax.jit, static_argnames=("cap",))
+        def lut_fn(tiles, cap):
+            big = concat(list(tiles), capacity=cap)
+            return big, join_ops.build_dense_lut(big, bkeys, layout, eremaps)
 
-            def probe_raw(p, build, index):
-                return join_ops.hash_join_unique(
-                    p, pschema, pkeys, build, bschema, bkeys, spec,
-                    pht, bht, remaps, index=index, exact_layout=layout,
-                )
-
-            self._probe_raw = probe_raw
-            self._probe_fn = jax.jit(probe_raw)
-        elif spec.join_type in ("semi", "anti"):
-
-            def probe_raw(p, build, index):
-                # output is a probe-shaped mask: out_cap is irrelevant
-                out, _ = join_ops.hash_join_general(
-                    p, pschema, pkeys, build, bschema, bkeys, spec,
-                    out_capacity=1,
-                    probe_hash_tables=pht, build_hash_tables=bht,
-                    build_code_remaps=remaps, index=index,
-                    exact_layout=layout,
-                )
-                return out
-
-            self._probe_raw = probe_raw
-            self._probe_fn = jax.jit(probe_raw)
-        else:
-            self._probe_raw = None
+        self._lut_fn = lut_fn
+        self._probe_raw = None
+        if not self._fusable:
+            pschema = self.child.output_schema
+            pkeys = self.probe_keys
+            pht = self.probe_hash_tables or None
+            remaps = self.build_code_remaps or None
+            spec = self.spec
 
             @functools.partial(jax.jit, static_argnames=("out_cap",))
             def probe_gen_fn(p, build, index, out_cap):
@@ -849,32 +893,100 @@ class HashJoinOp(OneInputOperator):
             self._probe_gen_fn = probe_gen_fn
             self._out_cap = 0
 
+    def _set_probe(self, kind: str):
+        """Install the probe function for the index strategy chosen at build
+        time. All strategies share the (probe, build_batch, index) calling
+        convention so fusion and the pull path stay uniform."""
+        pschema = self.child.output_schema
+        bschema = self.build.output_schema
+        pkeys, bkeys = self.probe_keys, self.build_keys
+        pht = self.probe_hash_tables or None
+        bht = self.build_hash_tables or None
+        remaps = self.build_code_remaps or None
+        layout = self.exact_layout
+        spec = self.spec
+
+        if kind == "analytic":
+            info = self._analytic
+
+            def probe_raw(p, build, index):
+                fi, fo = join_ops.dense_analytic_probe(
+                    p, pkeys, build, bkeys, info, remaps
+                )
+                return join_ops.emit_unique(p, build, spec, fi, fo)
+        elif kind == "lut":
+
+            def probe_raw(p, build, index):
+                fi, fo = join_ops.dense_lut_probe(p, pkeys, layout, index)
+                return join_ops.emit_unique(p, build, spec, fi, fo)
+        elif spec.build_unique:
+
+            def probe_raw(p, build, index):
+                return join_ops.hash_join_unique(
+                    p, pschema, pkeys, build, bschema, bkeys, spec,
+                    pht, bht, remaps, index=index, exact_layout=layout,
+                )
+        else:  # sorted-index existence probe over duplicate build keys
+
+            def probe_raw(p, build, index):
+                out, _ = join_ops.hash_join_general(
+                    p, pschema, pkeys, build, bschema, bkeys, spec,
+                    out_capacity=1,
+                    probe_hash_tables=pht, build_hash_tables=bht,
+                    build_code_remaps=remaps, index=index,
+                    exact_layout=layout,
+                )
+                return out
+
+        self._probe_raw = probe_raw
+        self._probe_fn = jax.jit(probe_raw)
+
     def _ensure_built(self):
+        from ..utils import settings
         from .memory import Allocator, batch_bytes
 
         if self._built:
             return
-        alloc = Allocator("hash join build")
-        tiles = []
-        for b in _consume_op(self.build, "build_spool"):
-            nb = batch_bytes(b)
-            if alloc.would_exceed(nb):
-                # build side exceeds workmem: swap in the Grace hash join
-                # (both sides hash-partition so each partition's build fits
-                # the budget — disk_spiller.go's in-memory->external swap)
-                from .external import ChainOp, GraceHashJoinOp
-
-                chain = ChainOp(tiles + [b], self.build.output_schema,
-                                self.build.dictionaries, self.build)
-                self._grace = GraceHashJoinOp(
-                    self.child, chain, self.probe_keys, self.build_keys,
-                    self.spec,
-                )
-                self._grace.init()
+        if self._analytic is not None:
+            # position-preserving concat (NO compaction): row i of the build
+            # batch is row i of the table, so key arithmetic addresses it.
+            # No live-count host sync, no workmem spill (the build is the
+            # resident table plus projection-derived columns).
+            tiles = list(_consume_op(self.build, "build_spool"))
+            if tiles:
+                if len(tiles) == 1:
+                    self._build_batch = tiles[0]
+                else:
+                    self._build_batch = jax.tree_util.tree_map(
+                        lambda *xs: jnp.concatenate(xs), *tiles
+                    )
+                self._index = ()
+                self._set_probe("analytic")
                 self._built = True
                 return
-            alloc.reserve(nb)
-            tiles.append(b)
+            tiles = []
+        else:
+            alloc = Allocator("hash join build")
+            tiles = []
+            for b in _consume_op(self.build, "build_spool"):
+                nb = batch_bytes(b)
+                if alloc.would_exceed(nb):
+                    # build side exceeds workmem: swap in the Grace hash join
+                    # (both sides hash-partition so each partition's build
+                    # fits the budget — disk_spiller.go's swap)
+                    from .external import ChainOp, GraceHashJoinOp
+
+                    chain = ChainOp(tiles + [b], self.build.output_schema,
+                                    self.build.dictionaries, self.build)
+                    self._grace = GraceHashJoinOp(
+                        self.child, chain, self.probe_keys, self.build_keys,
+                        self.spec,
+                    )
+                    self._grace.init()
+                    self._built = True
+                    return
+                alloc.reserve(nb)
+                tiles.append(b)
         if not tiles:
             from ..coldata.batch import empty_batch
 
@@ -883,10 +995,27 @@ class HashJoinOp(OneInputOperator):
                 self._build_batch, self.build.output_schema, self.build_keys,
                 self.build_hash_tables or None,
             )
+            if self._fusable:
+                self._set_probe("sorted")
         else:
-            self._build_batch, self._index = self._build_fn(
-                tuple(tiles), cap=_spool_cap(tiles)
+            cap = _spool_cap(tiles)
+            use_lut = (
+                self._fusable
+                and self.exact_layout is not None
+                and self.exact_layout.total_bits
+                <= settings.get("sql.distsql.dense_lut_bits")
             )
+            if use_lut:
+                self._build_batch, self._index = self._lut_fn(
+                    tuple(tiles), cap=cap
+                )
+                self._set_probe("lut")
+            else:
+                self._build_batch, self._index = self._build_fn(
+                    tuple(tiles), cap=cap
+                )
+                if self._fusable:
+                    self._set_probe("sorted")
         self._built = True
 
     def children(self):
@@ -904,7 +1033,7 @@ class HashJoinOp(OneInputOperator):
     def stream_parts(self):
         from ..utils import settings
 
-        if self._probe_raw is None:
+        if not self._fusable:
             return None
         if getattr(self, "_grace", None) is not None:
             return None  # spilled: the Grace join drives the probe itself
@@ -923,7 +1052,8 @@ class HashJoinOp(OneInputOperator):
             return None  # the build spilled while spooling
         src, cfn, cargs = parts
         chain = getattr(self, "_chain_fn", None)
-        if chain is None or getattr(self, "_chain_base", None) is not cfn:
+        if (chain is None or getattr(self, "_chain_base", None) is not cfn
+                or getattr(self, "_chain_raw", None) is not self._probe_raw):
             nc = len(cargs)
             raw = self._probe_raw
 
@@ -932,6 +1062,7 @@ class HashJoinOp(OneInputOperator):
 
             self._chain_fn = chain
             self._chain_base = cfn
+            self._chain_raw = raw
         return src, self._chain_fn, cargs + (self._build_batch, self._index)
 
     def _next(self):

@@ -6,9 +6,11 @@ aggregation detects group boundaries in sorted input. The TPU redesign uses two
 strategies, both static-shape:
 
 1. ``sort_groupby`` — the general path. Sort the tile by the group key columns
-   (XLA sort), detect segment boundaries, reduce with jax.ops.segment_* into a
-   padded output tile. Replaces pointer-chasing hash tables, which TPUs cannot
-   do, with sorts, which they do well.
+   (XLA sort), detect segment boundaries, reduce with segmented associative
+   scans (ops/segscan.py — log-depth fused passes; jax.ops.segment_* lowers
+   to scatter, which serializes on the TPU vector unit at ~100ms/op/1M rows).
+   Replaces pointer-chasing hash tables, which TPUs cannot do, with sorts and
+   scans, which they do well.
 
 2. ``smallgroup_partial_states`` — the MXU/VPU path for planner-known small group
    cardinality G (e.g. TPC-H Q1's returnflag x linestatus = 6): a one-hot
@@ -33,6 +35,7 @@ import numpy as np
 
 from ..coldata.batch import Batch, Column
 from ..coldata.types import FLOAT64, INT64, Family, Schema, SQLType
+from . import segscan
 
 
 @dataclass(frozen=True)
@@ -73,8 +76,11 @@ def _minmax_sentinel(dtype, is_min: bool):
     return jnp.array(info.max if is_min else info.min, dtype)
 
 
-def _segment_agg(spec: AggSpec, col: Column | None, live, seg, cap, t: SQLType | None):
-    """Per-segment reduction -> (data[cap], valid[cap]) given segment ids."""
+def _segment_agg(spec: AggSpec, col: Column | None, live, seg, cap,
+                 t: SQLType | None):
+    """Per-segment reduction -> (data[cap], valid[cap]) given segment ids —
+    the CPU path (XLA:CPU scatters are a cheap serial loop; see
+    segscan.use_scans for the strategy split)."""
     if spec.func == "count_rows":
         data = jax.ops.segment_sum(live.astype(jnp.int64), seg, num_segments=cap)
         return data, jnp.ones((cap,), jnp.bool_)
@@ -84,17 +90,13 @@ def _segment_agg(spec: AggSpec, col: Column | None, live, seg, cap, t: SQLType |
         return data, jnp.ones((cap,), jnp.bool_)
     cnt = jax.ops.segment_sum(contributes.astype(jnp.int32), seg, num_segments=cap)
     nonempty = cnt > 0
-    if spec.func == "sum_f":
+    if spec.func in ("sum_f", "sum_sq"):
         d = col.data.astype(jnp.float64)
         if t is not None and t.family is Family.DECIMAL:
             d = d / (10.0 ** t.scale)
+        if spec.func == "sum_sq":
+            d = d * d
         vals = jnp.where(contributes, d, 0.0)
-        return jax.ops.segment_sum(vals, seg, num_segments=cap), nonempty
-    if spec.func == "sum_sq":
-        d = col.data.astype(jnp.float64)
-        if t is not None and t.family is Family.DECIMAL:
-            d = d / (10.0 ** t.scale)
-        vals = jnp.where(contributes, d * d, 0.0)
         return jax.ops.segment_sum(vals, seg, num_segments=cap), nonempty
     if spec.func in ("sum", "avg"):
         if t.family is Family.FLOAT or spec.func == "avg":
@@ -119,6 +121,62 @@ def _segment_agg(spec: AggSpec, col: Column | None, live, seg, cap, t: SQLType |
         sent = _minmax_sentinel(col.data.dtype, False)
         vals = jnp.where(contributes, col.data, sent)
         return jax.ops.segment_max(vals, seg, num_segments=cap), nonempty
+    raise ValueError(f"unknown aggregate {spec.func}")
+
+
+def _scan_agg_entries(spec: AggSpec, col: Column | None, live,
+                      t: SQLType | None):
+    """Plan one aggregate as segmented-scan work: returns (entries, finish)
+    where entries is a list of (op, row_vals) to scan and finish(*at_slots)
+    maps the scans' per-segment totals (gathered at segment ends) to
+    (data, valid).
+
+    The scans replace jax.ops.segment_* (scatter-lowered on TPU, ~100ms per
+    op per 1M-row tile) with log-depth fused passes (segscan.py)."""
+    add = jnp.add
+
+    if spec.func == "count_rows":
+        return ([(add, live.astype(jnp.int64))],
+                lambda c: (c, jnp.ones_like(c, dtype=jnp.bool_)))
+    contributes = live & col.valid
+    if spec.func == "count":
+        return ([(add, contributes.astype(jnp.int64))],
+                lambda c: (c, jnp.ones_like(c, dtype=jnp.bool_)))
+    cnt_entry = (add, contributes.astype(jnp.int64))
+    if spec.func in ("sum_f", "sum_sq"):
+        d = col.data.astype(jnp.float64)
+        if t is not None and t.family is Family.DECIMAL:
+            d = d / (10.0 ** t.scale)
+        if spec.func == "sum_sq":
+            d = d * d
+        vals = jnp.where(contributes, d, 0.0)
+        return ([cnt_entry, (add, vals)],
+                lambda c, s: (s, c > 0))
+    if spec.func in ("sum", "avg"):
+        if t.family is Family.FLOAT or spec.func == "avg":
+            vals = jnp.where(contributes, col.data.astype(jnp.float64), 0.0)
+
+            def finish_f(c, s):
+                if spec.func != "avg":
+                    return s, c > 0
+                avg = s / jnp.where(c > 0, c, 1).astype(jnp.float64)
+                if t.family is Family.DECIMAL:
+                    avg = avg / (10.0 ** t.scale)
+                return avg, c > 0
+
+            return [cnt_entry, (add, vals)], finish_f
+        vals = jnp.where(contributes, col.data.astype(jnp.int64), 0)
+        return [cnt_entry, (add, vals)], lambda c, s: (s, c > 0)
+    if spec.func in ("min", "max"):
+        is_min = spec.func == "min"
+        sent = _minmax_sentinel(col.data.dtype, is_min)
+        vals = jnp.where(contributes, col.data, sent)
+        op = jnp.minimum if is_min else jnp.maximum
+        return [cnt_entry, (op, vals)], lambda c, s: (s, c > 0)
+    if spec.func == "any_not_null":
+        sent = _minmax_sentinel(col.data.dtype, False)
+        vals = jnp.where(contributes, col.data, sent)
+        return [cnt_entry, (jnp.maximum, vals)], lambda c, s: (s, c > 0)
     raise ValueError(f"unknown aggregate {spec.func}")
 
 
@@ -179,19 +237,53 @@ def sort_groupby(
     prev_live = jnp.roll(live_s, 1)
     boundary = live_s & ((idx == 0) | changed | ~prev_live)
     num_groups = jnp.sum(boundary, dtype=jnp.int32)
-    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    seg = jnp.maximum(seg, 0)
 
     out_cols: list[Column] = []
     out_mask = jnp.arange(cap_out, dtype=jnp.int32) < num_groups
 
-    # Group key columns: scatter the boundary row's key into its segment slot.
-    dest = jnp.where(boundary, seg, cap_out)
-    for kd, kv in keys_s:
-        data = jnp.zeros((cap_out,), kd.dtype).at[dest].set(kd, mode="drop")
-        valid = jnp.zeros((cap_out,), jnp.bool_).at[dest].set(kv, mode="drop")
-        out_cols.append(Column(data=data, valid=valid))
+    if not segscan.use_scans():
+        # CPU: scatter the boundary row's key into its segment slot and
+        # reduce with jax.ops.segment_* (XLA:CPU scatters are a cheap serial
+        # loop; 20 log-depth scan passes are not — segscan.use_scans).
+        seg = jnp.maximum(jnp.cumsum(boundary.astype(jnp.int32)) - 1, 0)
+        dest = jnp.where(boundary, seg, cap_out)
+        for kd, kv in keys_s:
+            data = jnp.zeros(
+                (cap_out,) + kd.shape[1:], kd.dtype
+            ).at[dest].set(kd, mode="drop")
+            valid = jnp.zeros((cap_out,), jnp.bool_).at[dest].set(
+                kv, mode="drop"
+            )
+            out_cols.append(Column(data=data, valid=valid))
+        for spec in aggs:
+            col = None
+            t = None
+            if spec.col is not None:
+                t = schema.types[spec.col]
+                col = Column(
+                    data=batch.cols[spec.col].data[perm],
+                    valid=batch.cols[spec.col].valid[perm],
+                )
+            data, valid = _segment_agg(spec, col, live_s, seg, cap_out, t)
+            out_cols.append(Column(data=data, valid=valid & out_mask))
+        return Batch(cols=tuple(out_cols), mask=out_mask), num_groups
 
+    # TPU: segment j's total lives at its END row after an inclusive
+    # segmented scan; compacting the end rows to the front (one stable sort)
+    # puts segment j's end at position j — scatter-free slot assignment.
+    ends = segscan.seg_ends(boundary, live_s)
+    slot_idx = segscan.compact_to_slots(ends, cap_out)
+
+    # Group key columns: gather the end row's keys (same segment, same key).
+    for kd, kv in keys_s:
+        g = kd[slot_idx]
+        m = out_mask if g.ndim == 1 else out_mask[:, None]  # BYTES: [cap, W]
+        data = jnp.where(m, g, jnp.zeros_like(g))
+        out_cols.append(Column(data=data, valid=kv[slot_idx] & out_mask))
+
+    # One fused multi-scan covers every aggregate's per-segment reduction.
+    entries: list = []
+    finishers: list = []
     for spec in aggs:
         col = None
         t = None
@@ -201,7 +293,17 @@ def sort_groupby(
                 data=batch.cols[spec.col].data[perm],
                 valid=batch.cols[spec.col].valid[perm],
             )
-        data, valid = _segment_agg(spec, col, live_s, seg, cap_out, t)
+        es, finish = _scan_agg_entries(spec, col, live_s, t)
+        finishers.append((len(entries), len(es), finish))
+        entries.extend(es)
+    if entries:
+        scanned = segscan.seg_scan_multi(
+            [op for op, _ in entries], [v for _, v in entries], boundary
+        )
+        at_slots = [s[slot_idx] for s in scanned]
+    for start, n, finish in finishers:
+        data, valid = finish(*at_slots[start:start + n])
+        data = jnp.where(out_mask, data, jnp.zeros_like(data[:1]))
         out_cols.append(Column(data=data, valid=valid & out_mask))
 
     return Batch(cols=tuple(out_cols), mask=out_mask), num_groups

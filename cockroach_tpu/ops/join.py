@@ -137,6 +137,127 @@ def exact_keys(
     return jnp.where(active, k, _SENTINEL), active
 
 
+# ---------------------------------------------------------------------------
+# Dense direct addressing
+#
+# The reference's hash table (colexechash) exists because Go can chase
+# pointers; the first TPU design replaced it with sort + unrolled binary
+# search (log2(n) dependent gathers per probe — ~20 x 7.5ms per 1M-row tile
+# on v5e, the measured join bottleneck). When the build key's VALUE RANGE is
+# dense, addressing is direct instead:
+#
+# - 'analytic': the build side is a position-preserving chain over a resident
+#   table whose key column IS (an offset of) the row index — true for every
+#   TPC-H PK (o_orderkey = 1..N, p_partkey = 1..N, ...) and for clustered
+#   child tables (partsupp: 4 rows per part, contiguous). Probe cost: ONE
+#   gather of the build liveness mask (+ fanout-1 verification gathers).
+#   Build cost: ZERO — no sort, no spool sync, no hash table at all.
+# - 'lut': the packed exact key (plan_exact_key) fits in few bits; a dense
+#   int32 position table is scatter-built ONCE from the (compacted, usually
+#   small) build spool. Probe cost: one gather. Build cost: one scatter of
+#   build-side size.
+#
+# Both paths are exact (no hash, no collision handling): key equality is
+# index equality by construction.
+
+
+@dataclass(frozen=True)
+class DenseAnalytic:
+    """Probe row index = (first_key - key_lo) * fanout + j, j in [0, fanout).
+    verify: remaining key positions needing equality checks (all but the
+    first when fanout > 1 or multi-column keys)."""
+
+    key_lo: int
+    fanout: int
+    build_rows: int  # fanout * number-of-distinct-first-keys (live prefix)
+
+
+def dense_analytic_probe(
+    probe: Batch,
+    probe_keys: tuple[int, ...],
+    build: Batch,
+    build_keys: tuple[int, ...],
+    info: DenseAnalytic,
+    build_code_remaps=None,
+):
+    """(found_idx, found) for unique-build joins via direct addressing."""
+    k0 = probe.cols[probe_keys[0]]
+    base = (k0.data.astype(jnp.int64) - info.key_lo) * info.fanout
+    active = probe.mask & k0.valid
+    in_range = active & (base >= 0) & (base < info.build_rows)
+    base_c = jnp.clip(base, 0, build.capacity - 1).astype(jnp.int32)
+    rest_p = probe_keys[1:]
+    rest_b = build_keys[1:]
+    rest_remaps = None
+    if build_code_remaps:
+        rest_remaps = {
+            pos - 1: r for pos, r in build_code_remaps.items() if pos >= 1
+        }
+    found = jnp.zeros((probe.capacity,), jnp.bool_)
+    found_idx = jnp.zeros((probe.capacity,), jnp.int32)
+    for j in range(info.fanout):
+        idx = jnp.minimum(base_c + j, build.capacity - 1)
+        ok = in_range & build.mask[idx]
+        if rest_p:
+            ok = ok & _keys_equal(
+                probe, rest_p, build, rest_b, idx, rest_remaps
+            )
+        found_idx = jnp.where(ok & ~found, idx, found_idx)
+        found = found | ok
+    return found_idx, found
+
+
+def build_dense_lut(
+    build: Batch,
+    build_keys: tuple[int, ...],
+    layout: ExactKeyLayout,
+    exact_remaps=None,
+) -> jax.Array:
+    """[2**total_bits] int32 build positions (-1 absent). Dead/NULL rows
+    carry the u64 sentinel key and drop out of the scatter."""
+    bk, _ = exact_keys(build, build_keys, layout, exact_remaps)
+    lut = jnp.full((1 << layout.total_bits,), -1, jnp.int32)
+    pos = jnp.arange(build.capacity, dtype=jnp.int32)
+    return lut.at[bk].set(pos, mode="drop")
+
+
+def dense_lut_probe(
+    probe: Batch,
+    probe_keys: tuple[int, ...],
+    layout: ExactKeyLayout,
+    lut: jax.Array,
+):
+    """(found_idx, found): one gather; packed-key equality IS key equality."""
+    ph, p_active = exact_keys(probe, probe_keys, layout)
+    size = lut.shape[0]
+    phc = jnp.clip(ph, jnp.uint64(0), jnp.uint64(size - 1)).astype(jnp.int32)
+    idx = lut[phc]
+    found = p_active & (ph < size) & (idx >= 0)
+    return jnp.maximum(idx, 0), found
+
+
+def emit_unique(probe: Batch, build: Batch, spec: JoinSpec,
+                found_idx, found) -> Batch:
+    """Probe-aligned emission shared by every unique-build probe strategy
+    (dense analytic / dense LUT / sorted bsearch)."""
+    if spec.join_type == "semi":
+        return probe.with_mask(probe.mask & found)
+    if spec.join_type == "anti":
+        return probe.with_mask(probe.mask & ~found)
+    bcols = tuple(
+        Column(data=c.data[found_idx], valid=c.valid[found_idx] & found)
+        for c in build.cols
+    )
+    cols = probe.cols + bcols
+    if spec.join_type == "inner":
+        mask = probe.mask & found
+    elif spec.join_type == "left":
+        mask = probe.mask
+    else:
+        raise ValueError(f"unsupported join type {spec.join_type}")
+    return Batch(cols=cols, mask=mask)
+
+
 def bsearch(sorted_u64: jax.Array, queries: jax.Array,
             side: str = "left") -> jax.Array:
     """Branchless UNROLLED binary search (log2(n) static gather+select
@@ -289,23 +410,7 @@ def hash_join_unique(
         # guard against sentinel-hash self-matches
         found = found & p_active & build.mask[found_idx]
 
-    if spec.join_type == "semi":
-        return probe.with_mask(probe.mask & found)
-    if spec.join_type == "anti":
-        return probe.with_mask(probe.mask & ~found)
-
-    bcols = tuple(
-        Column(data=c.data[found_idx], valid=c.valid[found_idx] & found)
-        for c in build.cols
-    )
-    cols = probe.cols + bcols
-    if spec.join_type == "inner":
-        mask = probe.mask & found
-    elif spec.join_type == "left":
-        mask = probe.mask
-    else:
-        raise ValueError(f"unsupported join type {spec.join_type}")
-    return Batch(cols=cols, mask=mask)
+    return emit_unique(probe, build, spec, found_idx, found)
 
 
 def hash_join_general(

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import segscan
 from .keys import key_words, words_cmp_eq, words_in_range
 
 _BIG = np.int32(2**31 - 1)
@@ -151,22 +152,37 @@ def merge_blocks(blocks: tuple[KVBlock, ...], cap: int) -> KVBlock:
 # The scan-filter kernel
 
 
-def _segments(block: KVBlock) -> jax.Array:
-    """Segment id per row: consecutive rows with equal keys share an id.
-    Requires the block sorted by key."""
+def _key_boundaries(block: KVBlock, window: int | None = None) -> jax.Array:
+    """True on the first row of each key run (block sorted by key). With
+    `window`, every multiple-of-window position also starts a segment —
+    the multi-scan kernel packs independent scan windows side by side and
+    must not let a key run bleed across a window edge."""
     words = key_words(block.key)
     same = words_cmp_eq(words[1:], words[:-1]) & block.mask[1:] & block.mask[:-1]
     boundary = jnp.concatenate([jnp.ones((1,), jnp.bool_), ~same])
-    return jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    if window:
+        pos = jnp.arange(block.capacity, dtype=jnp.int32)
+        boundary = boundary | (pos % window == 0)
+    return boundary
 
 
-@jax.jit
+def _seg_bcast(op, vals, boundary, live):
+    """Per-segment total of `vals` under `op`, broadcast to every row of the
+    segment. Backend-adaptive (ops/segscan.py): segmented scans on TPU
+    (scatter serializes on the VPU, ~100ms per 1M-row op), segment_* on CPU
+    (where scatter is a cheap serial loop and 20 scan passes are not)."""
+    segop = jax.ops.segment_min if op is jnp.minimum else jax.ops.segment_max
+    return segscan.seg_bcast(op, segop, vals, boundary, live)
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
 def mvcc_scan_filter(
     block: KVBlock,
     read_ts: jax.Array,
     reader_txn: jax.Array,
     start_words: jax.Array | None = None,
     end_words: jax.Array | None = None,
+    window: int | None = None,
 ):
     """Newest-visible-version selection over a sorted block.
 
@@ -177,11 +193,14 @@ def mvcc_scan_filter(
       conflict : [cap] bool — intents of *other* txns at ts <= read_ts that
                  shadow the read (WriteIntentError rows; pebble_mvcc_scanner
                  accumulates these the same way)
+
+    `window` (static) segments the block into independent scan windows
+    (scan_batch packs one scan per window).
     """
     cap = block.capacity
     words = key_words(block.key)
     in_range = block.mask & words_in_range(words, start_words, end_words)
-    seg = _segments(block)
+    boundary = _key_boundaries(block, window)
 
     own = block.txn == reader_txn
     committed = block.txn == 0
@@ -191,8 +210,8 @@ def mvcc_scan_filter(
 
     pos = jnp.arange(cap, dtype=jnp.int32)
     cand_pos = jnp.where(visible, pos, _BIG)
-    first = jax.ops.segment_min(cand_pos, seg, num_segments=cap)
-    newest = visible & (pos == first[seg])
+    first = _seg_bcast(jnp.minimum, cand_pos, boundary, block.mask)
+    newest = visible & (pos == first)
 
     # an *other-txn* intent visible to this read shadows any selected version
     # at-or-below it — that's a conflict, not a silent skip
@@ -205,7 +224,7 @@ def mvcc_scan_filter(
     # conflicts only matter if they are the newest candidate or newer than it:
     # since rows are ts-desc, an intent above `first` within the segment
     # conflicts; one below `first` is shadowed and irrelevant.
-    conflict = conflict & (pos <= first[seg])
+    conflict = conflict & (pos <= first)
 
     selected = newest & ~block.tomb
     return selected, conflict
@@ -223,13 +242,13 @@ def mvcc_gc_filter(block: KVBlock, gc_ts: jax.Array, bottom: bool):
       level).
     """
     cap = block.capacity
-    seg = _segments(block)
+    boundary = _key_boundaries(block)
     pos = jnp.arange(cap, dtype=jnp.int32)
 
     old = block.mask & (block.txn == 0) & (block.ts <= gc_ts)
     cand_pos = jnp.where(old, pos, _BIG)
-    first_old = jax.ops.segment_min(cand_pos, seg, num_segments=cap)
-    newest_old = old & (pos == first_old[seg])
+    first_old = _seg_bcast(jnp.minimum, cand_pos, boundary, block.mask)
+    newest_old = old & (pos == first_old)
 
     keep = block.mask & (
         (block.txn != 0) | (block.ts > gc_ts) | newest_old
@@ -238,10 +257,104 @@ def mvcc_gc_filter(block: KVBlock, gc_ts: jax.Array, bottom: bool):
         # elide a kept tombstone when it is the oldest surviving row of its
         # key (nothing below it to shadow)
         keep_pos = jnp.where(keep, pos, -1)
-        last_keep = jax.ops.segment_max(keep_pos, seg, num_segments=cap)
-        elide = keep & block.tomb & newest_old & (pos == last_keep[seg])
+        last_keep = _seg_bcast(jnp.maximum, keep_pos, boundary, block.mask)
+        elide = keep & block.tomb & newest_old & (pos == last_keep)
         keep = keep & ~elide
     return keep
+
+
+# ---------------------------------------------------------------------------
+# Batched multi-scan (the kv Streamer analog)
+
+
+def _lex_lt(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Lexicographic a < b over trailing word lanes ([..., W] uint64)."""
+    lt = jnp.zeros(a.shape[:-1], jnp.bool_)
+    gt = jnp.zeros(a.shape[:-1], jnp.bool_)
+    for w in range(a.shape[-1]):
+        aw, bw = a[..., w], b[..., w]
+        undecided = ~lt & ~gt
+        lt = lt | (undecided & (aw < bw))
+        gt = gt | (undecided & (aw > bw))
+    return lt
+
+
+def seek_positions(
+    view_words: jax.Array, query_words: jax.Array, n_live: jax.Array
+) -> jax.Array:
+    """First LIVE row position with key >= query, per query — the iterator
+    SeekGE over the sorted view, as an unrolled branchless binary search
+    (the same shape as ops/join.bsearch, lifted to multi-word keys).
+
+    Dead rows sort past the live prefix but hold zero key bytes (they'd
+    compare below every real key), so the search is clamped to n_live."""
+    n = view_words.shape[0]
+    bits = max(1, int(n).bit_length())
+    pos = jnp.zeros(query_words.shape[:-1], jnp.int32)
+    for sb in range(bits - 1, -1, -1):
+        cand = pos + (1 << sb)
+        rows = view_words[jnp.clip(cand - 1, 0, n - 1)]
+        ok = (cand <= n_live) & _lex_lt(rows, query_words)
+        pos = jnp.where(ok, cand, pos)
+    return pos
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def multi_scan(
+    view: KVBlock,
+    starts_words: jax.Array,  # [B, W] uint64 start-key word lanes
+    read_ts: jax.Array,
+    reader_txn: jax.Array,
+    window: int,
+):
+    """B independent forward scans against ONE sorted view in ONE device
+    pass — the TPU answer to per-scan iterator re-seeks (reference analog:
+    pkg/kv/kvclient/kvstreamer batching many spans into one storage trip).
+
+    Each scan b seeks its start position and claims a `window`-row slice;
+    mvcc_scan_filter runs over the [B*window] packed block with window
+    boundaries so key runs cannot bleed between scans. Rows at/past a
+    truncated window's last key are withheld (their version set may be cut
+    — the pebbleMVCCScanner pagination rule); the caller grows `window`
+    geometrically while any scan is both truncated and short.
+
+    Returns (win, sel, conflict, complete, truncated) — win is the packed
+    [B*window] block; counts/emission stay host-side. truncated[b] means
+    scan b's window did not reach the end of the view (more keys exist past
+    it), so a short result must grow the window rather than terminate —
+    even when the whole window was tombstones (sel all-False)."""
+    n = view.capacity
+    vwords = key_words(view.key)
+    n_live = jnp.sum(view.mask, dtype=jnp.int32)
+    lo = seek_positions(vwords, starts_words, n_live)  # [B]
+
+    c = jnp.arange(window, dtype=jnp.int32)
+    idx = lo[:, None] + c[None, :]  # [B, window]
+    valid = idx < n_live
+    idxc = jnp.clip(idx, 0, n - 1).reshape(-1)
+
+    win = KVBlock(
+        key=view.key[idxc],
+        ts=view.ts[idxc],
+        seq=view.seq[idxc],
+        txn=view.txn[idxc],
+        tomb=view.tomb[idxc],
+        value=view.value[idxc],
+        vlen=view.vlen[idxc],
+        mask=view.mask[idxc] & valid.reshape(-1),
+    )
+    sel, conflict = mvcc_scan_filter(
+        win, read_ts, reader_txn, window=window
+    )
+
+    # completeness: a truncated window withholds rows at/past its cut key
+    truncated = (lo + window) < n_live  # [B]
+    cut_idx = jnp.clip(lo + window - 1, 0, n - 1)
+    cut_words = vwords[cut_idx]  # [B, W]
+    wwords = key_words(win.key).reshape(starts_words.shape[0], window, -1)
+    below_cut = _lex_lt(wwords, cut_words[:, None, :])
+    complete = (~truncated[:, None]) | below_cut  # [B, window]
+    return win, sel, conflict, complete.reshape(-1), truncated
 
 
 # ---------------------------------------------------------------------------

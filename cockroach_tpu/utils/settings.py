@@ -165,6 +165,14 @@ WORKMEM_BYTES = register_int(
     "operator variant (disk_spiller.go:103)",
     lo=1 << 16,
 )
+DENSE_LUT_BITS = register_int(
+    "sql.distsql.dense_lut_bits", 24,
+    "max packed-key bits for the dense direct-addressing join index "
+    "(ops/join.py): probes become one gather instead of a log2(n) binary "
+    "search. 24 bits = a 64MiB int32 position table, far cheaper than the "
+    "probe gathers it saves on any TPC-H-scale join",
+    lo=0, hi=30,
+)
 SCAN_STREAM_ROWS = register_int(
     "sql.distsql.scan_stream_rows", 1 << 23,
     "tables larger than this stream host->device tile by tile with "
