@@ -90,8 +90,12 @@ class KVTable:
             raise ValueError(
                 f"engine val_width {db.engine.val_width} < row width {need}"
             )
-        # snapshot timestamp for reads; None = now() at device_batch time
+        # snapshot timestamp for reads; None = now() at device_batch time.
+        # reader_txn makes columnar scans run AS a transaction: its own
+        # intents are visible, other txns' intents conflict (the session's
+        # explicit-txn SELECT path sets both around each statement)
         self.read_ts: int | None = None
+        self.reader_txn: int = 0
         # STRING columns: dictionary-coded in the value slots; the mapping
         # persists in a companion key space of the same engine
         self._string_cols = tuple(
@@ -355,7 +359,7 @@ class KVTable:
         sw = K.encode_bound(start, eng.key_width)
         ew = K.encode_bound(end, eng.key_width)
         sel, conflict = mvcc.mvcc_scan_filter(
-            view, jnp.int64(ts), jnp.int64(0),
+            view, jnp.int64(ts), jnp.int64(self.reader_txn),
             jnp.asarray(sw), jnp.asarray(ew),
         )
         cnp = np.asarray(conflict)

@@ -66,6 +66,15 @@ class Txn:
     def on_commit(self, cb) -> None:
         self._commit_hooks.append(cb)
 
+    def note_read_span(self, start: bytes, end: bytes | None,
+                       point: bool = False) -> None:
+        """Record an externally-performed read (e.g. a columnar table scan
+        executed at this txn's snapshot) so commit-time refresh validation
+        covers it — the span-refresher contract for reads that bypass
+        Txn.get/scan."""
+        self._check_open()
+        self._read_spans.append((start, end, point))
+
     # -- reads --------------------------------------------------------------
 
     def get(self, key: bytes | str) -> bytes | None:

@@ -46,6 +46,18 @@ class BindError(Exception):
 # helpers
 
 
+def _positional(seq, numlit) -> str:
+    """ORDER BY <position>: 1-based, bounds-checked (0 would silently hit
+    Python's negative indexing). seq: output names or (name, expr) items."""
+    pos = int(numlit.value)
+    if pos < 1 or pos > len(seq):
+        raise BindError(
+            f"ORDER BY position {pos} is out of range (1..{len(seq)})"
+        )
+    item = seq[pos - 1]
+    return item if isinstance(item, str) else item[0]
+
+
 def _conjuncts(e: P.Node | None) -> list[P.Node]:
     if e is None:
         return []
@@ -431,6 +443,8 @@ class Binder:
         self.ctes: dict[str, Rel] = {}
 
     def bind(self, sel: P.Select) -> Rel:
+        if sel.set_ops:
+            return self._bind_set_ops(sel)
         for name, csel in sel.ctes:
             # CTEs bind once; every reference shares the one plan subtree
             # (the distributed lowering memoizes shared subtrees, so a CTE
@@ -513,6 +527,43 @@ class Binder:
                     self._lower_with_subqueries(lower, c))
 
         return self._finish(sel, joined.rel, resolver)
+
+    def _bind_set_ops(self, sel: P.Select) -> Rel:
+        """UNION [ALL] chain (left-associative; non-ALL steps deduplicate,
+        SQL set semantics). ORDER BY / LIMIT on `sel` apply to the WHOLE
+        union (the parser hoists a trailing arm's order/limit up).
+        Reference surface: sql.y set operations -> UnionClause."""
+        import dataclasses as _dc
+
+        # CTEs scope over EVERY arm: register them on this binder first,
+        # then bind each arm with the shared registry
+        for name, csel in sel.ctes:
+            self.ctes[name] = self.bind(csel)
+        base = _dc.replace(sel, set_ops=(), order_by=(), limit=None,
+                           offset=0, ctes=())
+        rel = self.bind(base)
+        for is_all, arm in sel.set_ops:
+            arm_rel = self.bind(arm)
+            rel = rel.union_all(arm_rel)
+            if not is_all:
+                rel = rel.distinct()
+        keys = []
+        for o in sel.order_by:
+            if isinstance(o.expr, P.Ident) and o.expr.name in rel.schema.names:
+                keys.append((o.expr.name, o.desc))
+            elif isinstance(o.expr, P.NumLit):
+                keys.append(
+                    (_positional(rel.schema.names, o.expr), o.desc))
+            else:
+                raise BindError(
+                    "UNION ORDER BY must name an output column or position"
+                )
+        if keys:
+            rel = rel.sort(keys)
+        if sel.limit is not None or sel.offset:
+            rel = rel.limit(sel.limit if sel.limit is not None else (1 << 62),
+                            sel.offset)
+        return rel
 
     @staticmethod
     def _make_resolver(scope: Scope, joined: "BoundQuery"):
@@ -641,7 +692,17 @@ class Binder:
                 elif ri in placed and li not in placed:
                     cand.setdefault(li, []).append((colmap[(ri, rp)], lp))
             if not cand:
-                raise BindError("cross join required but not supported")
+                # no equi edge reaches the remaining sources: cartesian
+                # product with the smallest one (crossJoiner role)
+                nxt = min((i for i in range(n) if i not in placed),
+                          key=lambda i: sizes[i])
+                off = len(rel.schema)
+                nb = len(sources[nxt].rel.schema)
+                rel = rel.cross_join(sources[nxt].rel)
+                for p in range(nb):
+                    colmap[(nxt, p)] = off + p
+                placed.add(nxt)
+                continue
             # smallest build side first
             nxt = min(cand, key=lambda i: sizes[i])
             on = cand[nxt]  # (probe joined POSITION, build local POSITION)
@@ -663,19 +724,48 @@ class Binder:
             arg = node.arg
             if not isinstance(arg, P.Ident):
                 raise BindError("IN (SELECT) argument must be a column")
-            if how == "anti":
-                # NOT IN is only a plain anti join when neither side can be
-                # NULL (a NULL in the subquery result empties the output; a
-                # NULL probe key is never returned under three-valued
-                # logic, but an anti join returns it). Prove non-nullability
-                # at bind time or refuse, as the reference's optbuilder adds
-                # NULL checks before using anti join.
-                self._require_non_nullable(arg, scope, "NOT IN argument")
-                self._require_inner_non_nullable(node.select)
             resolver = self._make_resolver(scope, joined)
             outer_pos = (resolver(arg) if resolver is not None
                          else joined.rel.idx(arg.name))
             inner_col = sub.schema.names[0]
+            if how == "anti":
+                # NOT IN under three-valued logic: a NULL in the subquery
+                # empties the output; a NULL probe key is not-true (dropped)
+                # — EXCEPT against an empty subquery, where x NOT IN () is
+                # TRUE for every x including NULL. A plain anti join gets
+                # only the last case right. When bind-time analysis proves
+                # both sides non-nullable, the anti join is exact; otherwise
+                # evaluate the (uncorrelated) subquery once and pick the
+                # branch, the way the reference's optbuilder wraps NOT IN in
+                # null-rejecting projections (pkg/sql/opt/optbuilder).
+                nullable = True
+                try:
+                    self._require_non_nullable(arg, scope, "NOT IN argument")
+                    self._require_inner_non_nullable(node.select)
+                    nullable = False
+                except BindError:
+                    pass
+                if nullable:
+                    # bind-time evaluation of the (uncorrelated) subquery —
+                    # the same eager-execution precedent as scalar
+                    # subqueries; the anti join below re-runs the sub plan,
+                    # an accepted double execution for this rare shape
+                    vals = sub.run()[inner_col]
+                    n_sub = len(vals)
+                    has_null = (vals.dtype == object
+                                and any(v is None for v in vals))
+                    if has_null:
+                        # never-true — but keep the anti join in the plan
+                        # (below) so the subquery's table scans stay
+                        # visible to in-txn read-span tracking
+                        joined.rel = joined.rel.filter(ex.lit(False))
+                    elif n_sub > 0:
+                        # drop NULL probe keys, then anti join
+                        joined.rel = joined.rel.filter(
+                            ex.Not(ex.IsNull(ex.ColRef(outer_pos)))
+                        )
+                    # empty subquery: plain anti join keeps every row
+                    # (including NULL keys) — exactly NOT IN () = TRUE
             joined.rel = joined.rel.join(
                 sub, on=[(outer_pos, inner_col)], how=how, build_unique=False
             )
@@ -1101,7 +1191,7 @@ class Binder:
             if o.expr in expr_names:
                 order_keys.append((expr_names[o.expr], o.desc))
             elif isinstance(o.expr, P.NumLit):
-                order_keys.append((items[int(o.expr.value) - 1][0], o.desc))
+                order_keys.append((_positional(items, o.expr), o.desc))
             elif (isinstance(o.expr, P.Ident)
                   and o.expr.name in {n for n, _ in items}):
                 order_keys.append((o.expr.name, o.desc))
@@ -1262,7 +1352,7 @@ class Binder:
             if o.expr in expr_names:
                 order_keys.append((expr_names[o.expr], o.desc))
             elif isinstance(o.expr, P.NumLit):
-                order_keys.append((post[int(o.expr.value) - 1][0], o.desc))
+                order_keys.append((_positional(post, o.expr), o.desc))
             elif isinstance(o.expr, P.Ident) and o.expr.name in out_names:
                 order_keys.append((o.expr.name, o.desc))
             elif (isinstance(o.expr, P.Ident)
@@ -1285,8 +1375,14 @@ class Binder:
     def _lower_agg_expr(self, g: Rel, e: P.Node, aggs, group_items,
                         name_ok: bool = False) -> ex.Expr:
         """Lower an expression over the groupby output: aggregate calls become
-        references to their output columns."""
+        references to their output columns, and any (sub)expression that IS a
+        group-by expression references its group column (GROUP BY b * 2 with
+        SELECT b * 2 must read the computed key, not re-derive it from
+        columns the groupby output no longer carries)."""
         e = _fold(e)
+        for gname, gexpr in group_items:
+            if e == gexpr:
+                return ex.ColRef(g.idx(gname))
         if isinstance(e, P.FuncCall) and e.name in AGG_FUNCS:
             return ex.ColRef(g.idx(aggs[e]))
         if isinstance(e, P.Ident):
@@ -1340,7 +1436,7 @@ class Binder:
                         order_keys.append((o.expr.name, o.desc))
                     elif isinstance(o.expr, P.NumLit):
                         order_keys.append(
-                            (rel.schema.names[int(o.expr.value) - 1], o.desc))
+                            (_positional(rel.schema.names, o.expr), o.desc))
                     else:
                         raise BindError(f"cannot order by {o.expr}")
             rel = rel.sort(order_keys)

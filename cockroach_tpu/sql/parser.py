@@ -292,6 +292,10 @@ class Select(Node):
     offset: int = 0
     distinct: bool = False
     ctes: tuple[tuple[str, "Select"], ...] = ()  # WITH name AS (select)
+    # UNION [ALL] arms, left-associative: (is_all, select). ORDER BY /
+    # LIMIT on a Select that has set_ops apply to the WHOLE union (the
+    # parser hoists a trailing arm's order/limit up here).
+    set_ops: tuple[tuple[bool, "Select"], ...] = ()
 
 
 # ---------------------------------------------------------------------------
@@ -475,6 +479,39 @@ class Parser:
         return s
 
     def parse_select(self) -> Select:
+        """One select, plus any UNION [ALL] chain (left-associative). A
+        trailing ORDER BY / LIMIT parsed into the LAST arm is hoisted to
+        the union level (SQL: they order/limit the whole set operation)."""
+        s = self.parse_select_one()
+        arms: list[tuple[bool, Select]] = []
+        while self.eat_kw("union"):
+            is_all = bool(self.eat_kw("all"))
+            arms.append((is_all, self.parse_select_one()))
+        if not arms:
+            return s
+        # only the LAST arm's trailing ORDER BY/LIMIT is the union's;
+        # order/limit on any earlier arm needs parentheses (postgres
+        # rejects the unparenthesized form too — accepting it silently
+        # would truncate the whole union to the first arm's LIMIT)
+        if s.order_by or s.limit is not None or s.offset:
+            raise SyntaxError(
+                "ORDER BY/LIMIT on a UNION arm requires parentheses; "
+                "a trailing ORDER BY/LIMIT applies to the whole union"
+            )
+        order_by: tuple = ()
+        limit = None
+        offset = 0
+        last_all, last = arms[-1]
+        if last.order_by or last.limit is not None or last.offset:
+            order_by, limit, offset = last.order_by, last.limit, last.offset
+            arms[-1] = (last_all, dataclasses.replace(
+                last, order_by=(), limit=None, offset=0))
+        return dataclasses.replace(
+            s, set_ops=tuple(arms), order_by=order_by, limit=limit,
+            offset=offset,
+        )
+
+    def parse_select_one(self) -> Select:
         self.expect_kw("select")
         distinct = bool(self.eat_kw("distinct"))
         self.eat_kw("all")
@@ -713,13 +750,20 @@ class Parser:
             self.expect_op(")")
             return Extract(part, arg)
         if self.at_kw("substring"):
+            # both standard forms: substring(s FROM i FOR n) and the
+            # function-call shape substring(s, i, n)
             self.next()
             self.expect_op("(")
             arg = self.parse_expr()
-            self.expect_kw("from")
-            start = int(self.next().value)
-            self.expect_kw("for")
-            ln = int(self.next().value)
+            if self.eat_kw("from"):
+                start = int(self.next().value)
+                self.expect_kw("for")
+                ln = int(self.next().value)
+            else:
+                self.expect_op(",")
+                start = int(self.next().value)
+                self.expect_op(",")
+                ln = int(self.next().value)
             self.expect_op(")")
             return FuncCall("substring", (arg, NumLit(start), NumLit(ln)))
         if self.eat_op("("):

@@ -28,6 +28,8 @@ from ..catalog import Catalog
 from ..coldata import types as T
 from ..kv import DB, Clock
 from ..kv.table import KVTable, create_kv_table
+from ..kv.txn import TransactionRetryError
+from ..storage.lsm import WriteIntentError
 from ..storage import rowcodec
 from ..storage.lsm import Engine
 from . import parser as P
@@ -81,10 +83,32 @@ class Session:
             from ..kv.table import load_catalog_from_engine
 
             load_catalog_from_engine(self.catalog, self.db)
+        # explicit-transaction state machine: NoTxn (_txn None) / Open /
+        # Aborted (_txn_aborted — only ROLLBACK/COMMIT leave it)
+        self._txn = None
+        self._txn_aborted = False
 
     # -- dispatch ------------------------------------------------------------
 
     def execute(self, text: str):
+        handled = self._maybe_txn_stmt(text)
+        if handled is not None:
+            return handled
+        if self._txn_aborted:
+            raise BindError(
+                "current transaction is aborted, commands ignored until "
+                "end of transaction block (issue ROLLBACK)"
+            )
+        try:
+            return self._dispatch(text)
+        except BaseException:
+            # ANY failure inside an explicit block aborts it (postgres /
+            # CRDB: subsequent statements are rejected until ROLLBACK)
+            if self._txn is not None:
+                self._txn_aborted = True
+            raise
+
+    def _dispatch(self, text: str):
         handled = self._maybe_settings_stmt(text)
         if handled is None:
             handled = self._maybe_admin_stmt(text)
@@ -92,8 +116,12 @@ class Session:
             return handled
         stmt = P.parse_statement(text)
         if isinstance(stmt, P.Select):
-            return Binder(self.catalog).bind(stmt).run()
+            return self._select(stmt)
         if isinstance(stmt, P.CreateTable):
+            if self._txn is not None:
+                raise BindError(
+                    "DDL inside an explicit transaction is not supported"
+                )
             return self._create_table(stmt)
         if isinstance(stmt, P.Insert):
             return self._insert(stmt)
@@ -102,6 +130,131 @@ class Session:
         if isinstance(stmt, P.Delete):
             return self._delete(stmt)
         raise BindError(f"unsupported statement {type(stmt).__name__}")
+
+    # -- explicit transactions (the conn_executor txn state machine,
+    # reference: pkg/sql/conn_executor.go:2323 + conn_fsm.go, reduced to
+    # NoTxn / Open / Aborted) ------------------------------------------------
+
+    def _maybe_txn_stmt(self, text: str):
+        import re as _re
+
+        t = text.strip().rstrip(";").lower()
+        if _re.match(r"^(begin|start)(\s+transaction)?$", t):
+            if self._txn is not None:
+                raise BindError("there is already a transaction in progress")
+            self._txn = self.db.new_txn()
+            self._txn_aborted = False
+            return {"begin": True}
+        if _re.match(r"^(commit|end)(\s+transaction)?$", t):
+            if self._txn is None:
+                return {"warning": "there is no transaction in progress"}
+            txn, self._txn = self._txn, None
+            if self._txn_aborted:
+                # COMMIT of an aborted txn rolls back (postgres semantics)
+                self._txn_aborted = False
+                txn.rollback()
+                return {"rollback": True}
+            # a commit-time refresh failure rolls back inside commit() and
+            # raises the retryable error (CRDB 40001 shape): the client
+            # must restart the whole block
+            txn.commit()
+            return {"commit": True}
+        if _re.match(r"^(rollback|abort)(\s+transaction)?$", t):
+            if self._txn is None:
+                return {"warning": "there is no transaction in progress"}
+            txn, self._txn = self._txn, None
+            self._txn_aborted = False
+            txn.rollback()
+            return {"rollback": True}
+        return None
+
+    def _run_write(self, op):
+        """Run a DML closure: auto-commit via DB.txn retries outside an
+        explicit transaction; inside one, run against the session txn with
+        NO implicit retry — a retryable conflict surfaces to the client as
+        a restart-the-block error and the txn enters the Aborted state
+        (the reference cannot replay client-driven statements either).
+
+        The closure's columnar scans (``_affected``) surface foreign
+        intents as WriteIntentError; that is the same retryable conflict
+        Txn.get/scan convert, so convert it here too — otherwise the
+        40001 retry loop every client wraps around blocks never fires."""
+
+        def guarded(txn):
+            try:
+                return op(txn)
+            except WriteIntentError as e:
+                raise TransactionRetryError(
+                    f"conflicting intent on {e.keys}"
+                ) from e
+
+        if self._txn is None:
+            return self.db.txn(guarded)
+        try:
+            return guarded(self._txn)
+        except TransactionRetryError:
+            self._txn_aborted = True
+            raise
+
+    def _read_as(self, txn):
+        """Context: KV-backed columnar scans read AT txn's snapshot AS txn
+        (own intents visible, foreign intents conflict)."""
+        from contextlib import contextmanager
+
+        kv_tables = [t for t in self.catalog.tables.values()
+                     if isinstance(t, KVTable)]
+
+        @contextmanager
+        def ctx():
+            try:
+                for t in kv_tables:
+                    t.read_ts = txn.read_ts
+                    t.reader_txn = txn.txn_id
+                yield
+            finally:
+                for t in kv_tables:
+                    t.read_ts = None
+                    t.reader_txn = 0
+
+        return ctx()
+
+    def _select(self, stmt: P.Select):
+        if self._txn is None:
+            return Binder(self.catalog).bind(stmt).run()
+        # in-txn SELECT: scans read at the txn snapshot, and every scanned
+        # table's span lands in the txn's read set for commit-time refresh
+        txn = self._txn
+        with self._read_as(txn):
+            rel = Binder(self.catalog).bind(stmt)
+            for t in self._scanned_kv_tables(rel.plan):
+                from ..storage import rowcodec as _rc
+
+                start, end = _rc.table_span(t.table_id)
+                txn.note_read_span(start, end)
+            try:
+                return rel.run()
+            except WriteIntentError as e:
+                self._txn_aborted = True
+                raise TransactionRetryError(
+                    f"conflicting intent on {e.keys}"
+                ) from e
+
+    def _scanned_kv_tables(self, plan):
+        """KVTables named by TableScan nodes anywhere in a plan tree."""
+        from ..plan import spec as S
+
+        out = []
+        if isinstance(plan, S.TableScan):
+            t = self.catalog.tables.get(plan.table)
+            if isinstance(t, KVTable):
+                out.append(t)
+        for f in ("input", "probe", "build"):
+            child = getattr(plan, f, None)
+            if child is not None:
+                out.extend(self._scanned_kv_tables(child))
+        for child in getattr(plan, "inputs", ()) or ():
+            out.extend(self._scanned_kv_tables(child))
+        return out
 
     @staticmethod
     def _maybe_settings_stmt(text: str):
@@ -346,7 +499,7 @@ class Session:
             for r in rows:
                 t.insert(txn, r)
 
-        self.db.txn(op)
+        self._run_write(op)
         return {"rows_affected": len(rows)}
 
     def _affected(self, t: KVTable, where: P.Node | None,
@@ -385,12 +538,15 @@ class Session:
         pk_t = t.schema.type_of(t.pk)
 
         def op(txn):
-            # the affected-row scan runs INSIDE the txn closure so a retry
-            # recomputes it, and each row is re-read through the txn
-            # (get_row_txn tracks the read span) — a writer interleaving
-            # between scan and commit fails the commit-time refresh and
-            # retries instead of being silently overwritten (lost update)
-            res = self._affected(t, stmt.where, computed_sets)
+            # the affected-row scan runs INSIDE the txn closure at the TXN'S
+            # snapshot (own intents visible — statements earlier in an
+            # explicit txn are seen), so a retry recomputes it, and each row
+            # is re-read through the txn (get_row_txn tracks the read span)
+            # — a writer interleaving between scan and commit fails the
+            # commit-time refresh and retries instead of being silently
+            # overwritten (lost update)
+            with self._read_as(txn):
+                res = self._affected(t, stmt.where, computed_sets)
             n = len(res[t.pk])
             written = 0
             for i in range(n):
@@ -413,7 +569,7 @@ class Session:
                 written += 1
             return written
 
-        n = self.db.txn(op)
+        n = self._run_write(op)
         return {"rows_affected": n}
 
     def _delete(self, stmt: P.Delete):
@@ -421,7 +577,8 @@ class Session:
         pk_t = t.schema.type_of(t.pk)
 
         def op(txn):
-            res = self._affected(t, stmt.where)
+            with self._read_as(txn):
+                res = self._affected(t, stmt.where)
             deleted = 0
             for v in res[t.pk]:
                 pk = _from_result(v, pk_t)
@@ -431,7 +588,7 @@ class Session:
                 deleted += 1
             return deleted
 
-        n = self.db.txn(op)
+        n = self._run_write(op)
         return {"rows_affected": n}
 
 

@@ -1,0 +1,289 @@
+"""Logictest corpus generator — sqlite3 as the independent oracle.
+
+The reference's corpus (pkg/sql/logictest/testdata/logic_test, 447 files)
+encodes SQL behavior as datadriven files. This generator produces ORIGINAL
+files for this engine's dialect subset: each query's expected cells come
+from sqlite (stdlib, a fully independent SQL implementation), rendered with
+the runner's own formatting rules. Dialect divergences (CAST rounding,
+case-insensitive LIKE, int division) are simply not generated here —
+they're covered by handwritten files encoding THIS engine's documented
+semantics.
+
+Run:  python tests/logictest/gen_corpus.py [--verify]
+  --verify also executes every generated file through a Session and reports
+  failures (used before checking generated files in).
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "testdata")
+
+# shared fixture tables (lowercase strings only: LIKE stays case-exact)
+NUMS = """
+create table nums (a int primary key, b int, f float, s string)
+""", """
+insert into nums values
+  (1, 10, 1.5, 'apple'), (2, null, -2.25, 'banana'), (3, 30, null, 'cherry'),
+  (4, null, null, null), (5, 10, 0.5, 'apple'), (6, -7, 3.25, 'date'),
+  (7, 30, -0.5, 'banana'), (8, 0, 7.125, 'elder'), (9, 10, 2.5, null),
+  (10, -7, 1.25, 'fig')
+"""
+
+PAIR = """
+create table pl (id int primary key, k int, v int)
+""", """
+insert into pl values (1, 1, 100), (2, 1, 200), (3, 2, 300), (4, null, 400),
+                      (5, 3, 500), (6, 2, 600)
+""", """
+create table pr (id int primary key, k int, w int, tag string)
+""", """
+insert into pr values (10, 1, 7, 'x'), (11, 1, 8, 'y'), (12, 3, 9, 'x'),
+                      (13, null, 5, 'z'), (14, 4, 6, 'y')
+"""
+
+# (filename, setup statements, [(types, sort, sql), ...])
+AREAS: list[tuple[str, tuple[str, ...], list[tuple[str, str, str]]]] = []
+
+AREAS.append(("agg_grouping", NUMS, [
+    ("II", "rowsort", "select b, count(*) from nums group by b"),
+    ("II", "rowsort", "select b, count(f) from nums group by b"),
+    ("IR", "rowsort", "select b, sum(f) from nums group by b"),
+    ("IR", "rowsort", "select b, avg(a) from nums group by b"),
+    ("II", "rowsort", "select b, min(a) from nums group by b"),
+    ("II", "rowsort", "select b, max(a) from nums group by b"),
+    ("TI", "rowsort", "select s, count(*) from nums group by s"),
+    ("TR", "rowsort", "select s, sum(f) from nums group by s"),
+    ("I", "nosort", "select count(*) from nums"),
+    ("I", "nosort", "select count(b) from nums"),
+    ("I", "nosort", "select count(*) from nums where b is null"),
+    ("R", "nosort", "select sum(f) from nums"),
+    ("R", "nosort", "select avg(b) from nums"),
+    ("I", "nosort", "select min(b) from nums"),
+    ("I", "nosort", "select max(b) from nums"),
+    ("R", "nosort", "select sum(f) from nums where a > 100"),
+    ("I", "nosort", "select count(*) from nums where a > 100"),
+    ("II", "rowsort",
+     "select b, count(*) from nums group by b having count(*) > 1"),
+    ("IR", "rowsort",
+     "select b, sum(f) from nums group by b having sum(f) > 1.0"),
+    ("II", "rowsort",
+     "select b, max(a) from nums where f is not null group by b"),
+    ("ITI", "rowsort",
+     "select b, s, count(*) from nums group by b, s"),
+    ("II", "rowsort",
+     "select b * 2, count(*) from nums where a < 9 group by b * 2"),
+]))
+
+AREAS.append(("distinct_limit", NUMS, [
+    ("I", "rowsort", "select distinct b from nums"),
+    ("T", "rowsort", "select distinct s from nums"),
+    ("II", "rowsort", "select distinct b, b from nums"),
+    ("IT", "rowsort", "select distinct b, s from nums where a <= 5"),
+    ("I", "nosort", "select a from nums order by a limit 3"),
+    ("I", "nosort", "select a from nums order by a desc limit 4"),
+    ("I", "nosort", "select a from nums order by a limit 3 offset 2"),
+    ("I", "nosort", "select a from nums order by a limit 20 offset 8"),
+    ("I", "nosort", "select a from nums order by a limit 2 offset 20"),
+    ("I", "nosort", "select distinct b from nums order by b limit 2"),
+    ("II", "nosort",
+     "select a, b from nums order by b, a limit 5"),
+    ("I", "nosort", "select count(*) from (select distinct b from nums)"),
+]))
+
+AREAS.append(("order_nulls", NUMS, [
+    ("I", "nosort", "select b from nums order by b"),
+    ("I", "nosort", "select b from nums order by b desc"),
+    ("R", "nosort", "select f from nums order by f"),
+    ("R", "nosort", "select f from nums order by f desc"),
+    ("T", "nosort", "select s from nums order by s"),
+    ("T", "nosort", "select s from nums order by s desc"),
+    ("II", "nosort", "select b, a from nums order by b, a"),
+    ("II", "nosort", "select b, a from nums order by b desc, a desc"),
+    ("IRT", "nosort",
+     "select b, f, s from nums order by b, f desc, s"),
+    ("IT", "nosort", "select a, s from nums order by s, a limit 6"),
+]))
+
+AREAS.append(("join_edges", PAIR, [
+    ("III", "rowsort", "select pl.id, pr.id, w from pl, pr where pl.k = pr.k"),
+    ("II", "rowsort", "select pl.id, w from pl left join pr on pl.k = pr.k"),
+    ("I", "rowsort",
+     "select pl.id from pl, pr where pl.k = pr.k and w > 7"),
+    ("IT", "rowsort",
+     "select v, tag from pl, pr where pl.k = pr.k and pl.v >= 300"),
+    ("II", "rowsort",
+     "select a.id, b.id from pl as a, pl as b where a.k = b.k"),
+    ("I", "nosort", "select count(*) from pl, pr"),
+    ("I", "nosort", "select count(*) from pl, pr where pl.k = pr.k"),
+    ("II", "rowsort",
+     "select k, n from (select pl.k as k, count(*) as n "
+     "from pl, pr where pl.k = pr.k group by pl.k)"),
+    ("TI", "rowsort",
+     "select tag, sum(v) from pl, pr where pl.k = pr.k group by tag"),
+    ("I", "rowsort",
+     "select pl.id from pl left join pr on pl.k = pr.k where w is null"),
+]))
+
+AREAS.append(("subqueries", PAIR, [
+    ("I", "rowsort", "select id from pl where k in (select k from pr)"),
+    ("I", "rowsort",
+     "select id from pl where k not in (select k from pr where k is not null)"),
+    ("I", "rowsort",
+     "select id from pl where exists (select * from pr where pr.k = pl.k)"),
+    ("I", "rowsort",
+     "select id from pl where not exists "
+     "(select * from pr where pr.k = pl.k)"),
+    ("I", "rowsort",
+     "select id from pl where v > (select min(w) from pr) * 40"),
+    ("I", "rowsort",
+     "select id from pr where w = (select max(w) from pr)"),
+    ("I", "rowsort",
+     "select id from pl where k in (select k from pr where tag = 'x')"),
+    ("I", "nosort",
+     "select count(*) from pl where k not in (select k from pr)"),
+]))
+
+AREAS.append(("scalar_functions", NUMS, [
+    ("I", "rowsort", "select abs(b) from nums where b is not null"),
+    ("R", "rowsort", "select abs(f) from nums where f is not null"),
+    ("R", "rowsort", "select floor(f) from nums where f is not null"),
+    ("R", "rowsort", "select ceil(f) from nums where f is not null"),
+    ("R", "rowsort", "select f + 1.5 from nums where f is not null"),
+    ("R", "rowsort", "select f * -2.0 from nums where f > 0"),
+    ("I", "rowsort", "select length(s) from nums where s is not null"),
+    ("T", "rowsort", "select upper(s) from nums where s is not null"),
+    ("T", "rowsort",
+     "select substring(s, 1, 3) from nums where s is not null"),
+    ("I", "rowsort", "select coalesce(b, -1) from nums"),
+    ("R", "rowsort", "select coalesce(f, 0.0) from nums"),
+    ("I", "rowsort", "select coalesce(b, a) from nums"),
+    ("R", "rowsort", "select sqrt(a) from nums where a in (1, 4, 9)"),
+    ("I", "rowsort", "select a + b * 2 from nums where b is not null"),
+    ("I", "rowsort", "select -(a) from nums where a < 4"),
+]))
+
+AREAS.append(("between_like_union", NUMS + PAIR, [
+    ("I", "rowsort", "select a from nums where b between 0 and 20"),
+    ("I", "rowsort", "select a from nums where a between 3 and 6"),
+    ("I", "rowsort", "select a from nums where f between -1.0 and 2.0"),
+    ("I", "rowsort", "select a from nums where s like 'a%'"),
+    ("I", "rowsort", "select a from nums where s like '%an%'"),
+    ("I", "rowsort", "select a from nums where s like '_a%'"),
+    ("I", "rowsort", "select a from nums where s not like '%a%'"),
+    ("I", "rowsort",
+     "select b from nums union select k from pl"),
+    ("I", "rowsort",
+     "select b from nums union all select k from pl"),
+    ("I", "rowsort",
+     "select a from nums where b = 10 union select id from pr where w < 7"),
+]))
+
+AREAS.append(("where_3vl", NUMS, [
+    ("I", "rowsort", "select a from nums where b > 0 or f > 0"),
+    ("I", "rowsort", "select a from nums where b > 0 and f > 0"),
+    ("I", "rowsort", "select a from nums where not (b > 0)"),
+    ("I", "rowsort", "select a from nums where b is null and f is null"),
+    ("I", "rowsort", "select a from nums where b is null or f is null"),
+    ("I", "rowsort", "select a from nums where b = b"),
+    ("I", "rowsort", "select a from nums where b <> 10"),
+    ("I", "rowsort", "select a from nums where coalesce(b, 0) >= 0"),
+    ("I", "rowsort", "select a from nums where (b > 0) = (f > 0)"),
+    ("I", "rowsort", "select a from nums where b in (10, -7)"),
+    ("I", "rowsort", "select a from nums where b not in (10, 30)"),
+]))
+
+
+def _render(val, t: str) -> str:
+    if val is None:
+        return "NULL"
+    if t == "I":
+        return str(int(val))
+    if t == "R":
+        return f"{float(val):.6g}"
+    if t == "B":
+        return "true" if val else "false"
+    return str(val)
+
+
+def _sqlite_dialect(sql: str) -> str:
+    return sql.replace("substring(", "substr(")
+
+
+def generate() -> list[str]:
+    paths = []
+    for fname, setup, queries in AREAS:
+        conn = sqlite3.connect(":memory:")
+        for s in setup:
+            conn.execute(_sqlite_dialect(s))
+        out = [
+            f"# {fname}: generated by gen_corpus.py — expected rows computed",
+            "# by sqlite3 (independent oracle); regenerate, don't hand-edit.",
+            "",
+        ]
+        for s in setup:
+            out.append("statement ok")
+            out.append(s.strip())
+            out.append("")
+        for types, sort, sql in queries:
+            rows = conn.execute(_sqlite_dialect(sql)).fetchall()
+            cells = []
+            rendered = [
+                tuple(_render(v, types[c]) for c, v in enumerate(row))
+                for row in rows
+            ]
+            if sort == "rowsort":
+                rendered.sort()
+            cells = [c for row in rendered for c in row]
+            if sort == "valuesort":
+                cells.sort()
+            out.append(f"query {types} {sort}")
+            out.append(sql)
+            out.append("----")
+            out.extend(cells)
+            out.append("")
+        path = os.path.join(OUT, f"{fname}.test")
+        with open(path, "w") as f:
+            f.write("\n".join(out))
+        paths.append(path)
+        conn.close()
+    return paths
+
+
+def verify(paths: list[str]) -> int:
+    from cockroach_tpu.sql import Session
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "logictest_runner", os.path.join(HERE, "runner.py"))
+    runner = importlib.util.module_from_spec(spec)
+    sys.modules["logictest_runner"] = runner  # dataclasses need the module
+    spec.loader.exec_module(runner)
+    failures = 0
+    for p in paths:
+        try:
+            n = runner.run_logic_file(p, Session())
+            print(f"ok {os.path.basename(p)}: {n} directives")
+        except Exception as e:
+            failures += 1
+            print(f"FAIL {os.path.basename(p)}: {e}")
+    return failures
+
+
+if __name__ == "__main__":
+    ps = generate()
+    total = sum(
+        open(p).read().count("query ") + open(p).read().count("statement ")
+        for p in ps
+    )
+    print(f"generated {len(ps)} files, ~{total} directives")
+    if "--verify" in sys.argv:
+        from cockroach_tpu.utils.backend import force_cpu_backend
+
+        force_cpu_backend()
+        sys.exit(1 if verify(ps) else 0)

@@ -172,7 +172,10 @@ def run_logic_file(path: str, session, mesh=None) -> int:
             continue
         got = _cells(res, case.types, case.sort)
         _compare(got, case.expected, case.types, case.line, "local")
-        if mesh is not None:
+        in_txn = getattr(session, "_txn", None) is not None
+        if mesh is not None and not in_txn:
+            # the distributed re-run binds fresh (outside any session txn —
+            # an in-txn query's snapshot/intents are session state)
             try:
                 rel = sql_bind(session.catalog, case.sql)
                 dres = rel.run_distributed(mesh)

@@ -276,3 +276,94 @@ def test_show_tables_and_columns(sess):
     assert list(r["data_type"]) == ["INT64", "DECIMAL(10,2)"]
     with pytest.raises(BindError):
         sess.execute("show columns from nope")
+
+
+# ---------------------------------------------------------------------------
+# explicit transactions (BEGIN/COMMIT/ROLLBACK — the conn_executor txn FSM)
+
+
+def test_txn_commit_makes_writes_visible(sess):
+    sess.execute("CREATE TABLE a (k INT PRIMARY KEY, v INT)")
+    sess.execute("BEGIN")
+    sess.execute("INSERT INTO a VALUES (1, 10)")
+    sess.execute("INSERT INTO a VALUES (2, 20)")
+    # in-txn read sees own uncommitted writes
+    r = sess.execute("SELECT v FROM a ORDER BY k")
+    assert list(r["v"]) == [10, 20]
+    # a second session hits the open txn's intents (reduced semantics:
+    # conflict error rather than txn-push; the reference would block)
+    from cockroach_tpu.storage import WriteIntentError
+
+    other = Session(catalog=sess.catalog, db=sess.db)
+    with pytest.raises(WriteIntentError):
+        other.execute("SELECT v FROM a")
+    assert sess.execute("COMMIT") == {"commit": True}
+    assert list(other.execute("SELECT v FROM a ORDER BY k")["v"]) == [10, 20]
+
+
+def test_txn_rollback_discards_writes(sess):
+    sess.execute("CREATE TABLE b (k INT PRIMARY KEY, v INT)")
+    sess.execute("INSERT INTO b VALUES (1, 1)")
+    sess.execute("BEGIN")
+    sess.execute("UPDATE b SET v = 99 WHERE k = 1")
+    sess.execute("INSERT INTO b VALUES (2, 2)")
+    assert sess.execute("ROLLBACK") == {"rollback": True}
+    r = sess.execute("SELECT k, v FROM b")
+    assert list(r["k"]) == [1] and list(r["v"]) == [1]
+
+
+def test_txn_multi_statement_atomicity_over_conflict(sess):
+    from cockroach_tpu.kv.txn import TransactionRetryError
+
+    sess.execute("CREATE TABLE c (k INT PRIMARY KEY, v INT)")
+    sess.execute("INSERT INTO c VALUES (1, 1)")
+    sess.execute("BEGIN")
+    sess.execute("UPDATE c SET v = 2 WHERE k = 1")
+    # another session's write conflicts with the open txn's intent and
+    # surfaces as the RETRYABLE error (the 40001 contract clients loop on)
+    other = Session(catalog=sess.catalog, db=sess.db)
+    with pytest.raises(TransactionRetryError):
+        other.execute("UPDATE c SET v = 3 WHERE k = 1")
+    # our txn still commits its atomic block
+    assert sess.execute("COMMIT") == {"commit": True}
+    assert list(sess.execute("SELECT v FROM c")["v"]) == [2]
+
+
+def test_txn_aborted_state_discipline(sess):
+    sess.execute("CREATE TABLE d (k INT PRIMARY KEY, v INT)")
+    sess.execute("BEGIN")
+    sess._txn_aborted = True  # simulate a mid-block retryable failure
+    with pytest.raises(BindError, match="aborted"):
+        sess.execute("SELECT * FROM d")
+    # COMMIT of an aborted block rolls back
+    assert sess.execute("COMMIT") == {"rollback": True}
+    # session is usable again
+    sess.execute("INSERT INTO d VALUES (1, 1)")
+    assert list(sess.execute("SELECT v FROM d")["v"]) == [1]
+
+
+def test_txn_begin_nesting_and_stray_end(sess):
+    assert "warning" in sess.execute("COMMIT")
+    assert "warning" in sess.execute("ROLLBACK")
+    sess.execute("BEGIN")
+    with pytest.raises(BindError, match="already a transaction"):
+        sess.execute("BEGIN")
+    sess.execute("ROLLBACK")
+
+
+def test_txn_snapshot_isolation_for_reads(sess):
+    sess.execute("CREATE TABLE e (k INT PRIMARY KEY, v INT)")
+    sess.execute("INSERT INTO e VALUES (1, 1)")
+    sess.execute("BEGIN")
+    assert list(sess.execute("SELECT v FROM e")["v"]) == [1]
+    # a concurrent committed write lands ABOVE our snapshot: not visible
+    other = Session(catalog=sess.catalog, db=sess.db)
+    other.execute("INSERT INTO e VALUES (2, 2)")
+    assert list(sess.execute("SELECT v FROM e")["v"]) == [1]
+    # the concurrent commit invalidated our read span: COMMIT surfaces the
+    # retryable error (the client restarts the block)
+    from cockroach_tpu.kv.txn import TransactionRetryError
+
+    with pytest.raises(TransactionRetryError):
+        sess.execute("COMMIT")
+    assert sess._txn is None  # back to NoTxn either way

@@ -537,13 +537,12 @@ def test_sql_subquery_in_from(cat):
     )
 
 
-def test_sql_not_in_nullable_rejected():
-    """NOT IN over a nullable subquery column is rejected at bind time: a
-    plain anti join diverges from three-valued NOT IN semantics when the
-    subquery result can contain NULL."""
+def test_sql_not_in_three_valued():
+    """NOT IN follows three-valued logic even over nullable columns: a NULL
+    in the subquery empties the result; NULL probe keys are dropped; an
+    empty subquery keeps every row (x NOT IN () is TRUE)."""
     import cockroach_tpu.catalog as catalog_mod
     from cockroach_tpu.coldata.types import INT64, Schema
-    from cockroach_tpu.sql.binder import BindError
 
     c2 = catalog_mod.Catalog()
     c2.add(catalog_mod.Table.from_strings(
@@ -552,19 +551,27 @@ def test_sql_not_in_nullable_rejected():
         "u", Schema.of(b=INT64, c=INT64),
         {"b": np.arange(3), "c": np.arange(100, 103)},
         valids={"b": np.array([True, False, True])}))
-    # nullable subquery column rejected
-    with pytest.raises(BindError, match="NULL"):
-        sql(c2, "select count(*) as n from t "
-                "where a not in (select b from u)")
-    # nullable outer argument rejected
-    with pytest.raises(BindError, match="NULL"):
-        sql(c2, "select count(*) as n from u "
-                "where b not in (select a from t)")
+    # NULL in the subquery result: NOT IN is never true -> empty
+    got = sql(c2, "select count(*) as n from t "
+                  "where a not in (select b from u)").run()
+    assert int(got["n"][0]) == 0
+    # nullable OUTER argument: NULL probe keys dropped, others anti-join
+    got = sql(c2, "select count(*) as n from u "
+                  "where b not in (select a from t)").run()
+    assert int(got["n"][0]) == 0  # b values {0, 2} are all in t; NULL dropped
+    got = sql(c2, "select count(*) as n from u "
+                  "where b not in (select c from u)").run()
+    assert int(got["n"][0]) == 2  # {0, 2} not in {100..102}; NULL dropped
+    # empty subquery: every row passes, even the NULL-key one
+    got = sql(c2, "select count(*) as n from u "
+                  "where b not in (select a from t where a > 100)").run()
+    assert int(got["n"][0]) == 3
     # IN (not negated) over the same nullable column is fine
     got = sql(c2, "select count(*) as n from t "
                   "where a in (select b from u)").run()
     assert int(got["n"][0]) >= 1
-    # and NOT IN over provably non-null columns still binds
+    # and NOT IN over provably non-null columns still binds (no execution
+    # of the subquery at bind time on this fast path)
     got = sql(c2, "select count(*) as n from t "
                   "where a not in (select c from u)").run()
     assert int(got["n"][0]) == 5
