@@ -98,6 +98,10 @@ class Gossip:
                     conn, _ = self._srv.accept()
                 except socket.timeout:
                     continue
+                except OSError:
+                    # close() raced the accept (fd already closed): the
+                    # server is shutting down, not failing
+                    return
                 try:
                     # malformed or truncated exchanges must not kill the
                     # server loop — drop the connection and keep accepting

@@ -40,15 +40,25 @@ class Job:
     payload: dict
     progress: dict
     error: str = ""
+    # adoption claim (jobs/adopt.go): which node runs it, at which liveness
+    # epoch. A node whose epoch was incremented (fenced) must not write
+    # checkpoints for claims made under the older epoch.
+    claim_node: int = 0
+    claim_epoch: int = 0
 
 
 class Registry:
     """Durable job records + resumer dispatch (jobs.Registry reduction)."""
 
-    def __init__(self, db: DB, node_id: int = 1):
+    def __init__(self, db: DB, node_id: int = 1, liveness=None):
         self.db = db
         self.node_id = node_id
+        # NodeLiveness (kv/liveness.py): adoption claims are epoch-stamped
+        # and checkpoints are fenced against epoch increments; None keeps
+        # the single-registry behavior (claims recorded, never contested)
+        self.liveness = liveness
         self._resumers: dict[str, object] = {}
+        self._running: set[int] = set()  # in-process, guards self-re-adoption
 
     # -- resumer registration (RegisterConstructor analog) -------------------
 
@@ -65,28 +75,37 @@ class Registry:
         return _PREFIX + b"%08d" % job_id
 
     def _write(self, t, job: Job) -> None:
-        t.put(self._key(job.job_id), json.dumps({
+        rec = {
             "type": job.job_type, "state": job.state,
             "payload": job.payload, "progress": job.progress,
-            "error": job.error,
-        }).encode("utf-8"))
+        }
+        # compact encoding: records live in fixed-width engine values
+        if job.error:
+            rec["error"] = job.error
+        if job.claim_node:
+            rec["claim_node"] = job.claim_node
+            rec["claim_epoch"] = job.claim_epoch
+        t.put(self._key(job.job_id),
+              json.dumps(rec, separators=(",", ":")).encode("utf-8"))
+
+    @staticmethod
+    def _from_record(job_id: int, v: bytes) -> Job:
+        d = json.loads(v.decode("utf-8"))
+        return Job(job_id, d["type"], d["state"], d["payload"],
+                   d["progress"], d.get("error", ""),
+                   d.get("claim_node", 0), d.get("claim_epoch", 0))
 
     def load(self, job_id: int) -> Job | None:
         v = self.db.get(self._key(job_id))
         if v is None:
             return None
-        d = json.loads(v.decode("utf-8"))
-        return Job(job_id, d["type"], d["state"], d["payload"],
-                   d["progress"], d.get("error", ""))
+        return self._from_record(job_id, v)
 
     def jobs(self) -> list[Job]:
-        out = []
-        for k, v in self.db.scan(_PREFIX, _PREFIX + b"\xff"):
-            d = json.loads(v.decode("utf-8"))
-            out.append(Job(int(k[len(_PREFIX):]), d["type"], d["state"],
-                           d["payload"], d["progress"],
-                           d.get("error", "")))
-        return out
+        return [
+            self._from_record(int(k[len(_PREFIX):]), v)
+            for k, v in self.db.scan(_PREFIX, _PREFIX + b"\xff")
+        ]
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -113,37 +132,135 @@ class Registry:
 
         return self.db.txn(op)
 
+    def _my_epoch(self) -> int:
+        """The liveness epoch this node BELIEVES it owns (set by its own
+        successful heartbeats) — a fenced node must keep stamping its old
+        epoch so its writes fail, not adopt the fencer's."""
+        if self.liveness is None:
+            return 0
+        if self.liveness._my_epoch is not None:
+            return self.liveness._my_epoch
+        rec = self.liveness._read(self.node_id)
+        return rec.epoch if rec is not None else 0
+
     def checkpoint(self, job: Job) -> None:
         """Persist progress mid-run (the backup-manifest-checkpoint shape:
-        a crash after this point resumes from here, not from zero)."""
-        self.db.txn(lambda t: self._write(t, job))
+        a crash after this point resumes from here, not from zero).
+
+        Epoch fencing (jobs/adopt.go + liveness epochs): with liveness
+        wired, the fence check and the record write share ONE txn — the
+        liveness read lands in the txn's read spans, so a fencer's epoch
+        increment between check and commit invalidates the write (refresh
+        failure) instead of letting a stale node clobber the new owner."""
+        from .liveness import EpochFencedError
+
+        def op(t):
+            if self.liveness is not None and job.claim_node == self.node_id:
+                rec = self.liveness._read(self.node_id, t)
+                if rec is not None and rec.epoch != job.claim_epoch:
+                    raise EpochFencedError(
+                        f"node {self.node_id} epoch {rec.epoch} != claim "
+                        f"epoch {job.claim_epoch}; job {job.job_id} was "
+                        "re-adopted"
+                    )
+            self._write(t, job)
+
+        self.db.txn(op)
+
+    def adopt_orphans(self) -> list[Job]:
+        """Re-adopt running jobs whose claim is no longer valid: the
+        claimant's liveness record expired (fence it — its late checkpoints
+        must fail) or it is a stale self-claim from before our own epoch
+        advanced (jobs/adopt.go's claim-expired loop). One failing job must
+        not stall its siblings. Requires liveness."""
+        if self.liveness is None:
+            return []
+        from ..utils import log
+        from .liveness import StillLiveError
+
+        out = []
+        for job in self.jobs():
+            if job.state != "running" or job.job_id in self._running:
+                continue
+            if job.claim_node == 0:
+                continue
+            if job.claim_node == self.node_id:
+                # our own claim: after a crash-and-restart the record is
+                # live again but nothing is driving the job — resume it
+                # (the _running guard keeps in-flight jobs untouched)
+                pass
+            else:
+                if self.liveness.is_live(job.claim_node):
+                    continue
+                try:
+                    self.liveness.increment_epoch(job.claim_node)
+                except StillLiveError:
+                    continue  # heartbeated between checks; leave it alone
+            try:
+                out.append(self.adopt_and_resume(job.job_id))
+            except Exception as e:
+                log.warning(log.OPS, "orphan adoption failed",
+                            job=job.job_id, error=str(e))
+        return out
+
+    def _claim(self, job_id: int, observed: Job) -> Job | None:
+        """Transactionally claim a job for this node. The read of the
+        record is span-tracked, so two adopters racing on the same orphan
+        conflict: the loser's retry re-reads the new claim and backs off
+        (returns None) instead of double-running the job."""
+        my_epoch = self._my_epoch()
+
+        def op(t):
+            v = t.get(self._key(job_id))
+            if v is None:
+                return None
+            cur = self._from_record(job_id, v)
+            if cur.state in ("succeeded", "failed"):
+                return cur
+            if ((cur.claim_node, cur.claim_epoch)
+                    != (observed.claim_node, observed.claim_epoch)):
+                return None  # someone else claimed since we looked
+            cur.state = "running"
+            cur.claim_node = self.node_id
+            cur.claim_epoch = my_epoch
+            self._write(t, cur)
+            return cur
+
+        return self.db.txn(op)
 
     def adopt_and_resume(self, job_id: int) -> Job:
         """Claim a pending/running job and drive its resumer to a terminal
         state. Re-entrant: called again after a crash, the resumer
         continues from the persisted progress."""
-        job = self.load(job_id)
-        if job is None:
+        observed = self.load(job_id)
+        if observed is None:
             raise KeyError(f"no job {job_id}")
+        if observed.state in ("succeeded", "failed"):
+            return observed
+        resume = self._resumers.get(observed.job_type)
+        if resume is None:
+            raise KeyError(f"no resumer for job type {observed.job_type!r}")
+        job = self._claim(job_id, observed)
+        if job is None:
+            return self.load(job_id)  # lost the claim race: current state
         if job.state in ("succeeded", "failed"):
             return job
-        resume = self._resumers.get(job.job_type)
-        if resume is None:
-            raise KeyError(f"no resumer for job type {job.job_type!r}")
-        job.state = "running"
-        self.checkpoint(job)
+        self._running.add(job_id)
         try:
-            result = resume(self, job)
-        except Exception as e:
-            job.state = "failed"
-            job.error = f"{type(e).__name__}: {e}"
+            try:
+                result = resume(self, job)
+            except Exception as e:
+                job.state = "failed"
+                job.error = f"{type(e).__name__}: {e}"
+                self.checkpoint(job)
+                raise
+            job.state = "succeeded"
+            if isinstance(result, dict):
+                job.progress.update(result)
             self.checkpoint(job)
-            raise
-        job.state = "succeeded"
-        if isinstance(result, dict):
-            job.progress.update(result)
-        self.checkpoint(job)
-        return job
+            return job
+        finally:
+            self._running.discard(job_id)
 
 
 # -- built-in job types ------------------------------------------------------

@@ -27,6 +27,10 @@ _SAMPLE = struct.Struct("<qd")  # wall_ms, value
 
 def _key(name: str, wall_ms: int) -> bytes:
     safe = name.replace("|", "_").encode("utf-8")
+    # clamp to the fixed 13-digit field: a wider timestamp (e.g. the 1<<60
+    # open-interval default) would render as more digits and sort BELOW
+    # real samples, silently emptying range scans
+    wall_ms = min(max(wall_ms, 0), 10 ** 13 - 1)
     return _PREFIX + safe + b"|" + b"%013d" % wall_ms
 
 

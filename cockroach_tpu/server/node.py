@@ -1,0 +1,193 @@
+"""Node lifecycle — the server.Server / node startup reduction.
+
+Reference: pkg/server/server.go assembles the engine, liveness heartbeats,
+gossip, the jobs registry and the timeseries poller around one stopper;
+pkg/server/node.go is the per-node identity. This Node composes the same
+subsystems over one Engine/DB so they run AS A SYSTEM instead of as
+libraries:
+
+- liveness:   a background heartbeat keeps this node's epoch-stamped record
+  fresh (kv/liveness.py); the jobs registry fences stale claimants with it.
+- jobs:       Registry(liveness=...) adopts orphaned jobs of dead nodes on a
+  ticker (jobs/adopt.go's claim-expired loop).
+- tsdb:       a metrics poller snapshots the default registry into the
+  timeseries keyspace on a ticker (ts/db.go PollSource role).
+- gossip:     optional; serves an infostore endpoint, exchanges with peers,
+  and bridges CLUSTER SETTINGS both ways — a SET here publishes
+  `setting/<name>`, a fresher remote info applies locally (the
+  settings/updater.go <- gossip path).
+- admission:  the engine's IOGovernor paces writes by L0 health; the Node
+  exposes it for observability.
+
+start()/stop() bound every thread (the stopper discipline); everything is
+single-process-scoped, multi-host rides the DCN socket plane (flow/dcn.py).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..kv import DB, Clock
+from ..kv.jobs import Registry, register_builtin_jobs
+from ..kv.liveness import NodeLiveness
+from ..kv.tsdb import TimeSeriesDB
+from ..storage.lsm import Engine
+from ..utils import log, metric, settings
+
+
+class Node:
+    def __init__(
+        self,
+        node_id: int = 1,
+        db: DB | None = None,
+        engine: Engine | None = None,
+        heartbeat_interval_s: float = 0.2,
+        ttl_ms: int = 1000,
+        metrics_interval_s: float = 0.5,
+        adopt_interval_s: float = 0.5,
+        gossip_peers: list | None = None,
+    ):
+        self.node_id = int(node_id)
+        self.db = db if db is not None else DB(
+            # key budget: tsdb keys are "\x01ts<metric>|<13-digit ms>" —
+            # metric names run ~30 bytes, so the node store uses wide keys
+            engine if engine is not None else Engine(key_width=64,
+                                                     val_width=128),
+            Clock(),
+        )
+        self.liveness = NodeLiveness(
+            self.db, self.node_id,
+            heartbeat_interval_ms=int(heartbeat_interval_s * 1000),
+            ttl_ms=ttl_ms,
+        )
+        self.jobs = Registry(self.db, node_id=self.node_id,
+                             liveness=self.liveness)
+        register_builtin_jobs(self.jobs)
+        self.tsdb = TimeSeriesDB(self.db)
+        self.gossip = None
+        self._gossip_peers = list(gossip_peers or [])
+        self._hb_interval = heartbeat_interval_s
+        self._metrics_interval = metrics_interval_s
+        self._adopt_interval = adopt_interval_s
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._settings_cb = None
+        self._applying_remote = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, gossip_port: int = 0) -> "Node":
+        self._stop.clear()
+        self.liveness.heartbeat()  # own record exists before anything reads
+
+        self._spawn(self._heartbeat_loop, "liveness-heartbeat")
+        self._spawn(self._metrics_loop, "tsdb-poller")
+        self._spawn(self._adopt_loop, "jobs-adopt")
+
+        if gossip_port is not None and (self._gossip_peers
+                                        or gossip_port >= 0):
+            from ..flow.gossip import Gossip
+
+            self.gossip = Gossip(self.node_id)
+            self._gossip_addr = self.gossip.serve(port=gossip_port)
+            if self._gossip_peers:
+                self.gossip.run_background(self._gossip_peers,
+                                           interval_s=0.1)
+            self._settings_cb = self._publish_setting
+            settings.on_change(self._settings_cb)
+            self._spawn(self._settings_apply_loop, "gossip-settings")
+        log.info(log.OPS, "node started", node=self.node_id)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._settings_cb is not None:
+            settings.remove_on_change(self._settings_cb)
+            self._settings_cb = None
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+        if self.gossip is not None:
+            self.gossip.close()
+            self.gossip = None
+        log.info(log.OPS, "node stopped", node=self.node_id)
+
+    def _spawn(self, fn, name: str) -> None:
+        t = threading.Thread(target=fn, name=f"{name}-n{self.node_id}",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- loops ---------------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        from ..kv.liveness import EpochFencedError
+        from ..kv.txn import TransactionRetryError
+
+        while not self._stop.wait(self._hb_interval):
+            try:
+                self.liveness.heartbeat()
+            except EpochFencedError:
+                # declared dead by a peer: the WHOLE node must stop taking
+                # work (a fenced node that keeps adopting jobs runs them in
+                # parallel with its fencer). Stop every loop; claims made
+                # under the old believed epoch keep failing their fence
+                # check. The reference's node exits on this signal too.
+                log.warning(log.OPS, "heartbeat fenced; stopping node",
+                            node=self.node_id)
+                self._stop.set()
+                return
+            except TransactionRetryError:
+                continue  # contended heartbeat key; next tick retries
+
+    def _metrics_loop(self) -> None:
+        while not self._stop.wait(self._metrics_interval):
+            try:
+                self.tsdb.record(metric.DEFAULT)
+            except Exception as e:  # metric write must never kill the node
+                log.warning(log.OPS, "tsdb poll failed", error=str(e))
+
+    def _adopt_loop(self) -> None:
+        while not self._stop.wait(self._adopt_interval):
+            try:
+                adopted = self.jobs.adopt_orphans()
+                for j in adopted:
+                    log.info(log.OPS, "re-adopted orphaned job",
+                             job=j.job_id, state=j.state)
+            except Exception as e:
+                log.warning(log.OPS, "adoption pass failed", error=str(e))
+
+    # -- gossip <-> settings bridge ------------------------------------------
+
+    _SETTING_PREFIX = "setting/"
+
+    def _publish_setting(self, name: str, value) -> None:
+        if self.gossip is None or self._applying_remote:
+            return
+        self.gossip.add_info(self._SETTING_PREFIX + name, value)
+
+    def _settings_apply_loop(self) -> None:
+        applied: dict[str, object] = {}
+        while not self._stop.wait(0.1):
+            if self.gossip is None:
+                return
+            for key in self.gossip.keys():
+                if not key.startswith(self._SETTING_PREFIX):
+                    continue
+                name = key[len(self._SETTING_PREFIX):]
+                info = self.gossip.get_info(key)
+                if info is None or applied.get(name) == info:
+                    continue
+                try:
+                    self._applying_remote = True
+                    settings.set(name, info)
+                    applied[name] = info
+                except Exception as e:
+                    log.warning(log.OPS, "gossiped setting rejected",
+                                setting=name, error=str(e))
+                    applied[name] = info  # don't retry a bad value forever
+                finally:
+                    self._applying_remote = False
+
+    def gossip_addr(self):
+        return getattr(self, "_gossip_addr", None)

@@ -196,6 +196,12 @@ class Engine:
         self.memtable_size = memtable_size
         self.gc_ts = gc_ts
         self.compact_width = compact_width
+        # admission control: every write path consults the IOGovernor and
+        # pays a delay proportional to L0 overload (io_load_listener.go
+        # role — slow writers BEFORE read amplification inverts)
+        from .. utils.admission import IOGovernor
+
+        self.governor = IOGovernor(self)
         self.mem = _Memtable()
         self.runs: list[mvcc.KVBlock] = []  # sorted device runs, newest first
         self.stats = MVCCStats()
@@ -358,6 +364,7 @@ class Engine:
         from ..utils import metric
 
         metric.ENGINE_WRITES.inc()
+        self.governor.pace_write()
         seq = self._seq + 1
         if self._wal is not None:  # write-ahead: durable before visible
             self._wal_record(_REC_WRITE, b, v, int(ts), seq, int(txn), tomb)
@@ -423,6 +430,7 @@ class Engine:
         n = len(keys)
         if n == 0:
             return
+        self.governor.pace_write()
         if keys.shape[1] > self.key_width:
             raise ValueError("ingest keys wider than engine key width")
         if values.shape[1] > self.val_width:

@@ -104,6 +104,12 @@ class IOGovernor:
         return max(0, over) * self.delay_per_run_s
 
     def pace_write(self) -> float:
+        """The single admission gate for engine write paths (put/ingest):
+        checks the cluster setting here so callers cannot diverge."""
+        from . import settings
+
+        if not settings.get("admission.io_pacing.enabled"):
+            return 0.0
         d = self.write_delay_s()
         if d > 0:
             self.throttled += 1

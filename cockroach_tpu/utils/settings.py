@@ -96,14 +96,41 @@ def set(name: str, value) -> None:  # noqa: A001 - SQL SET semantics
         if value not in s.choices:
             raise ValueError(f"{name}: {value!r} not in {s.choices}")
     s.value = value
+    _notify(name, value)
+
+
+_CHANGE_LISTENERS: list = []
+
+
+def on_change(cb) -> None:
+    """Subscribe cb(name, value) to every settings.set — the gossip bridge
+    (the reference gossips updated cluster settings to every node,
+    settings/updater.go); Node wires this to publish into its infostore."""
+    _CHANGE_LISTENERS.append(cb)
+
+
+def remove_on_change(cb) -> None:
+    if cb in _CHANGE_LISTENERS:
+        _CHANGE_LISTENERS.remove(cb)
+
+
+def _notify(name: str, value) -> None:
+    for cb in list(_CHANGE_LISTENERS):
+        cb(name, value)
 
 
 def reset(name: str | None = None) -> None:
+    # a RESET is a value change like any SET: listeners (the gossip bridge)
+    # must see it, or peers keep the overridden value forever
     if name is None:
         for s in _REGISTRY.values():
-            s.value = None
+            if s.value is not None:
+                s.value = None
+                _notify(s.name, s.get())
     else:
-        _REGISTRY[name].value = None
+        s = _REGISTRY[name]
+        s.value = None
+        _notify(name, s.get())
 
 
 def all_settings() -> dict[str, Setting]:
@@ -164,6 +191,12 @@ WORKMEM_BYTES = register_int(
     "against mon.BytesMonitor analog); exceeding it swaps in the external "
     "operator variant (disk_spiller.go:103)",
     lo=1 << 16,
+)
+IO_PACING = register_bool(
+    "admission.io_pacing.enabled", True,
+    "write admission control: engine writes pay a delay proportional to "
+    "L0 overload (io_load_listener role) so compaction catches up before "
+    "read amplification inverts",
 )
 DENSE_LUT_BITS = register_int(
     "sql.distsql.dense_lut_bits", 24,
