@@ -52,11 +52,31 @@ class Table:
             self.schema.index(name): d for name, d in self.dictionaries.items()
         }
 
+    def set_stats(self, st) -> None:
+        """Install ANALYZE-collected statistics (sql/stats.TableStats).
+        Planner consumers (join order, broadcast threshold, exact-key bit
+        widths) read the SNAPSHOT — deliberately stale-able, like the
+        reference's optimizer stats."""
+        self.table_stats = st
+        # exact-key/sort-key consumers read col_stats(): refresh the (lo,
+        # hi) view from the analyzed snapshot
+        self._stats = {
+            n: (c.lo, c.hi)
+            for n, c in st.cols.items()
+            if c.lo is not None and c.hi is not None
+        } if st is not None else None
+
+    def estimated_rows(self) -> int:
+        """Planner cardinality: the ANALYZE snapshot when present, else the
+        physical count."""
+        st = getattr(self, "table_stats", None)
+        return st.row_count if st is not None else self.num_rows
+
     def col_stats(self) -> dict[str, tuple]:
         """Per-column (lo, hi) bounds over valid rows for integer-represented
         columns (the table-statistics analog of pkg/sql/stats, reduced to
         what the kernel layer consumes: sort-key bit widths). Computed once
-        on the host, cached."""
+        on the host, cached; ANALYZE (set_stats) replaces the snapshot."""
         if getattr(self, "_stats", None) is None:
             stats: dict[str, tuple] = {}
             for name, t in zip(self.schema.names, self.schema.types):

@@ -1,0 +1,261 @@
+"""Cross-host DistSQL — one query spanning processes over the DCN lane.
+
+Reference shape (pkg/sql/distsql/server.go:616 SetupFlow +
+pkg/sql/flowinfra/flow_registry.go:164): the gateway ships FlowSpecs to
+remote nodes, each remote registers its flow under a FlowID, and stream
+connections attach to registered flows by (flow_id, stream_id). Here:
+
+- ``HostFlowServer`` extends the one-shot FlowServer with that registry:
+  a SETUP_FLOW request carries serialized plan fragments (flow/wire.py),
+  which build operators against the server's catalog and wait in the
+  registry; a FLOW_STREAM request attaches to one (flow_id, stream_id)
+  and streams its batches back (Arrow IPC framing from flow/dcn.py).
+  Either arrival order works — streams wait for their setup briefly, the
+  registry's ConnectInboundStream timeout discipline.
+- ``run_distributed_hosts`` is the gateway half (DistSQLPlanner.PlanAndRun
+  reduction): split an aggregation plan into per-host partial fragments
+  over table shards, SetupFlow each, attach the streams, and run the
+  final aggregation locally over the inboxes' union.
+
+The in-process SPMD mesh (parallel/planner.py) remains the intra-slice
+plane; this module is the ACROSS-hosts plane stacked above it.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import uuid
+
+from ..coldata.types import Schema
+from ..plan import spec as S
+from . import wire
+from .dcn import FlowInbox, FlowOutbox, _recv_msg, _send_msg
+from .operator import Operator
+
+
+class HostFlowServer:
+    """SetupFlow + FlowStream service over one listening socket."""
+
+    def __init__(self, catalog, host: str = "127.0.0.1", port: int = 0,
+                 stream_wait_s: float = 10.0, flow_ttl_s: float = 60.0):
+        self.catalog = catalog
+        self._srv = socket.create_server((host, port))
+        self.addr = self._srv.getsockname()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # the flow registry: (flow_id, stream_id) -> (operator, expiry)
+        # waiting for its stream connection (flow_registry.go:164); flows
+        # no stream attaches to within flow_ttl_s are purged
+        self._registry: dict[tuple[str, int], tuple[Operator, float]] = {}
+        self._reg_lock = threading.Condition()
+        self.stream_wait_s = stream_wait_s
+        self.flow_ttl_s = flow_ttl_s
+
+    def serve_background(self) -> "HostFlowServer":
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def _serve(self) -> None:
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # close() raced the accept
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        from ..utils import log
+
+        try:
+            msg = _recv_msg(conn)
+            if msg is None:
+                return
+            req = json.loads(msg.decode("utf-8"))
+            op = req.get("op")
+            if op == "setup_flow":
+                try:
+                    self._setup_flow(req)
+                except Exception as e:
+                    # the gateway must learn WHY its fragment was rejected
+                    # (unknown table, undecodable spec), not just see a
+                    # closed socket
+                    _send_msg(conn, json.dumps({
+                        "error": f"{type(e).__name__}: {e}"
+                    }).encode("utf-8"))
+                    return
+                _send_msg(conn, b'{"ok": true}')
+            elif op == "flow_stream":
+                self._flow_stream(conn, req)
+            else:
+                _send_msg(conn, b'{"error": "unknown op"}')
+        except Exception as e:
+            log.warning(log.OPS, "host flow connection failed",
+                        error=f"{type(e).__name__}: {e}")
+        finally:
+            conn.close()
+
+    def _setup_flow(self, req: dict) -> None:
+        from ..plan import builder as plan_builder
+
+        flow_id = str(req["flow_id"])
+        # build EVERY stream before registering ANY: a failure mid-request
+        # must not leave half a flow in the registry
+        built = {}
+        for sid, spec in req["streams"].items():
+            plan = wire.dec_plan(spec)
+            built[(flow_id, int(sid))] = plan_builder.build(
+                plan, self.catalog)
+        deadline = time.time() + self.flow_ttl_s
+        with self._reg_lock:
+            self._purge_expired_locked()
+            for key, op in built.items():
+                self._registry[key] = (op, deadline)
+            self._reg_lock.notify_all()
+
+    def _purge_expired_locked(self) -> None:
+        """Drop flows no stream ever attached to (a crashed gateway must
+        not pin operators forever — flow_registry.go's timeout on the
+        setup side)."""
+        now = time.time()
+        for key in [k for k, (_, dl) in self._registry.items() if dl < now]:
+            del self._registry[key]
+
+    def _flow_stream(self, conn: socket.socket, req: dict) -> None:
+        key = (str(req["flow_id"]), int(req["stream_id"]))
+        deadline = time.time() + self.stream_wait_s
+        with self._reg_lock:
+            self._purge_expired_locked()
+            while key not in self._registry:
+                left = deadline - time.time()
+                if left <= 0:
+                    _send_msg(conn, b'{"error": "no such flow"}')
+                    return
+                self._reg_lock.wait(timeout=left)
+            op, _ = self._registry.pop(key)
+        _send_msg(conn, b'{"ok": true}')
+        FlowOutbox(op, conn).run()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._srv.close()
+
+
+def setup_flow(addr, flow_id: str, streams: dict[int, S.PlanNode]) -> None:
+    """Ship plan fragments to a host's registry (SetupFlowRequest)."""
+    sock = socket.create_connection(tuple(addr))
+    try:
+        _send_msg(sock, json.dumps({
+            "op": "setup_flow", "flow_id": flow_id,
+            "streams": {sid: wire.enc_plan(p) for sid, p in streams.items()},
+        }).encode("utf-8"))
+        resp = json.loads(_recv_msg(sock).decode("utf-8"))
+        if not resp.get("ok"):
+            raise RuntimeError(f"setup_flow rejected: {resp}")
+    finally:
+        sock.close()
+
+
+def attach_stream(addr, flow_id: str, stream_id: int,
+                  schema: Schema) -> FlowInbox:
+    """Attach to a registered flow's stream (FlowStream RPC)."""
+    sock = socket.create_connection(tuple(addr))
+    _send_msg(sock, json.dumps({
+        "op": "flow_stream", "flow_id": flow_id, "stream_id": stream_id,
+    }).encode("utf-8"))
+    resp = json.loads(_recv_msg(sock).decode("utf-8"))
+    if not resp.get("ok"):
+        sock.close()
+        raise RuntimeError(f"flow_stream rejected: {resp}")
+    return FlowInbox(sock, schema)
+
+
+def plan_host_fragments(plan: S.PlanNode, n_hosts: int):
+    """Split an Aggregate(complete) over a scan chain into per-host partial
+    fragments + the gateway's final-stage recipe.
+
+    Returns (fragments, final_info) where fragments[i] is the plan to ship
+    to host i and final_info = (group_cols, aggs, base_schema_source_plan).
+    Raises TypeError for plans the host distributor does not cover (the
+    caller falls back to local execution, exactly like the reference's
+    distSQL support checks)."""
+    if not isinstance(plan, S.Aggregate) or plan.mode != "complete":
+        raise TypeError("host distribution covers Aggregate(complete) roots")
+    frags = [
+        S.Aggregate(
+            _shard_scans(plan.input, i, n_hosts), plan.group_cols,
+            plan.aggs, mode="partial",
+        )
+        for i in range(n_hosts)
+    ]
+    return frags, (plan.group_cols, plan.aggs)
+
+
+def _shard_scans(p: S.PlanNode, i: int, n: int) -> S.PlanNode:
+    if isinstance(p, S.TableScan):
+        if p.shard is not None:
+            raise TypeError("scan already sharded")
+        return S.TableScan(p.table, p.columns, shard=(i, n))
+    if isinstance(p, S.Filter):
+        return S.Filter(_shard_scans(p.input, i, n), p.predicate)
+    if isinstance(p, S.Project):
+        return S.Project(_shard_scans(p.input, i, n), p.exprs, p.names,
+                         p.dict_overrides)
+    raise TypeError(
+        f"host distribution cannot shard through {type(p).__name__}"
+    )
+
+
+def run_distributed_hosts(plan: S.PlanNode, catalog, host_addrs: list):
+    """Gateway execution: one partial fragment per host, final agg here.
+
+    The fragment count equals the host count; stream ids are 0..n-1 under
+    one fresh flow id (the FlowID/StreamID pairing of api.proto)."""
+    from ..coldata.batch import to_host
+    from ..flow import operators as ops
+    from ..plan import builder as plan_builder
+    from .runtime import run_operator
+
+    frags, (group_cols, aggs) = plan_host_fragments(plan, len(host_addrs))
+    flow_id = uuid.uuid4().hex[:12]
+    # the partial fragments' OUTPUT schema (the state layout) — build one
+    # locally to learn it; also the base schema the final stage needs
+    probe_op = plan_builder.build(frags[0], catalog)
+    state_schema = probe_op.output_schema
+    base_schema = plan_builder.build(plan.input, catalog).output_schema
+
+    for i, (addr, frag) in enumerate(zip(host_addrs, frags)):
+        setup_flow(addr, flow_id, {i: frag})
+    inboxes = [
+        attach_stream(addr, flow_id, i, state_schema)
+        for i, addr in enumerate(host_addrs)
+    ]
+    union = ops.UnionOp(tuple(inboxes))
+    final = ops.AggregateOp(union, group_cols, aggs, mode="final",
+                            input_schema=base_schema)
+    return run_operator(final)
+
+
+def explain_hosts(plan: S.PlanNode, n_hosts: int) -> list[str]:
+    """EXPLAIN (DISTSQL) lines for the cross-host stages."""
+    frags, (group_cols, aggs) = plan_host_fragments(plan, n_hosts)
+    out = []
+    for i, f in enumerate(frags):
+        out.append(
+            f"remote host {i}: partial aggregation over shard {i}/{n_hosts}"
+            f" (streams via FlowStream id {i})"
+        )
+    out.append(
+        f"gateway: final aggregation over {n_hosts} inbound streams"
+    )
+    return out

@@ -132,9 +132,11 @@ class ScanOp(SourceOperator):
     """
 
     def __init__(self, table: Table, columns: tuple[str, ...] | None = None,
-                 tile: int | None = None):
+                 tile: int | None = None,
+                 shard: tuple[int, int] | None = None):
         super().__init__()
         self.table = table
+        self.shard = shard  # (i, n): emit only rows [i*rows//n, (i+1)*rows//n)
         names = columns or table.schema.names
         self.col_idxs = tuple(table.schema.index(n) for n in names)
         self.output_schema = table.schema.select(self.col_idxs)
@@ -174,8 +176,24 @@ class ScanOp(SourceOperator):
 
     # -- resident mode ------------------------------------------------------
 
+    def _shard_bounds(self) -> tuple[int, int] | None:
+        if self.shard is None:
+            return None
+        i, n = self.shard
+        rows = self.table.num_rows
+        return (i * rows // n, (i + 1) * rows // n)
+
     def _init_resident(self):
         self._batch = self.table.device_batch(self.output_schema.names)
+        bounds = self._shard_bounds()
+        if bounds is not None:
+            # shard by masking: rows outside [lo, hi) go dead; positions
+            # (and dense-key addressing) stay stable
+            lo, hi = bounds
+            idx = jnp.arange(self._batch.capacity, dtype=jnp.int32)
+            self._batch = self._batch.with_mask(
+                self._batch.mask & (idx >= lo) & (idx < hi)
+            )
         cap = self._batch.capacity
         tile = self.tile
         if tile is None or tile <= 0 or cap % tile != 0:
@@ -194,6 +212,14 @@ class ScanOp(SourceOperator):
         self._host_cols = {n: np.asarray(t.columns[n]) for n in names}
         self._host_valids = {n: t.valids[n] for n in names if n in t.valids}
         self._nrows = t.num_rows
+        bounds = self._shard_bounds()
+        if bounds is not None:
+            lo, hi = bounds
+            self._host_cols = {n: a[lo:hi] for n, a in self._host_cols.items()}
+            self._host_valids = {
+                n: v[lo:hi] for n, v in self._host_valids.items()
+            }
+            self._nrows = hi - lo
         # big tiles amortize dispatch (bounded so two in-flight double-
         # buffered tiles stay far under HBM); ~64 tiles per table keeps the
         # pipeline busy at any scale

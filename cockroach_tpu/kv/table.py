@@ -314,6 +314,27 @@ class KVTable:
         self._count_cache = (key, n)
         return n
 
+    # -- statistics (sql/stats) ---------------------------------------------
+
+    def set_stats(self, st) -> None:
+        """Install ANALYZE statistics; (lo, hi) bounds feed col_stats for
+        exact-key planning, row_count feeds estimated_rows."""
+        self.table_stats = st
+
+    def estimated_rows(self) -> int:
+        st = getattr(self, "table_stats", None)
+        return st.row_count if st is not None else self.num_rows
+
+    def col_stats(self) -> dict[str, tuple]:
+        st = getattr(self, "table_stats", None)
+        if st is None:
+            return {}
+        return {
+            n: (c.lo, c.hi)
+            for n, c in st.cols.items()
+            if c.lo is not None and c.hi is not None
+        }
+
     def dict_by_index(self) -> dict:
         return {i: d.snapshot() for i, d in self._dicts.items()}
 

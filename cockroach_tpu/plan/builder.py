@@ -17,6 +17,7 @@ def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
         return ops.ScanOp(
             catalog.get(plan.table), plan.columns,
             tile=settings.get("sql.distsql.tile_size"),
+            shard=plan.shard,
         )
     if isinstance(plan, S.Filter):
         return ops.FilterOp(build(plan.input, catalog), plan.predicate)

@@ -28,7 +28,7 @@ def estimated_rows(plan: S.PlanNode, catalog: Catalog) -> int:
     """Crude upper-bound cardinality from catalog tables (the stats stand-in
     for the reference's cost model)."""
     if isinstance(plan, S.TableScan):
-        return catalog.get(plan.table).num_rows
+        return catalog.get(plan.table).estimated_rows()
     if isinstance(plan, (S.HashJoin, S.MergeJoin)):
         return max(estimated_rows(plan.probe, catalog),
                    estimated_rows(plan.build, catalog))

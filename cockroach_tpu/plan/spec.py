@@ -28,6 +28,10 @@ class PlanNode:
 class TableScan(PlanNode):
     table: str
     columns: tuple[str, ...] | None = None  # None = all
+    # cross-host partitioned read: this scan covers row range
+    # [i*rows//n, (i+1)*rows//n) of the table — the TableReader span
+    # partitioning a SetupFlow ships to each node (PartitionSpans role)
+    shard: tuple[int, int] | None = None  # (shard index, shard count)
 
 
 @dataclass(frozen=True)

@@ -76,13 +76,26 @@ class Node:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def start(self, gossip_port: int = 0) -> "Node":
+    def start(self, gossip_port: int = 0,
+              pg_port: int | None = None) -> "Node":
         self._stop.clear()
         self.liveness.heartbeat()  # own record exists before anything reads
 
         self._spawn(self._heartbeat_loop, "liveness-heartbeat")
         self._spawn(self._metrics_loop, "tsdb-poller")
         self._spawn(self._adopt_loop, "jobs-adopt")
+
+        self.pg = None
+        if pg_port is not None:
+            from .pgwire import PgServer
+
+            # every pgwire connection gets its own Session over this
+            # node's shared catalog/DB (conn-executor-per-session)
+            from ..catalog import Catalog
+
+            self._sql_catalog = Catalog()
+            self.pg = PgServer(catalog=self._sql_catalog, db=self.db,
+                               port=pg_port).serve_background()
 
         if gossip_port is not None and (self._gossip_peers
                                         or gossip_port >= 0):
@@ -110,6 +123,9 @@ class Node:
         if self.gossip is not None:
             self.gossip.close()
             self.gossip = None
+        if getattr(self, "pg", None) is not None:
+            self.pg.close()
+            self.pg = None
         log.info(log.OPS, "node stopped", node=self.node_id)
 
     def _spawn(self, fn, name: str) -> None:

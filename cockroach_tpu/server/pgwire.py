@@ -1,0 +1,290 @@
+"""pgwire — the Postgres v3 wire protocol server over the SQL session.
+
+Reference: pkg/sql/pgwire/server.go:854 accepts conns, conn.go:343 reads
+the startup message and serves the message loop; CockroachDB speaks v3 so
+every Postgres driver works unchanged. This is the same surface, reduced
+to the simple-query flow every driver's autocommit path uses:
+
+  StartupMessage -> AuthenticationOk + ParameterStatus* + BackendKeyData
+                    + ReadyForQuery
+  'Q' (simple query) -> RowDescription / DataRow* / CommandComplete
+                        (or ErrorResponse) -> ReadyForQuery
+  SSLRequest -> 'N' (no TLS here); CancelRequest -> ignored; 'X' ends.
+
+ReadyForQuery carries the session's REAL transaction status ('I' idle,
+'T' in block, 'E' aborted block) — BEGIN/COMMIT/ROLLBACK flow through the
+session FSM, so drivers' transaction handling works. Results travel in
+text format (the universally-supported encoding); the extended protocol
+(Parse/Bind/Execute) is the next increment.
+
+Each connection gets its OWN Session over the shared catalog/DB — the
+reference's conn-executor-per-session model.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from ..coldata.types import Family
+from ..sql import Session
+
+_SSL_REQUEST = 80877103
+_CANCEL_REQUEST = 80877102
+_STARTUP_V3 = 196608
+
+# type OIDs (pg_catalog.pg_type)
+_OID_BOOL = 16
+_OID_INT8 = 20
+_OID_FLOAT8 = 701
+_OID_TEXT = 25
+_OID_DATE = 1082
+_OID_NUMERIC = 1700
+
+
+def _oid_for_dtype(dtype) -> int:
+    """Column OID from the RESULT ARRAY's dtype — never from row values
+    (a NULL in row 0 must not retype the whole column as TEXT)."""
+    if dtype == np.bool_:
+        return _OID_BOOL
+    if np.issubdtype(dtype, np.integer):
+        return _OID_INT8
+    if np.issubdtype(dtype, np.floating):
+        return _OID_FLOAT8
+    return _OID_TEXT  # object arrays: strings or mixed/NULL-bearing
+
+
+def _render(v) -> bytes | None:
+    if v is None:
+        return None
+    if isinstance(v, (bool, np.bool_)):
+        return b"t" if v else b"f"
+    if isinstance(v, (float, np.floating)):
+        return repr(float(v)).encode()
+    return str(v).encode()
+
+
+class _Conn:
+    def __init__(self, sock: socket.socket, session: Session):
+        self.sock = sock
+        self.session = session
+
+    # -- framing -------------------------------------------------------------
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def _send(self, tag: bytes, payload: bytes = b"") -> None:
+        self.sock.sendall(tag + struct.pack("!I", len(payload) + 4) + payload)
+
+    # -- startup -------------------------------------------------------------
+
+    def startup(self) -> bool:
+        while True:
+            n = struct.unpack("!I", self._recv_exact(4))[0]
+            body = self._recv_exact(n - 4)
+            code = struct.unpack("!I", body[:4])[0]
+            if code == _SSL_REQUEST:
+                self.sock.sendall(b"N")  # no TLS; client retries plaintext
+                continue
+            if code == _CANCEL_REQUEST:
+                return False
+            if code != _STARTUP_V3:
+                raise ConnectionError(f"unsupported protocol {code}")
+            break
+        self._send(b"R", struct.pack("!I", 0))  # AuthenticationOk (trust)
+        for k, v in (
+            (b"server_version", b"13.0 cockroach_tpu"),
+            (b"client_encoding", b"UTF8"),
+            (b"DateStyle", b"ISO"),
+        ):
+            self._send(b"S", k + b"\x00" + v + b"\x00")
+        self._send(b"K", struct.pack("!II", 0, 0))  # BackendKeyData
+        self._ready()
+        return True
+
+    def _txn_status(self) -> bytes:
+        if getattr(self.session, "_txn_aborted", False):
+            return b"E"
+        return b"T" if getattr(self.session, "_txn", None) is not None \
+            else b"I"
+
+    def _ready(self) -> None:
+        self._send(b"Z", self._txn_status())
+
+    # -- query flow ----------------------------------------------------------
+
+    def _error(self, msg: str, code: str = "XX000") -> None:
+        fields = (b"SERROR\x00" + b"C" + code.encode() + b"\x00"
+                  + b"M" + msg.encode("utf-8", "replace") + b"\x00\x00")
+        self._send(b"E", fields)
+
+    def _row_description(self, names, dtypes) -> None:
+        out = [struct.pack("!H", len(names))]
+        for name, dt in zip(names, dtypes):
+            out.append(
+                name.encode() + b"\x00"
+                + struct.pack("!IHIhih", 0, 0, _oid_for_dtype(dt), -1, -1, 0)
+            )
+        self._send(b"T", b"".join(out))
+
+    def _data_row(self, row) -> None:
+        out = [struct.pack("!H", len(row))]
+        for v in row:
+            r = _render(v)
+            if r is None:
+                out.append(struct.pack("!i", -1))
+            else:
+                out.append(struct.pack("!i", len(r)) + r)
+        self._send(b"D", b"".join(out))
+
+    def _run_query(self, sql_text: str) -> None:
+        res = self.session.execute(sql_text)
+        if isinstance(res, dict) and res and all(
+            isinstance(v, np.ndarray) for v in res.values()
+        ):
+            names = list(res.keys())
+            nrows = len(res[names[0]]) if names else 0
+            self._row_description(names, [res[n].dtype for n in names])
+            for i in range(nrows):
+                self._data_row([res[n][i] for n in names])
+            self._send(b"C", b"SELECT %d\x00" % nrows)
+            return
+        # DML / DDL / txn control results
+        if isinstance(res, dict):
+            if "rows_affected" in res:
+                n = res["rows_affected"]
+                low = sql_text.strip().lower()
+                if low.startswith("insert"):
+                    tag = b"INSERT 0 %d" % n
+                elif low.startswith("update"):
+                    tag = b"UPDATE %d" % n
+                elif low.startswith("delete"):
+                    tag = b"DELETE %d" % n
+                else:
+                    tag = b"OK"
+            elif "begin" in res:
+                tag = b"BEGIN"
+            elif "commit" in res:
+                tag = b"COMMIT"
+            elif "rollback" in res:
+                tag = b"ROLLBACK"
+            elif "created" in res:
+                tag = b"CREATE TABLE"
+            elif "analyzed" in res:
+                tag = b"ANALYZE"
+            else:
+                tag = b"OK"
+        else:
+            tag = b"OK"
+        self._send(b"C", tag + b"\x00")
+
+    def serve(self) -> None:
+        if not self.startup():
+            return
+        while True:
+            tag = self._recv_exact(1)
+            n = struct.unpack("!I", self._recv_exact(4))[0]
+            body = self._recv_exact(n - 4)
+            if tag == b"X":  # Terminate
+                return
+            if tag == b"Q":
+                sql_text = body.rstrip(b"\x00").decode("utf-8", "replace")
+                try:
+                    if sql_text.strip():
+                        self._run_query(sql_text)
+                    else:
+                        self._send(b"I", b"")  # EmptyQueryResponse
+                except Exception as e:
+                    self._error(f"{type(e).__name__}: {e}",
+                                code=_sqlstate_for(e))
+                self._ready()
+            elif tag in (b"P", b"B", b"D", b"E", b"C", b"S", b"H"):
+                # extended protocol not implemented yet: fail the portal
+                # honestly and stay in sync at the next Sync ('S')
+                if tag == b"S":
+                    self._error("extended query protocol not supported; "
+                                "use simple query mode", code="0A000")
+                    self._ready()
+            else:
+                self._error(f"unknown message {tag!r}")
+                self._ready()
+
+
+def _sqlstate_for(e: Exception) -> str:
+    from ..kv.txn import TransactionRetryError
+    from ..storage.lsm import WriteIntentError
+    from ..utils.errors import QueryError
+
+    if isinstance(e, QueryError) and e.__cause__ is not None:
+        return _sqlstate_for(e.__cause__)
+    if isinstance(e, (TransactionRetryError, WriteIntentError)):
+        return "40001"  # serialization_failure: clients retry
+    return "XX000"
+
+
+class PgServer:
+    """Accept loop: one thread + one Session per connection."""
+
+    def __init__(self, catalog=None, db=None, host: str = "127.0.0.1",
+                 port: int = 0, session_factory=None):
+        if session_factory is None:
+            if db is not None:
+                # bootstrap the shared catalog ONCE; per-connection
+                # sessions reuse it without re-scanning descriptors
+                boot = Session(catalog=catalog, db=db)
+                catalog, db = boot.catalog, boot.db
+            self._factory = lambda: Session(catalog=catalog, db=db,
+                                            bootstrap=False)
+        else:
+            self._factory = session_factory
+        self._srv = socket.create_server((host, port))
+        self.addr = self._srv.getsockname()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def serve_background(self) -> "PgServer":
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def _serve(self) -> None:
+        from ..utils import log, metric
+
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+
+            def run(c=conn):
+                try:
+                    _Conn(c, self._factory()).serve()
+                except (ConnectionError, OSError):
+                    pass  # client went away: its problem, not the server's
+                except Exception as e:
+                    log.warning(log.OPS, "pgwire connection failed",
+                                error=f"{type(e).__name__}: {e}")
+                finally:
+                    c.close()
+
+            metric.PG_CONNS.inc()
+            threading.Thread(target=run, daemon=True).start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._srv.close()

@@ -599,7 +599,7 @@ class Binder:
                 rel = Rel.scan(self.catalog, it.name)
                 sources.append(
                     Source(it.alias or it.name, rel, rel.schema.names,
-                           base_rows=self.catalog.get(it.name).num_rows,
+                           base_rows=self.catalog.get(it.name).estimated_rows(),
                            table=it.name)
                 )
             elif isinstance(it, P.SubqueryRef):

@@ -70,19 +70,33 @@ class Session:
     """
 
     def __init__(self, catalog: Catalog | None = None, db: DB | None = None,
-                 val_width: int = 128, key_width: int = 16):
+                 val_width: int = 128, key_width: int = 16,
+                 bootstrap: bool = True):
+        """bootstrap=False skips the catalog rediscovery scan — for servers
+        (pgwire) that bootstrap the shared catalog ONCE and hand every
+        connection's session the prebuilt one (re-running the descriptor
+        scan per connection would replace live KVTable objects under
+        concurrently executing sessions)."""
         self.catalog = catalog if catalog is not None else Catalog()
         self.db = db if db is not None else DB(
             Engine(key_width=key_width, val_width=val_width,
                    memtable_size=4096),
             Clock(),
         )
-        if db is not None:
+        if db is not None and bootstrap:
             # opening over an existing store: rediscover persisted tables
-            # from their descriptors (the catalog bootstrap path)
+            # from their descriptors (the catalog bootstrap path), plus any
+            # persisted ANALYZE statistics (system.table_statistics role)
             from ..kv.table import load_catalog_from_engine
 
             load_catalog_from_engine(self.catalog, self.db)
+            from . import stats as stats_mod
+
+            for tbl in self.catalog.tables.values():
+                if isinstance(tbl, KVTable):
+                    st = stats_mod.load_kv_stats(self.db, tbl.table_id)
+                    if st is not None:
+                        tbl.set_stats(st)
         # explicit-transaction state machine: NoTxn (_txn None) / Open /
         # Aborted (_txn_aborted — only ROLLBACK/COMMIT leave it)
         self._txn = None
@@ -355,6 +369,41 @@ class Session:
                 "column_name": _np.array(tbl.schema.names, dtype=object),
                 "data_type": _np.array(
                     [str(ty) for ty in tbl.schema.types], dtype=object),
+            }
+        m = _re.match(
+            r"(?is)^(?:analyze|create\s+statistics\s+\w+\s+from)\s+"
+            r"([a-z0-9_]+)$", t)
+        if m:
+            from . import stats as stats_mod
+
+            name = m.group(1)
+            tbl = self.catalog.tables.get(name)
+            if tbl is None:
+                raise BindError(f"unknown table {name!r}")
+            st = stats_mod.analyze_table(tbl)
+            tbl.set_stats(st)
+            if isinstance(tbl, KVTable):
+                stats_mod.save_kv_stats(self.db, tbl.table_id, st)
+            return {"analyzed": name, "rows": st.row_count}
+        m = _re.match(r"(?is)^show\s+statistics\s+for\s+table\s+"
+                      r"([a-z0-9_]+)$", t)
+        if m:
+            import numpy as _np
+
+            tbl = self.catalog.tables.get(m.group(1))
+            if tbl is None:
+                raise BindError(f"unknown table {m.group(1)!r}")
+            st = getattr(tbl, "table_stats", None)
+            if st is None:
+                return {"column_name": _np.array([], dtype=object)}
+            names = list(st.cols)
+            return {
+                "column_name": _np.array(names, dtype=object),
+                "row_count": _np.full(len(names), st.row_count),
+                "distinct_count": _np.array(
+                    [st.cols[n].ndv for n in names]),
+                "null_count": _np.array(
+                    [st.cols[n].null_count for n in names]),
             }
         if _re.match(r"(?is)^show\s+jobs$", t):
             import numpy as _np

@@ -1,0 +1,154 @@
+"""Table statistics — the pkg/sql/stats reduction.
+
+Reference: CREATE STATISTICS / the automatic stats collector sample tables
+into TableStatistic protos (row count, distinct count, null count, and
+histograms per column, pkg/sql/stats/new_stat.go); the optimizer's
+statistics builder consumes them for cardinality estimates
+(pkg/sql/opt/memo/statistics_builder.go). Here ANALYZE computes exact
+single-pass statistics (the tables are columnar and resident — sampling
+buys nothing at this scale) and three planner consumers read them:
+
+- join ordering starts from the largest estimated source
+  (sql/binder.py Source.base_rows);
+- the distribute planner's broadcast-join threshold compares estimated
+  rows (plan/distribute.py estimated_rows);
+- exact packed join keys derive bit widths from (lo, hi) bounds
+  (ops/join.plan_exact_key via Table.col_stats).
+
+Statistics are DELIBERATELY stale-able: they snapshot at ANALYZE time and
+perturbing them changes plans without changing data — exactly the
+reference's contract (and what the stats tests assert).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class ColumnStat:
+    lo: int | None = None  # min over non-NULL rows (int-represented cols)
+    hi: int | None = None
+    ndv: int = 0  # distinct non-NULL values
+    null_count: int = 0
+
+
+@dataclass
+class TableStats:
+    row_count: int
+    cols: dict[str, ColumnStat] = field(default_factory=dict)
+    created_unix: float = 0.0
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "row_count": self.row_count,
+            "created_unix": self.created_unix,
+            "cols": {
+                n: [c.lo, c.hi, c.ndv, c.null_count]
+                for n, c in self.cols.items()
+            },
+        }, separators=(",", ":"))
+
+    @staticmethod
+    def from_json(s: str) -> "TableStats":
+        d = json.loads(s)
+        return TableStats(
+            row_count=d["row_count"],
+            created_unix=d.get("created_unix", 0.0),
+            cols={
+                n: ColumnStat(lo, hi, ndv, nc)
+                for n, (lo, hi, ndv, nc) in d["cols"].items()
+            },
+        )
+
+
+def analyze_table(table) -> TableStats:
+    """One exact pass over host columns -> TableStats. Works for both host
+    Tables and KVTables (duck-typed on .schema/.columns/.valids)."""
+    from ..coldata.types import Family
+
+    n = table.num_rows
+    st = TableStats(row_count=int(n), created_unix=time.time())
+    if hasattr(table, "columns") and isinstance(table.columns, dict):
+        columns = {k: np.asarray(v) for k, v in table.columns.items()}
+        valids = {
+            k: np.asarray(v) for k, v in table.valids.items()
+        } if table.valids else {}
+    else:
+        # KVTable: statistics live in the RAW storage domain (scaled
+        # DECIMALs, dictionary codes) — the same domain col_stats feeds to
+        # exact-key planning — so read the columnar batch, not to_host
+        b = table.device_batch()
+        mask = np.asarray(b.mask)
+        columns = {
+            name: np.asarray(col.data)[mask]
+            for name, col in zip(table.schema.names, b.cols)
+        }
+        valids = {
+            name: np.asarray(col.valid)[mask]
+            for name, col in zip(table.schema.names, b.cols)
+        }
+    for name, t in zip(table.schema.names, table.schema.types):
+        a = columns[name]
+        cs = ColumnStat()
+        v = valids.get(name)
+        if v is not None:
+            cs.null_count = int((~v).sum())
+            live = a[v]
+        elif a.dtype == object:
+            isnull = np.array([x is None for x in a])
+            cs.null_count = int(isnull.sum())
+            live = a[~isnull]
+        else:
+            live = a
+        if len(live):
+            if live.dtype == object:
+                cs.ndv = int(len(set(live.tolist())))
+            else:
+                cs.ndv = int(len(np.unique(live)))
+            # STRING columns keep dictionary-CODE bounds (the pre-ANALYZE
+            # catalog stats include them and exact-key/sort packing relies
+            # on them; dropping bounds here would make ANALYZE degrade
+            # string-key plans)
+            if (t.family not in (Family.BYTES, Family.JSON,
+                                 Family.FLOAT, Family.BOOL)
+                    and live.dtype != object
+                    and np.issubdtype(live.dtype, np.integer)):
+                cs.lo = int(live.min())
+                cs.hi = int(live.max())
+        st.cols[name] = cs
+    return st
+
+
+# -- persistence for KV-backed tables (system keyspace) ----------------------
+# system.table_statistics role: JSON chunked across rows so statistics fit
+# any engine value width (the descriptor-chunking discipline)
+
+_STATS_PREFIX = b"\x01stat"
+
+
+def _stats_key(table_id: int, chunk: int) -> bytes:
+    return _STATS_PREFIX + b"%06d.%04d" % (table_id, chunk)
+
+
+def save_kv_stats(db, table_id: int, st: TableStats) -> None:
+    blob = st.to_json().encode("utf-8")
+    step = max(1, db.engine.val_width - 1)
+    # clear any longer previous version before writing the new chunks
+    for k, _ in db.scan(_stats_key(table_id, 0),
+                        _stats_key(table_id, 9999)):
+        db.delete(k)
+    for ci in range(0, (len(blob) + step - 1) // step):
+        db.put(_stats_key(table_id, ci), blob[ci * step:(ci + 1) * step])
+
+
+def load_kv_stats(db, table_id: int) -> TableStats | None:
+    rows = db.scan(_stats_key(table_id, 0), _stats_key(table_id, 9999))
+    if not rows:
+        return None
+    blob = b"".join(v for _, v in rows)
+    return TableStats.from_json(blob.decode("utf-8"))

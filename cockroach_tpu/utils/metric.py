@@ -135,6 +135,7 @@ ENGINE_WRITES = DEFAULT.counter(
 ENGINE_SCANS = DEFAULT.counter("storage_scans", "KV scan operations")
 ENGINE_RUNS = DEFAULT.gauge("storage_runs", "sorted runs in the LSM")
 QUERIES = DEFAULT.counter("sql_queries", "queries executed by run_operator")
+PG_CONNS = DEFAULT.counter("pgwire_conns", "pgwire connections accepted")
 QUERY_SECONDS = DEFAULT.histogram(
     "sql_query_seconds", "end-to-end query latency")
 TXN_COMMITS = DEFAULT.counter("txn_commits", "committed transactions")

@@ -219,3 +219,97 @@ def test_flow_server_survives_bad_clients(remote):
                                   cat.get("orders").schema)
     got = run_operator(inbox)
     assert len(got["o_orderkey"]) == cat.get("orders").num_rows
+
+
+# ---------------------------------------------------------------------------
+# round 4: one query across processes — SetupFlow specs + flow registry
+
+
+def _serve_host_flows(q):
+    """Child process: a HostFlowServer over the full deterministic catalog;
+    fragments arrive as serialized plan specs and build HERE."""
+    from cockroach_tpu.utils.backend import force_cpu_backend
+
+    force_cpu_backend()
+    from cockroach_tpu.flow.disthost import HostFlowServer
+
+    cat = tpch.gen_tpch(sf=0.005, seed=23)
+    srv = HostFlowServer(cat).serve_background()
+    q.put(srv.addr)
+    q.get()
+    srv.close()
+
+
+@pytest.fixture(scope="module")
+def host_servers():
+    ctx = mp.get_context("spawn")
+    qs, ps, addrs = [], [], []
+    # sequential startup: two children importing jax simultaneously thrash
+    # the single-core CI box past any reasonable timeout
+    for _ in range(2):
+        q = ctx.Queue()
+        p = ctx.Process(target=_serve_host_flows, args=(q,), daemon=True)
+        p.start()
+        addrs.append(q.get(timeout=600))
+        qs.append(q)
+        ps.append(p)
+    yield addrs
+    for q in qs:
+        q.put("stop")
+    for p in ps:
+        p.join(timeout=10)
+        if p.is_alive():
+            p.terminate()
+
+
+def test_query_across_two_processes(host_servers):
+    """A grouped aggregation runs as remote partial fragments (scan shards
+    behind each host's flow registry) + a local final stage, and equals the
+    single-process result."""
+    from cockroach_tpu.flow.disthost import (explain_hosts,
+                                             run_distributed_hosts)
+    from cockroach_tpu.ops import expr as ex
+    from cockroach_tpu.ops.aggregation import AggSpec
+    from cockroach_tpu.plan import builder as plan_builder
+    from cockroach_tpu.plan import spec as S
+
+    cat = tpch.gen_tpch(sf=0.005, seed=23)
+    schema = cat.get("orders").schema
+    pred = ex.Cmp("gt", ex.ColRef(schema.index("o_totalprice")),
+                  ex.lit(1000.0))
+    plan = S.Aggregate(
+        S.Filter(S.TableScan("orders"), pred),
+        group_cols=(schema.index("o_shippriority"),),
+        aggs=(AggSpec("count_rows", None, "n"),
+              AggSpec("sum", schema.index("o_totalprice"), "total"),
+              AggSpec("max", schema.index("o_orderdate"), "latest")),
+        mode="complete",
+    )
+    want = run_operator(plan_builder.build(plan, cat))
+    got = run_distributed_hosts(plan, cat, host_servers)
+    assert sorted(got.keys()) == sorted(want.keys())
+    for k in want:
+        np.testing.assert_allclose(
+            np.asarray(got[k], dtype=np.float64),
+            np.asarray(want[k], dtype=np.float64), rtol=1e-9,
+        )
+    # EXPLAIN (DISTSQL) renders the remote stages
+    lines = explain_hosts(plan, 2)
+    assert any("remote host 0" in ln for ln in lines)
+    assert any("remote host 1" in ln for ln in lines)
+    assert any("gateway: final aggregation" in ln for ln in lines)
+
+
+def test_host_fragments_reject_unshardable_plans():
+    from cockroach_tpu.flow.disthost import plan_host_fragments
+    from cockroach_tpu.ops.aggregation import AggSpec
+    from cockroach_tpu.plan import spec as S
+
+    sortp = S.Sort(S.TableScan("orders"), ())
+    with pytest.raises(TypeError):
+        plan_host_fragments(
+            S.Aggregate(sortp, (0,), (AggSpec("count_rows", None, "n"),)),
+            2,
+        )
+    with pytest.raises(TypeError):
+        plan_host_fragments(S.TableScan("orders"), 2)

@@ -1,0 +1,138 @@
+"""ANALYZE + statistics-driven planning (pkg/sql/stats +
+statistics_builder reduction): collection, persistence, and the three
+planner consumers — join order, broadcast threshold, exact-key layouts —
+each shown to CHANGE PLANS when the statistics are perturbed."""
+
+import numpy as np
+
+import cockroach_tpu.catalog as catalog_mod
+from cockroach_tpu.coldata.types import INT64, STRING, Schema
+from cockroach_tpu.sql import Session, sql
+from cockroach_tpu.sql import stats as stats_mod
+
+
+def _cat():
+    c = catalog_mod.Catalog()
+    c.add(catalog_mod.Table.from_strings(
+        "big", Schema.of(bk=INT64, bv=INT64),
+        {"bk": np.arange(1, 1001), "bv": np.arange(1001, 2001)},
+    ))
+    c.add(catalog_mod.Table.from_strings(
+        "small", Schema.of(sk=INT64, sv=INT64),
+        {"sk": np.arange(1, 51), "sv": np.arange(51, 101)},
+    ))
+    return c
+
+
+def test_analyze_collects_and_shows():
+    sess = Session()
+    sess.execute("create table t (a int primary key, b int, s string)")
+    sess.execute(
+        "insert into t values (1, 10, 'x'), (2, 10, 'y'), (3, null, 'x')")
+    r = sess.execute("analyze t")
+    assert r == {"analyzed": "t", "rows": 3}
+    r = sess.execute("show statistics for table t")
+    by = dict(zip(r["column_name"], zip(r["distinct_count"],
+                                        r["null_count"])))
+    assert by["a"] == (3, 0)
+    assert by["b"] == (1, 1)  # {10}, one NULL
+    assert by["s"] == (2, 0)
+    # (lo, hi) bounds feed the kernel layer through col_stats
+    t = sess.catalog.tables["t"]
+    assert t.col_stats()["b"] == (10, 10)
+
+
+def test_analyze_persists_across_restart():
+    sess = Session()
+    sess.execute("create table p (a int primary key, b int)")
+    sess.execute("insert into p values (1, 5), (2, 6)")
+    sess.execute("analyze p")
+    sess2 = Session(db=sess.db)  # fresh catalog over the same store
+    t2 = sess2.catalog.tables["p"]
+    assert t2.estimated_rows() == 2
+    assert t2.col_stats()["b"] == (5, 6)
+
+
+def test_perturbed_rowcount_flips_join_order():
+    """The binder starts its greedy join order at the LARGEST estimated
+    source; inflating `small`'s row count must flip probe/build sides."""
+    from cockroach_tpu.plan import spec as S
+
+    def probe_table(cat):
+        rel = sql(cat, "select bv, sv from big, small where bk = sk")
+        # find the HashJoin node and identify which side is the probe
+        node = rel.plan
+        while not isinstance(node, S.HashJoin):
+            node = node.input
+        side = node.probe
+        while not isinstance(side, S.TableScan):
+            side = side.input
+        return side.table
+
+    cat = _cat()
+    for name in ("big", "small"):
+        cat.get(name).set_stats(stats_mod.analyze_table(cat.get(name)))
+    assert probe_table(cat) == "big"  # truthful stats: big probes
+    # perturb: claim `small` has a million rows — the plan must flip,
+    # with the data unchanged
+    fake = stats_mod.analyze_table(cat.get("small"))
+    fake.row_count = 1_000_000
+    cat.get("small").set_stats(fake)
+    assert probe_table(cat) == "small"
+
+
+def test_perturbed_rowcount_changes_broadcast_decision():
+    """distribute() broadcasts builds below the row threshold; inflating
+    the build side's statistics must replace Broadcast with Exchange."""
+    from cockroach_tpu.plan import distribute as D
+    from cockroach_tpu.plan import spec as S
+
+    def has_broadcast(plan):
+        if isinstance(plan, S.Broadcast):
+            return True
+        return any(
+            has_broadcast(getattr(plan, f))
+            for f in ("input", "probe", "build")
+            if getattr(plan, f, None) is not None
+        )
+
+    cat = _cat()
+    rel = sql(cat, "select bv, sv from big, small where bk = sk")
+    assert has_broadcast(D.distribute(rel.plan, cat))
+    # inflate BOTH sides so the join order keeps big as the probe but the
+    # build side (small) crosses the broadcast threshold: the distribute
+    # planner must switch from replicating the build to hash-shuffling
+    fake_small = stats_mod.analyze_table(cat.get("small"))
+    fake_small.row_count = 1 << 18  # over BROADCAST_ROWS_DEFAULT (1 << 17)
+    cat.get("small").set_stats(fake_small)
+    fake_big = stats_mod.analyze_table(cat.get("big"))
+    fake_big.row_count = 1 << 20
+    cat.get("big").set_stats(fake_big)
+    rel2 = sql(cat, "select bv, sv from big, small where bk = sk")
+    assert not has_broadcast(D.distribute(rel2.plan, cat))
+
+
+def test_perturbed_bounds_change_exact_key_layout():
+    """plan_exact_key derives packed-key bit widths from (lo, hi): widening
+    the analyzed bounds must widen the layout; dropping them must disable
+    the exact-key path entirely."""
+    from cockroach_tpu.flow import operators as ops
+    from cockroach_tpu.ops.join import JoinSpec
+
+    cat = _cat()
+    for name in ("big", "small"):
+        cat.get(name).set_stats(stats_mod.analyze_table(cat.get(name)))
+
+    def layout_bits():
+        j = ops.HashJoinOp(
+            ops.ScanOp(cat.get("big")), ops.ScanOp(cat.get("small")),
+            (0,), (0,), JoinSpec("inner", True),
+        )
+        return None if j.exact_layout is None else j.exact_layout.total_bits
+
+    tight = layout_bits()
+    assert tight is not None and tight <= 10  # keys 1..1000
+    wide = stats_mod.analyze_table(cat.get("big"))
+    wide.cols["bk"].hi = 1 << 40
+    cat.get("big").set_stats(wide)
+    assert layout_bits() >= 40  # the layout followed the (perturbed) stats
