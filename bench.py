@@ -424,7 +424,8 @@ def main() -> None:
         try:
             from cockroach_tpu.bench.ycsb import run_ycsb_e
 
-            y = run_ycsb_e(n_keys=1 << 20, ops=96, scan_len=64)
+            y = run_ycsb_e(n_keys=1 << 20, ops=512, scan_len=64,
+                           concurrency=128)
             _partial["detail"]["ycsb_e_1m"] = {
                 "load_keys_per_sec": y["load_keys_per_sec"],
                 "scan_rows_per_sec": round(y["rows_per_sec"]),

@@ -86,7 +86,7 @@ def run_ycsb_e(
             else:
                 starts.append(_key(int(rng.integers(0, n_keys))))
                 n_scans += 1
-        # pad to a FIXED batch shape (multi_scan jit-specializes on B;
+        # pad to a FIXED batch shape (multi_scan_sources jit-specializes on B;
         # ragged tails would each compile their own kernel)
         while len(starts) < concurrency:
             starts.append(_key(0))

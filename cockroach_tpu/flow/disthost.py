@@ -184,11 +184,11 @@ def plan_host_fragments(plan: S.PlanNode, n_hosts: int):
     """Split an Aggregate(complete) over a scan chain into per-host partial
     fragments + the gateway's final-stage recipe.
 
-    Returns (fragments, final_info) where fragments[i] is the plan to ship
-    to host i and final_info = (group_cols, aggs, base_schema_source_plan).
-    Raises TypeError for plans the host distributor does not cover (the
-    caller falls back to local execution, exactly like the reference's
-    distSQL support checks)."""
+    Returns (fragments, (group_cols, aggs)) where fragments[i] is the plan
+    to ship to host i; the caller derives the final stage's base schema
+    from plan.input. Raises TypeError for plans the host distributor does
+    not cover (the caller falls back to local execution, exactly like the
+    reference's distSQL support checks)."""
     if not isinstance(plan, S.Aggregate) or plan.mode != "complete":
         raise TypeError("host distribution covers Aggregate(complete) roots")
     frags = [

@@ -187,12 +187,15 @@ class ScanOp(SourceOperator):
         self._batch = self.table.device_batch(self.output_schema.names)
         bounds = self._shard_bounds()
         if bounds is not None:
-            # shard by masking: rows outside [lo, hi) go dead; positions
-            # (and dense-key addressing) stay stable
+            # shard by LIVE-ROW RANK, not raw position: KV-backed tables'
+            # live rows sit at scattered merged-view positions (often past
+            # num_rows), so a positional mask would silently drop rows.
+            # For host tables live rows are a prefix, so rank == position.
+            # Positions stay stable either way (dense-key addressing holds).
             lo, hi = bounds
-            idx = jnp.arange(self._batch.capacity, dtype=jnp.int32)
+            rank = jnp.cumsum(self._batch.mask.astype(jnp.int32)) - 1
             self._batch = self._batch.with_mask(
-                self._batch.mask & (idx >= lo) & (idx < hi)
+                self._batch.mask & (rank >= lo) & (rank < hi)
             )
         cap = self._batch.capacity
         tile = self.tile

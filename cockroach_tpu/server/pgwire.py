@@ -208,13 +208,17 @@ class _Conn:
                     self._error(f"{type(e).__name__}: {e}",
                                 code=_sqlstate_for(e))
                 self._ready()
-            elif tag in (b"P", b"B", b"D", b"E", b"C", b"S", b"H"):
-                # extended protocol not implemented yet: fail the portal
-                # honestly and stay in sync at the next Sync ('S')
-                if tag == b"S":
-                    self._error("extended query protocol not supported; "
-                                "use simple query mode", code="0A000")
-                    self._ready()
+            elif tag in (b"P", b"B", b"D", b"E", b"C", b"F"):
+                # extended protocol not implemented: answer each message
+                # with an immediate ErrorResponse (responses are unbuffered
+                # here, so a client's Flush already has everything) and
+                # resynchronize at Sync
+                self._error("extended query protocol not supported; "
+                            "use simple query mode", code="0A000")
+            elif tag == b"H":  # Flush: nothing buffered, nothing to do
+                pass
+            elif tag == b"S":  # Sync ends the (failed) extended batch
+                self._ready()
             else:
                 self._error(f"unknown message {tag!r}")
                 self._ready()

@@ -773,8 +773,15 @@ class Engine:
         if not starts:
             return []
         metric.ENGINE_SCANS.inc(len(starts))
-        view = self._merged_view()
-        if view is None:
+        # sorted sources, merged lazily per WINDOW (mergingIter shape): the
+        # per-batch cost scales with the windows, never with the store — no
+        # store-wide overlay re-sort when the memtable changed
+        sources = []
+        mb = self._mem_block()
+        if mb is not None:
+            sources.append(mb)
+        sources.extend(self.runs)
+        if not sources:
             return [[] for _ in starts]
         enc = [
             (s.encode() if isinstance(s, str) else bytes(s)) for s in starts
@@ -784,39 +791,46 @@ class Engine:
         ])
         starts_words = jnp.asarray(sw)
         B = len(enc)
+        max_cap = max(s.capacity for s in sources)
         window = _pad(max(16, 4 * max_keys), _CAND_ALIGN)
         while True:
-            win, sel, conflict, complete, truncated = mvcc.multi_scan(
-                view, starts_words, jnp.int64(ts), jnp.int64(txn),
-                window=window,
+            win, sel, conflict, complete, truncated = (
+                mvcc.multi_scan_sources(
+                    tuple(sources), starts_words, jnp.int64(ts),
+                    jnp.int64(txn), window=window,
+                )
             )
-            # one host sync materializes everything the emission needs
-            sel_np = np.asarray(sel & complete).reshape(B, window)
-            if np.asarray(conflict).any():
+            # device-side: compact selected rows to [B, max_keys] BEFORE
+            # materializing — the host (and the TPU tunnel) sees B*max_keys
+            # rows, never the full windows
+            keys_d, vals_d, vlen_d, counts_d = mvcc._emit_stage(
+                win, sel & complete, B, max_keys
+            )
+            if bool(np.asarray(jnp.any(conflict))):
                 cidx = np.nonzero(np.asarray(conflict))[0]
                 raise WriteIntentError(
                     K.decode_keys(np.asarray(win.key)[cidx]),
                     [int(t) for t in np.asarray(win.txn)[cidx]],
                 )
-            counts = sel_np.sum(axis=1)
+            counts = np.asarray(counts_d)
             # a truncated window with a short result must page forward even
             # if nothing in it was selected (e.g. a run of tombstones)
             truncated_np = np.asarray(truncated)
             if (truncated_np & (counts < max_keys)).any() and (
-                window < view.capacity
+                window < max_cap
             ):
-                window = min(_pad(window * 4, _CAND_ALIGN), _pad(view.capacity))
+                window = min(_pad(window * 4, _CAND_ALIGN), _pad(max_cap))
                 continue
-            keys_np = np.asarray(win.key).reshape(B, window, -1)
-            vals_np = np.asarray(win.value).reshape(B, window, -1)
-            vlen_np = np.asarray(win.vlen).reshape(B, window)
+            keys_np = np.asarray(keys_d)
+            vals_np = np.asarray(vals_d)
+            vlen_np = np.asarray(vlen_d)
             out: list[list[tuple[bytes, bytes]]] = []
             for b in range(B):
-                idx = np.nonzero(sel_np[b])[0][:max_keys]
-                ks = K.decode_keys(keys_np[b][idx])
+                k = min(int(counts[b]), max_keys)
+                ks = K.decode_keys(keys_np[b][:k])
                 out.append([
-                    (k, bytes(v[:n]))
-                    for k, v, n in zip(ks, vals_np[b][idx], vlen_np[b][idx])
+                    (key, bytes(v[:n]))
+                    for key, v, n in zip(ks, vals_np[b][:k], vlen_np[b][:k])
                 ])
             return out
 

@@ -89,20 +89,32 @@ def block_from_host(
     cap: int | None = None,
     seq: np.ndarray | None = None,
 ) -> KVBlock:
+    """Pad on the HOST, then one upload per field. (The previous device
+    `.at[:n].set` scatters re-specialized per live count n — every
+    memtable rebuild after an insert paid ~50ms x 8 fields of XLA compile
+    on the scan path.)"""
     n = len(ts)
     cap = cap or max(1, n)
     if seq is None:
         seq = np.zeros(n, dtype=np.int64)
-    b = empty_block(cap, keys.shape[1], value.shape[1])
+
+    def pad(a: np.ndarray, dtype) -> jnp.ndarray:
+        a = np.asarray(a, dtype=dtype)
+        out = np.zeros((cap,) + a.shape[1:], dtype=dtype)
+        out[:n] = a
+        return jnp.asarray(out)
+
+    mask = np.zeros(cap, np.bool_)
+    mask[:n] = True
     return KVBlock(
-        key=b.key.at[:n].set(jnp.asarray(keys)),
-        ts=b.ts.at[:n].set(jnp.asarray(ts, dtype=jnp.int64)),
-        seq=b.seq.at[:n].set(jnp.asarray(seq, dtype=jnp.int64)),
-        txn=b.txn.at[:n].set(jnp.asarray(txn, dtype=jnp.int64)),
-        tomb=b.tomb.at[:n].set(jnp.asarray(tomb, dtype=jnp.bool_)),
-        value=b.value.at[:n].set(jnp.asarray(value)),
-        vlen=b.vlen.at[:n].set(jnp.asarray(vlen, dtype=jnp.int32)),
-        mask=b.mask.at[:n].set(True),
+        key=pad(keys, np.uint8),
+        ts=pad(ts, np.int64),
+        seq=pad(seq, np.int64),
+        txn=pad(txn, np.int64),
+        tomb=pad(tomb, np.bool_),
+        value=pad(value, np.uint8),
+        vlen=pad(vlen, np.int32),
+        mask=jnp.asarray(mask),
     )
 
 
@@ -299,13 +311,6 @@ def seek_positions(
     return pos
 
 
-@jax.jit
-def _seek_stage(view: KVBlock, starts_words: jax.Array):
-    vwords = key_words(view.key)
-    n_live = jnp.sum(view.mask, dtype=jnp.int32)
-    return seek_positions(vwords, starts_words, n_live), n_live
-
-
 @functools.partial(jax.jit, static_argnames=("window",))
 def _gather_stage(view: KVBlock, lo, n_live, window: int):
     n = view.capacity
@@ -326,56 +331,129 @@ def _gather_stage(view: KVBlock, lo, n_live, window: int):
 
 
 @functools.partial(jax.jit, static_argnames=("window",))
-def _filter_stage(view: KVBlock, win: KVBlock, lo, n_live, read_ts,
-                  reader_txn, window: int):
-    sel, conflict = mvcc_scan_filter(win, read_ts, reader_txn, window=window)
-    # completeness: a truncated window withholds rows at/past its cut key
-    n = view.capacity
-    vwords = key_words(view.key)
-    truncated = (lo + window) < n_live  # [B]
-    cut_idx = jnp.clip(lo + window - 1, 0, n - 1)
-    cut_words = vwords[cut_idx]  # [B, W]
-    wwords = key_words(win.key).reshape(lo.shape[0], window, -1)
-    below_cut = _lex_lt(wwords, cut_words[:, None, :])
-    complete = (~truncated[:, None]) | below_cut  # [B, window]
-    return sel, conflict, complete.reshape(-1), truncated
+def _window_merge_stage(wins: tuple[KVBlock, ...], cuts, truncs, window: int):
+    """Merge S per-source windows per scan: concatenate along the window
+    axis, then ONE small sort keyed (scan id, key asc, ts desc, seq desc,
+    dead-last) — the lazy merging-iterator step, paying O(B*S*window)
+    per batch instead of re-sorting the whole store.
+
+    cuts: [S, B, W] per-source truncation cut keys; truncs: [S, B] bool.
+    Returns (flat merged KVBlock of capacity B*(S*window), complete flags,
+    truncated-per-scan)."""
+    S = len(wins)
+    B = truncs.shape[1]
+    CW = S * window
+
+    def cat(field):
+        parts = [getattr(w, field).reshape((B, window) +
+                                           getattr(w, field).shape[1:])
+                 for w in wins]
+        merged = jnp.concatenate(parts, axis=1)
+        return merged.reshape((B * CW,) + merged.shape[2:])
+
+    blk = KVBlock(**{f: cat(f) for f in (
+        "key", "ts", "seq", "txn", "tomb", "value", "vlen", "mask")})
+    words = key_words(blk.key)
+    wid = jnp.repeat(jnp.arange(B, dtype=jnp.int32), CW)
+    operands = [wid, (~blk.mask)]
+    operands += [words[:, i] for i in range(words.shape[1])]
+    operands.append(~(blk.ts.astype(jnp.uint64) ^ np.uint64(1 << 63)))
+    operands.append(~(blk.seq.astype(jnp.uint64) ^ np.uint64(1 << 63)))
+    perm = jnp.arange(B * CW, dtype=jnp.int32)
+    res = jax.lax.sort(operands + [perm], num_keys=len(operands),
+                       is_stable=True)
+    p = res[-1]
+    blk = jax.tree_util.tree_map(lambda x: x[p], blk)
+
+    # completeness: a scan is truncated if ANY source cut it; rows at or
+    # past the smallest cut key among truncated sources are withheld
+    truncated = truncs.any(axis=0)  # [B]
+    _MAXW = jnp.full(cuts.shape[1:], ~jnp.uint64(0))
+    cut = _MAXW
+    for s in range(S):
+        s_cut = jnp.where(truncs[s][:, None], cuts[s], _MAXW)
+        take = _lex_lt(s_cut, cut)
+        cut = jnp.where(take[:, None], s_cut, cut)
+    wwords = key_words(blk.key).reshape(B, CW, -1)
+    below = _lex_lt(wwords, cut[:, None, :])
+    complete = (~truncated[:, None]) | below
+    return blk, complete.reshape(-1), truncated
 
 
-def multi_scan(
-    view: KVBlock,
-    starts_words: jax.Array,  # [B, W] uint64 start-key word lanes
+@functools.partial(jax.jit, static_argnames=("window",))
+def _seek_cut_stage(src: KVBlock, starts_words, window: int):
+    """Seek + cut-key extraction for ONE source. Deliberately jitted
+    SEPARATELY from the window gather: fusing the unrolled binary search
+    with the window gathers sends XLA:CPU's fusion planner into
+    minutes-long compiles (the same pathology the multi_scan split fixed);
+    apart they compile in ~1s each, and no host sync separates them."""
+    vwords = key_words(src.key)
+    n_live = jnp.sum(src.mask, dtype=jnp.int32)
+    lo = seek_positions(vwords, starts_words, n_live)
+    cut_idx = jnp.clip(lo + window - 1, 0, src.capacity - 1)
+    return lo, n_live, vwords[cut_idx], (lo + window) < n_live
+
+
+def _source_stage(src: KVBlock, starts_words, window: int):
+    lo, n_live, cut, trunc = _seek_cut_stage(src, starts_words, window)
+    return _gather_stage(src, lo, n_live, window), cut, trunc
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def _filter_stage_flat(win: KVBlock, read_ts, reader_txn, window: int):
+    return mvcc_scan_filter(win, read_ts, reader_txn, window=window)
+
+
+@functools.partial(jax.jit, static_argnames=("B", "max_keys"))
+def _emit_stage(blk: KVBlock, flags, B: int, max_keys: int):
+    """Compact each window's selected rows to its first max_keys slots ON
+    DEVICE, so the host (and, over the TPU tunnel, the wire) receives
+    B*max_keys rows instead of the full windows. One stable sort by
+    (window, ~selected, position) puts every window's hits at the front
+    of its slice."""
+    N = blk.capacity
+    CW = N // B
+    wid = jnp.repeat(jnp.arange(B, dtype=jnp.int32), CW)
+    pos = jnp.arange(N, dtype=jnp.int32)
+    _, order = jax.lax.sort(
+        [(wid.astype(jnp.int64) << 32)
+         | ((~flags).astype(jnp.int64) << 31) | pos.astype(jnp.int64),
+         pos], num_keys=1,
+    )
+    take = (jnp.arange(B, dtype=jnp.int32)[:, None] * CW
+            + jnp.arange(max_keys, dtype=jnp.int32)[None, :]).reshape(-1)
+    idx = order[take]
+    counts = jnp.sum(flags.reshape(B, CW), axis=1, dtype=jnp.int32)
+    return (blk.key[idx].reshape(B, max_keys, -1),
+            blk.value[idx].reshape(B, max_keys, -1),
+            blk.vlen[idx].reshape(B, max_keys),
+            counts)
+
+
+def multi_scan_sources(
+    sources: tuple[KVBlock, ...],
+    starts_words: jax.Array,  # [B, W]
     read_ts: jax.Array,
     reader_txn: jax.Array,
     window: int,
 ):
-    """B independent forward scans against ONE sorted view in ONE device
-    round trip — the TPU answer to per-scan iterator re-seeks (reference
-    analog: pkg/kv/kvclient/kvstreamer batching many spans into one storage
-    trip).
-
-    Each scan b seeks its start position and claims a `window`-row slice;
-    mvcc_scan_filter runs over the [B*window] packed block with window
-    boundaries so key runs cannot bleed between scans. Rows at/past a
-    truncated window's last key are withheld (their version set may be cut
-    — the pebbleMVCCScanner pagination rule); the caller grows `window`
-    geometrically while any scan is both truncated and short.
-
-    Three jits, not one: the stages compile in ~1s each, while the fused
-    composition sends XLA:CPU's fusion planner into a measured 190s
-    compile. No host sync happens between stages (async dispatch), so the
-    split costs nothing over the tunnel.
-
-    Returns (win, sel, conflict, complete, truncated) — win is the packed
-    [B*window] block; counts/emission stay host-side. truncated[b] means
-    scan b's window did not reach the end of the view (more keys exist past
-    it), so a short result must grow the window rather than terminate —
-    even when the whole window was tombstones (sel all-False)."""
-    lo, n_live = _seek_stage(view, starts_words)
-    win = _gather_stage(view, lo, n_live, window)
-    sel, conflict, complete, truncated = _filter_stage(
-        view, win, lo, n_live, read_ts, reader_txn, window
+    """B scans against S SORTED sources (memtable block + runs) with NO
+    up-front store-wide merge: per-source seeks + window gathers, one
+    window-local merge sort, one filter pass. The per-batch cost scales
+    with B*S*window, never with the store — the pebble mergingIter
+    discipline, vectorized."""
+    wins, cuts, truncs = [], [], []
+    for src in sources:
+        win, cut, trunc = _source_stage(src, starts_words, window)
+        wins.append(win)
+        cuts.append(cut)
+        truncs.append(trunc)
+    blk, complete, truncated = _window_merge_stage(
+        tuple(wins), jnp.stack(cuts), jnp.stack(truncs), window
     )
-    return win, sel, conflict, complete, truncated
+    sel, conflict = _filter_stage_flat(blk, read_ts, reader_txn,
+                                       len(sources) * window)
+    return blk, sel, conflict, complete, truncated
 
 
 # ---------------------------------------------------------------------------
