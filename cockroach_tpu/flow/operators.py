@@ -176,12 +176,18 @@ class ScanOp(SourceOperator):
 
     # -- resident mode ------------------------------------------------------
 
-    def _shard_bounds(self) -> tuple[int, int] | None:
+    def _shard_bounds(self) -> tuple[int, int | None] | None:
+        """Rank range [lo, hi) for this shard; the LAST shard is unbounded
+        (hi None): num_rows is the newest-visible count at now(), but the
+        scan's snapshot can hold MORE live rows (older snapshot before
+        deletes, or a txn's own inserts) — trailing ranks must still land
+        in some shard or a distributed scan silently drops them."""
         if self.shard is None:
             return None
         i, n = self.shard
         rows = self.table.num_rows
-        return (i * rows // n, (i + 1) * rows // n)
+        return (i * rows // n,
+                None if i == n - 1 else (i + 1) * rows // n)
 
     def _init_resident(self):
         self._batch = self.table.device_batch(self.output_schema.names)
@@ -194,9 +200,10 @@ class ScanOp(SourceOperator):
             # Positions stay stable either way (dense-key addressing holds).
             lo, hi = bounds
             rank = jnp.cumsum(self._batch.mask.astype(jnp.int32)) - 1
-            self._batch = self._batch.with_mask(
-                self._batch.mask & (rank >= lo) & (rank < hi)
-            )
+            keep = self._batch.mask & (rank >= lo)
+            if hi is not None:
+                keep = keep & (rank < hi)
+            self._batch = self._batch.with_mask(keep)
         cap = self._batch.capacity
         tile = self.tile
         if tile is None or tile <= 0 or cap % tile != 0:
@@ -222,7 +229,7 @@ class ScanOp(SourceOperator):
             self._host_valids = {
                 n: v[lo:hi] for n, v in self._host_valids.items()
             }
-            self._nrows = hi - lo
+            self._nrows = (hi if hi is not None else self._nrows) - lo
         # big tiles amortize dispatch (bounded so two in-flight double-
         # buffered tiles stay far under HBM); ~64 tiles per table keeps the
         # pipeline busy at any scale

@@ -71,6 +71,7 @@ class _Conn:
     def __init__(self, sock: socket.socket, session: Session):
         self.sock = sock
         self.session = session
+        self._ext_failed = False  # error sent; discarding until Sync
 
     # -- framing -------------------------------------------------------------
 
@@ -209,15 +210,18 @@ class _Conn:
                                 code=_sqlstate_for(e))
                 self._ready()
             elif tag in (b"P", b"B", b"D", b"E", b"C", b"F"):
-                # extended protocol not implemented: answer each message
-                # with an immediate ErrorResponse (responses are unbuffered
-                # here, so a client's Flush already has everything) and
-                # resynchronize at Sync
-                self._error("extended query protocol not supported; "
-                            "use simple query mode", code="0A000")
+                # extended protocol not implemented: ONE ErrorResponse per
+                # failed batch, then discard messages until Sync (the
+                # protocol's error-recovery rule — a second error before
+                # Sync would desync pipeline-mode clients' result queues)
+                if not self._ext_failed:
+                    self._ext_failed = True
+                    self._error("extended query protocol not supported; "
+                                "use simple query mode", code="0A000")
             elif tag == b"H":  # Flush: nothing buffered, nothing to do
                 pass
             elif tag == b"S":  # Sync ends the (failed) extended batch
+                self._ext_failed = False
                 self._ready()
             else:
                 self._error(f"unknown message {tag!r}")

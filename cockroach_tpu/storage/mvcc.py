@@ -122,17 +122,25 @@ def block_from_host(
 # Sorting / merging
 
 
+def _mvcc_sort_operands(block: KVBlock) -> list[jax.Array]:
+    """THE canonical MVCC sort key as lax.sort operands: dead rows last,
+    key bytes ascending, ts DESC, seq DESC (sign bit flipped then inverted
+    for the descending u64 encodings). sort_block and the window merge
+    must agree exactly — the filter's newest-visible logic assumes it."""
+    words = key_words(block.key)
+    operands = [~block.mask]
+    operands += [words[:, i] for i in range(words.shape[1])]
+    operands.append(~(block.ts.astype(jnp.uint64) ^ np.uint64(1 << 63)))
+    operands.append(~(block.seq.astype(jnp.uint64) ^ np.uint64(1 << 63)))
+    return operands
+
+
 @jax.jit
 def sort_block(block: KVBlock) -> KVBlock:
     """Sort by (key asc, ts desc), dead rows last — the SST/memtable order
     (pkg/storage/mvcc_key.go EncodeMVCCKey ordering)."""
-    words = key_words(block.key)
     cap = block.capacity
-    operands = [~block.mask]
-    operands += [words[:, i] for i in range(words.shape[1])]
-    # ts desc, then seq desc: flip sign bit of the int64 pattern, invert
-    operands.append(~(block.ts.astype(jnp.uint64) ^ np.uint64(1 << 63)))
-    operands.append(~(block.seq.astype(jnp.uint64) ^ np.uint64(1 << 63)))
+    operands = _mvcc_sort_operands(block)
     perm = jnp.arange(cap, dtype=jnp.int32)
     res = jax.lax.sort(operands + [perm], num_keys=len(operands), is_stable=True)
     p = res[-1]
@@ -353,12 +361,9 @@ def _window_merge_stage(wins: tuple[KVBlock, ...], cuts, truncs, window: int):
 
     blk = KVBlock(**{f: cat(f) for f in (
         "key", "ts", "seq", "txn", "tomb", "value", "vlen", "mask")})
-    words = key_words(blk.key)
     wid = jnp.repeat(jnp.arange(B, dtype=jnp.int32), CW)
-    operands = [wid, (~blk.mask)]
-    operands += [words[:, i] for i in range(words.shape[1])]
-    operands.append(~(blk.ts.astype(jnp.uint64) ^ np.uint64(1 << 63)))
-    operands.append(~(blk.seq.astype(jnp.uint64) ^ np.uint64(1 << 63)))
+    # scan id leads; within a window the CANONICAL MVCC order applies
+    operands = [wid] + _mvcc_sort_operands(blk)
     perm = jnp.arange(B * CW, dtype=jnp.int32)
     res = jax.lax.sort(operands + [perm], num_keys=len(operands),
                        is_stable=True)
@@ -399,8 +404,32 @@ def _source_stage(src: KVBlock, starts_words, window: int):
     return _gather_stage(src, lo, n_live, window), cut, trunc
 
 
-@functools.partial(jax.jit, static_argnames=("window",))
 def _filter_stage_flat(win: KVBlock, read_ts, reader_txn, window: int):
+    """Window filter, Pallas-fused when eligible (storage.pallas_filter):
+    the kernel runs the whole pebbleMVCCScanner decision in one
+    VMEM-resident pass instead of ~8 separate fused HBM passes."""
+    from ..utils import settings
+
+    mode = settings.get("storage.pallas_filter")
+    # auto: TPU only — the kernel's tiling/shift shapes target Mosaic and
+    # have never been exercised through the Triton (GPU) lowering
+    use = mode == "on" or (
+        mode == "auto" and jax.default_backend() == "tpu"
+    )
+    if (use and win.key.shape[1] == 16 and window % 128 == 0
+            and win.capacity % window == 0):
+        from .pallas_scan import pallas_scan_filter
+
+        return pallas_scan_filter(
+            win, jnp.asarray(read_ts, jnp.int64),
+            jnp.asarray(reader_txn, jnp.int64), window=window,
+            interpret=jax.default_backend() == "cpu",
+        )
+    return _filter_stage_jnp(win, read_ts, reader_txn, window)
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def _filter_stage_jnp(win: KVBlock, read_ts, reader_txn, window: int):
     return mvcc_scan_filter(win, read_ts, reader_txn, window=window)
 
 

@@ -1,0 +1,182 @@
+"""Pallas MVCC scan-filter — the pebbleMVCCScanner hot loop as a TPU kernel.
+
+Reference: pkg/storage/pebble_mvcc_scanner.go:381 advances one KV at a
+time; the jnp version (mvcc.mvcc_scan_filter) is ~8 separate fused passes
+over the block (boundary compare, visibility algebra, segmented min scan,
+broadcast-back, conflict algebra). This kernel runs the WHOLE filter in
+one VMEM-resident pass over the batched-scan window layout:
+
+- rows    = scan windows ([B, CW]: multi_scan_sources packs one scan per
+  row, CW a multiple of 128 lanes — no key run crosses a row);
+- u64 key words and i64 ts/txn arrive PRE-SPLIT as i32 hi/lo planes
+  (Mosaic's native lane type; equality and ordering compose from 32-bit
+  compares);
+- the per-key "first visible position" is a segmented min-scan along the
+  lane axis (log2(CW) shifted selects) followed by a reverse segmented
+  fill — all register/VMEM traffic, no HBM round trips between passes.
+
+The jnp filter stays the portable fallback and the correctness oracle
+(tests/test_pallas_scan.py runs both, interpret mode on CPU); the real-
+chip win is measured by the bench's YCSB phase on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import mvcc as mvcc_mod
+
+_SUBLANES = 8  # window rows per grid step (f32/i32 sublane tile)
+
+
+def _split_u64(a: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """u64/i64 [..]-array -> (hi, lo) i32 planes (bit pattern halves)."""
+    u = a.astype(jnp.uint64)
+    hi = (u >> jnp.uint64(32)).astype(jnp.uint32).astype(jnp.int32)
+    lo = u.astype(jnp.uint32).astype(jnp.int32)
+    return hi, lo
+
+
+def _u32_le(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Unsigned a <= b on i32 bit patterns (flip sign bit, signed compare)."""
+    bias = jnp.int32(-0x80000000)
+    return (a ^ bias) <= (b ^ bias)
+
+
+def _i64_le(ahi, alo, bhi, blo) -> jax.Array:
+    """(ahi:alo) <= (bhi:blo) for signed 64-bit split into i32 planes."""
+    return (ahi < bhi) | ((ahi == bhi) & _u32_le(alo, blo))
+
+
+def _shift_right(x: jax.Array, k: int, fill):
+    """Shift lanes right by k (element i reads i-k); fill on the left."""
+    if k == 0:
+        return x
+    pad = jnp.full(x.shape[:-1] + (k,), fill, x.dtype)
+    return jnp.concatenate([pad, x[..., :-k]], axis=-1)
+
+
+def _shift_left(x: jax.Array, k: int, fill):
+    if k == 0:
+        return x
+    pad = jnp.full(x.shape[:-1] + (k,), fill, x.dtype)
+    return jnp.concatenate([x[..., k:], pad], axis=-1)
+
+
+def _scan_filter_kernel(kh0, kl0, kh1, kl1, tshi, tslo, txhi, txlo,
+                        tomb, mask, rthi_ref, rtlo_ref, rxhi_ref, rxlo_ref,
+                        sel_ref, conf_ref):
+    """One grid step: [_SUBLANES, CW] windows through the full filter."""
+    CW = kh0.shape[-1]
+    khi0, klo0 = kh0[:], kl0[:]
+    khi1, klo1 = kh1[:], kl1[:]
+    ts_hi, ts_lo = tshi[:], tslo[:]
+    tx_hi, tx_lo = txhi[:], txlo[:]
+    dead = mask[:] == 0
+    is_tomb = tomb[:] != 0
+    read_hi = rthi_ref[0]
+    read_lo = rtlo_ref[0]
+    rdr_hi = rxhi_ref[0]
+    rdr_lo = rxlo_ref[0]
+
+    # key-run boundaries: adjacent-equality on both 64-bit key words
+    same = jnp.ones(khi0.shape, jnp.bool_)
+    for h, l in ((khi0, klo0), (khi1, klo1)):
+        ph = _shift_right(h, 1, 0)
+        pl_ = _shift_right(l, 1, 0)
+        same = same & (h == ph) & (l == pl_)
+    prev_dead = _shift_right(dead.astype(jnp.int32), 1, 1) != 0
+    lane = jax.lax.broadcasted_iota(jnp.int32, khi0.shape, 1)
+    boundary = (~dead) & ((lane == 0) | (~same) | prev_dead)
+
+    committed = (tx_hi == 0) & (tx_lo == 0)
+    own = (tx_hi == rdr_hi) & (tx_lo == rdr_lo) & ~committed
+    ts_le = _i64_le(ts_hi, ts_lo, read_hi, read_lo)
+    visible = (~dead) & ((committed & ts_le) | own)
+
+    big = jnp.int32(0x7FFFFFFF)
+    cand = jnp.where(visible, lane, big)
+
+    # segmented min-scan along lanes: prefix-min restarting at boundaries
+    flags = boundary
+    vals = cand
+    k = 1
+    while k < CW:
+        sh_f = _shift_right(flags.astype(jnp.int32), k, 1) != 0
+        sh_v = _shift_right(vals, k, big)
+        vals = jnp.where(flags, vals, jnp.minimum(vals, sh_v))
+        flags = flags | sh_f
+        k *= 2
+    # vals now holds, at each lane, the min over its segment PREFIX; the
+    # segment TOTAL sits at the segment's last lane. Reverse fill: propagate
+    # each segment's end value back over the segment.
+    nxt_boundary = _shift_left(boundary.astype(jnp.int32), 1, 1) != 0
+    nxt_dead = _shift_left(dead.astype(jnp.int32), 1, 1) != 0
+    is_end = (~dead) & (nxt_boundary | nxt_dead)
+    seeded = jnp.where(is_end, vals, big)
+    rflags = is_end
+    rvals = seeded
+    k = 1
+    while k < CW:
+        sh_f = _shift_left(rflags.astype(jnp.int32), k, 0) != 0
+        sh_v = _shift_left(rvals, k, big)
+        rvals = jnp.where(rflags, rvals, jnp.minimum(rvals, sh_v))
+        rflags = rflags | sh_f
+        k *= 2
+    first = rvals  # first visible lane of this lane's key run
+
+    newest = visible & (lane == first)
+    selected = newest & ~is_tomb
+
+    conflict = (~dead) & ~committed & ~own & ts_le & (lane <= first)
+
+    sel_ref[:] = selected.astype(jnp.int8)
+    conf_ref[:] = conflict.astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+def pallas_scan_filter(block, read_ts, reader_txn, window: int,
+                       interpret: bool = False):
+    """Drop-in for mvcc.mvcc_scan_filter over the window-packed layout:
+    block capacity must be B*window with window % 128 == 0 and key width
+    16 bytes (two u64 words). Returns (selected, conflict) flat bools."""
+    from jax.experimental import pallas as pl
+
+    N = block.capacity
+    B = N // window
+    words = mvcc_mod.key_words(block.key)
+    assert words.shape[1] == 2, "pallas filter covers 16-byte keys"
+
+    def plane(x):
+        return x.reshape(B, window)
+
+    kh0, kl0 = _split_u64(plane(words[:, 0]))
+    kh1, kl1 = _split_u64(plane(words[:, 1]))
+    tshi, tslo = _split_u64(plane(block.ts))
+    txhi, txlo = _split_u64(plane(block.txn))
+    tomb = plane(block.tomb).astype(jnp.int8)
+    mask = plane(block.mask).astype(jnp.int8)
+    rthi, rtlo = _split_u64(read_ts.reshape(1))
+    rxhi, rxlo = _split_u64(reader_txn.reshape(1))
+
+    rows = max(1, min(_SUBLANES, B))
+    grid = ((B + rows - 1) // rows,)
+    spec = pl.BlockSpec((rows, window), lambda i: (i, 0))
+    sspec = pl.BlockSpec((1,), lambda i: (0,))  # read_ts/reader_txn scalars
+    sel, conf = pl.pallas_call(
+        _scan_filter_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, window), jnp.int8),
+            jax.ShapeDtypeStruct((B, window), jnp.int8),
+        ),
+        grid=grid,
+        in_specs=[spec] * 10 + [sspec] * 4,
+        out_specs=(spec, spec),
+        interpret=interpret,
+    )(kh0, kl0, kh1, kl1, tshi, tslo, txhi, txlo, tomb, mask,
+      rthi, rtlo, rxhi, rxlo)
+    return sel.reshape(-1) != 0, conf.reshape(-1) != 0

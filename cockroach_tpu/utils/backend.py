@@ -111,6 +111,15 @@ def force_cpu_backend(n_devices: int | None = None) -> None:
     # the env value — override the live config, not just the env var.
     jax.config.update("jax_platforms", "cpu")
     try:
+        # pallas/checkify register MLIR lowerings for the "tpu" platform at
+        # import; once the factory pop below makes that platform unknown,
+        # any LATER pallas import raises. Import them now, while "tpu" is
+        # still a known platform (interpret-mode tests need pallas on CPU).
+        import jax.experimental.pallas  # noqa: F401
+        from jax._src import checkify  # noqa: F401
+    except Exception:  # pragma: no cover - pallas absent/changed
+        pass
+    try:
         from jax._src import xla_bridge as _xb
 
         for name in list(getattr(_xb, "_backend_factories", {})):

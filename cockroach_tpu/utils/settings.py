@@ -192,6 +192,13 @@ WORKMEM_BYTES = register_int(
     "operator variant (disk_spiller.go:103)",
     lo=1 << 16,
 )
+PALLAS_FILTER = register_enum(
+    "storage.pallas_filter", "auto",
+    "MVCC window scan-filter implementation: 'auto' uses the fused Pallas "
+    "kernel on accelerators and the jnp composition on CPU; 'on' forces "
+    "Pallas (interpret mode on CPU — for parity testing); 'off' forces jnp",
+    choices=("auto", "on", "off"),
+)
 IO_PACING = register_bool(
     "admission.io_pacing.enabled", True,
     "write admission control: engine writes pay a delay proportional to "

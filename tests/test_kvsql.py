@@ -302,3 +302,32 @@ def test_sharded_scan_covers_kv_tables():
     assert len(got["k"]) == len(full["k"]) == 50
     np.testing.assert_array_equal(np.sort(got["k"]), np.sort(full["k"]))
     np.testing.assert_array_equal(np.sort(got["v"]), np.sort(full["v"]))
+
+
+def test_sharded_scan_covers_snapshot_beyond_num_rows():
+    """The last shard is rank-unbounded: a snapshot can hold MORE live rows
+    than num_rows reports at now() (e.g. a snapshot taken before deletes);
+    those trailing ranks must not vanish from a sharded scan (regression)."""
+    import numpy as np
+
+    from cockroach_tpu.flow.operators import ScanOp, UnionOp
+    from cockroach_tpu.flow.runtime import run_operator
+    from cockroach_tpu.sql import Session
+
+    sess = Session()
+    sess.execute("create table s (k int primary key, v int)")
+    for i in range(60):
+        sess.execute(f"insert into s values ({i}, {i})")
+    tbl = sess.catalog.tables["s"]
+    snap_ts = sess.db.clock.now()
+    sess.execute("delete from s where k >= 50")
+    assert tbl.num_rows == 50  # newest-visible count
+    tbl.read_ts = snap_ts  # scan AT the pre-delete snapshot
+    try:
+        got = run_operator(UnionOp(tuple(
+            ScanOp(tbl, shard=(i, 3)) for i in range(3)
+        )))
+        assert len(got["k"]) == 60, "sharded snapshot scan dropped rows"
+        np.testing.assert_array_equal(np.sort(got["k"]), np.arange(60))
+    finally:
+        tbl.read_ts = None
