@@ -335,6 +335,29 @@ class KVTable:
             if c.lo is not None and c.hi is not None
         }
 
+    def snapshot_live_rows(self) -> int:
+        """Live-row count at the CURRENT read context (read_ts/reader_txn)
+        — what a scan of this table will actually see. num_rows counts
+        newest-visible at now() with no reader; a pinned snapshot or an
+        in-txn read can hold MORE rows, and distributed planners must size
+        shards for the snapshot, not the present."""
+        from ..storage import keys as K
+        from ..storage import mvcc
+        from ..storage import rowcodec
+
+        eng: Engine = self.db.engine
+        view = eng._merged_view()
+        if view is None:
+            return 0
+        start, end = rowcodec.table_span(self.table_id)
+        ts = self.read_ts if self.read_ts is not None else self.db.clock.now()
+        sel, _ = mvcc.mvcc_scan_filter(
+            view, jnp.int64(ts), jnp.int64(self.reader_txn),
+            jnp.asarray(K.encode_bound(start, eng.key_width)),
+            jnp.asarray(K.encode_bound(end, eng.key_width)),
+        )
+        return int(np.asarray(jnp.sum(sel, dtype=jnp.int32)))
+
     def dict_by_index(self) -> dict:
         return {i: d.snapshot() for i, d in self._dicts.items()}
 

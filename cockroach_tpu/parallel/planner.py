@@ -152,8 +152,14 @@ class _Lowering:
         schema = table.schema.select(idxs)
         full = table.dict_by_index()
         dicts = {i: full[ci] for i, ci in enumerate(idxs) if ci in full}
+        # size from the SNAPSHOT's live count where the table distinguishes
+        # it: num_rows is the newest-visible count at now(), but a KV table
+        # pinned to an older read_ts (or reading as a txn) can hold more
+        # live rows — sizing from num_rows would drop the tail at compact
+        snap_fn = getattr(table, "snapshot_live_rows", None)
+        rows = snap_fn() if callable(snap_fn) else table.num_rows
         local_cap = max(
-            1024, -(-table.num_rows // (self.D * 1024)) * 1024
+            1024, -(-rows // (self.D * 1024)) * 1024
         )
         slot = len(self.scan_specs)
         self.scan_specs.append((plan.table, tuple(names), local_cap))
@@ -598,8 +604,18 @@ class DistributedQuery:
                     from ..coldata.batch import compact
 
                     gb = t.device_batch(tuple(names))
-                    # local_cap was planned from num_rows (live count), so
-                    # every live row fits the sharded capacity
+                    # backstop for the snapshot/now() divergence (sizing
+                    # uses snapshot_live_rows): compacting more live rows
+                    # than planned would silently DROP the tail — fail
+                    # loudly instead (one live-count sync at scan setup)
+                    live = int(np.asarray(
+                        jnp.sum(gb.mask, dtype=jnp.int32)))
+                    if live > local_cap * self.D:
+                        raise RuntimeError(
+                            f"snapshot of {tname} holds {live} live rows "
+                            f"but the plan sized {local_cap * self.D}; "
+                            "re-plan after the snapshot moved"
+                        )
                     gb = compact(gb, capacity=local_cap * self.D)
                 self._scan_cache[spec] = shard_batch(gb, self.mesh)
             self._scan_batches.append(self._scan_cache[spec])

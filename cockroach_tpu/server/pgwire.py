@@ -198,6 +198,11 @@ class _Conn:
             body = self._recv_exact(n - 4)
             if tag == b"X":  # Terminate
                 return
+            if self._ext_failed and tag != b"S":
+                # error-recovery rule: after the batch's ErrorResponse,
+                # discard EVERYTHING (including stray Query/unknown tags)
+                # until Sync — any extra response would desync the client
+                continue
             if tag == b"Q":
                 sql_text = body.rstrip(b"\x00").decode("utf-8", "replace")
                 try:

@@ -195,8 +195,10 @@ WORKMEM_BYTES = register_int(
 PALLAS_FILTER = register_enum(
     "storage.pallas_filter", "auto",
     "MVCC window scan-filter implementation: 'auto' uses the fused Pallas "
-    "kernel on accelerators and the jnp composition on CPU; 'on' forces "
-    "Pallas (interpret mode on CPU — for parity testing); 'off' forces jnp",
+    "kernel on TPU and the jnp composition everywhere else (the kernel's "
+    "tiling targets Mosaic; the GPU/Triton lowering is unexercised); 'on' "
+    "forces Pallas — compiled on TPU, interpret mode on CPU for parity "
+    "testing, unsupported on GPU; 'off' forces jnp",
     choices=("auto", "on", "off"),
 )
 IO_PACING = register_bool(

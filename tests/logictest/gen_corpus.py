@@ -198,6 +198,161 @@ AREAS.append(("where_3vl", NUMS, [
 ]))
 
 
+# -- matrix areas: systematic (aggregate x predicate x grouping) sweeps ------
+# every generated directive is still independently oracle-checked by sqlite
+
+
+def _agg_matrix() -> list[tuple[str, str, str]]:
+    aggs = [("count(*)", "I"), ("count(b)", "I"), ("sum(b)", "I"),
+            ("min(b)", "I"), ("max(b)", "I"), ("avg(b)", "R"),
+            ("sum(f)", "R"), ("min(f)", "R"), ("max(f)", "R"),
+            ("count(s)", "I")]
+    preds = ["", "where a <= 7", "where b is not null", "where f > 0",
+             "where s like '%a%'"]
+    groups = [("", ""), ("group by b", "I"), ("group by s", "T")]
+    out = []
+    for agg, at in aggs:
+        for pred in preds:
+            for grp, gt in groups:
+                if grp:
+                    gcol = grp.split()[-1]
+                    sql = f"select {gcol}, {agg} from nums {pred} {grp}"
+                    out.append((gt + at, "rowsort", " ".join(sql.split())))
+                else:
+                    sql = f"select {agg} from nums {pred}"
+                    out.append((at, "nosort", " ".join(sql.split())))
+    return out
+
+
+def _cmp_matrix() -> list[tuple[str, str, str]]:
+    out = []
+    for col, lit in (("b", "10"), ("b", "0"), ("f", "1.25"), ("a", "5"),
+                     ("s", "'banana'"), ("f", "-0.5"), ("b", "-7")):
+        for op in ("<", "<=", ">", ">=", "=", "<>"):
+            out.append(("I", "rowsort",
+                        f"select a from nums where {col} {op} {lit}"))
+    for col in ("b", "f", "s"):
+        out.append(("I", "rowsort",
+                    f"select a from nums where {col} is null"))
+        out.append(("I", "rowsort",
+                    f"select a from nums where {col} is not null"))
+    for lo, hi in (("0", "20"), ("-10", "0"), ("30", "30")):
+        out.append(("I", "rowsort",
+                    f"select a from nums where b between {lo} and {hi}"))
+        out.append(("I", "rowsort",
+                    f"select a from nums where b not between {lo} and {hi}"))
+    return out
+
+
+def _order_limit_matrix() -> list[tuple[str, str, str]]:
+    out = []
+    for col in ("b", "f", "s", "a"):
+        for d in ("", " desc"):
+            for tail in ("", " limit 4", " limit 3 offset 3"):
+                out.append(("I", "nosort",
+                            f"select a from nums order by {col}{d}, a{tail}"))
+    return out
+
+
+def _join_matrix() -> list[tuple[str, str, str]]:
+    out = []
+    for how in ("", " left"):
+        for pred in ("", " where v >= 300", " where v + w > 305"):
+            joined = (f"select pl.id, v from pl{how} join pr on pl.k = pr.k"
+                      f"{pred}") if how else (
+                      f"select pl.id, v from pl, pr where pl.k = pr.k"
+                      + pred.replace("where", "and"))
+            out.append(("II", "rowsort", joined))
+    for agg in ("count(*)", "sum(v)", "min(w)"):
+        out.append(("I", "nosort",
+                    f"select {agg} from pl, pr where pl.k = pr.k"))
+    return out
+
+
+def _expr_matrix() -> list[tuple[str, str, str]]:
+    """Arithmetic/function expressions in SELECT and in WHERE."""
+    out = []
+    exprs_i = ["a + 1", "a - 3", "a * 2", "-(a)", "abs(a - 5)",
+               "coalesce(b, 0) + a", "a + coalesce(b, -(a))"]
+    for e in exprs_i:
+        out.append(("I", "rowsort", f"select {e} from nums"))
+        out.append(("I", "rowsort", f"select a from nums where {e} > 4"))
+    exprs_r = ["f * 2.0", "f + 0.25", "-(f)", "abs(f)", "floor(f) + 0.5",
+               "ceil(f) - 1.0", "coalesce(f, -9.0)"]
+    for e in exprs_r:
+        out.append(("R", "rowsort",
+                    f"select {e} from nums where f is not null"))
+        out.append(("I", "rowsort", f"select a from nums where {e} < 2.0"))
+    for pred in ("a + coalesce(b, 0) > 12", "abs(coalesce(f, -5.0)) > 2.0",
+                 "a * 2 between 4 and 12", "not (a > 5)",
+                 "a in (1, 3, 5, 7) and b is not null"):
+        out.append(("I", "rowsort", f"select a from nums where {pred}"))
+    for sel in ("a > 5", "b is null", "f > 0.0"):
+        out.append(("B", "rowsort", f"select {sel} from nums"))
+    for func in ("abs", "floor", "ceil", "sqrt"):
+        out.append(("R", "rowsort",
+                    f"select {func}(f) from nums where f > 0"))
+    for func in ("length", "upper", "lower"):
+        t = "I" if func == "length" else "T"
+        out.append((t, "rowsort",
+                    f"select {func}(s) from nums where s is not null"))
+    out.append(("I", "rowsort",
+                "select a from nums where length(s) = 5"))
+    out.append(("T", "rowsort",
+                "select substring(s, 2, 2) from nums where s is not null"))
+    return out
+
+
+AREAS.append(("matrix_expr", NUMS, _expr_matrix()))
+AREAS.append(("matrix_agg", NUMS, _agg_matrix()))
+AREAS.append(("matrix_cmp", NUMS, _cmp_matrix()))
+AREAS.append(("matrix_order_limit", NUMS, _order_limit_matrix()))
+AREAS.append(("matrix_join", PAIR, _join_matrix()))
+
+AREAS.append(("case_cast_cte", NUMS, [
+    ("I", "rowsort",
+     "select case when b > 9 then 1 when b is null then -1 else 0 end "
+     "from nums"),
+    ("I", "rowsort",
+     "select case when f > 1.0 then a else -(a) end from nums "
+     "where f is not null"),
+    ("II", "rowsort",
+     "select b, case when b = 10 then 100 else b end from nums "
+     "where b is not null"),
+    ("I", "rowsort",
+     "select a from nums where case when b is null then 0 else b end > 5"),
+    ("R", "rowsort", "select cast(a as float) from nums where a < 4"),
+    ("R", "rowsort", "select cast(b as float) from nums where b > 0"),
+    ("I", "nosort",
+     "with big as (select a from nums where b > 5) "
+     "select count(*) from big"),
+    ("II", "rowsort",
+     "with m as (select max(b) as mb from nums) "
+     "select a, mb from nums, m where b = mb"),
+    ("I", "rowsort",
+     "with pos as (select a, f from nums where f > 0) "
+     "select a from pos where f < 3.0"),
+    ("I", "nosort", "select count(distinct b) from nums"),
+    ("I", "nosort", "select count(distinct s) from nums"),
+    ("I", "nosort",
+     "select count(distinct b) from nums where a > 2"),
+]))
+
+AREAS.append(("scalar_subqueries", NUMS, [
+    ("I", "rowsort", "select a from nums where b = (select max(b) from nums)"),
+    ("I", "rowsort",
+     "select a from nums where f > (select avg(f) from nums where f > 0)"),
+    ("I", "nosort", "select count(*) from nums "
+     "where a > (select min(a) from nums)"),
+    ("I", "rowsort",
+     "select a from nums where b > (select avg(b) from nums)"),
+    ("R", "rowsort",
+     "select f from nums where f > (select min(f) from nums) + 3.0"),
+    ("I", "rowsort",
+     "select a from nums where (select count(*) from nums) = 10"),
+]))
+
+
 def _render(val, t: str) -> str:
     if val is None:
         return "NULL"

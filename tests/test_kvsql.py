@@ -331,3 +331,31 @@ def test_sharded_scan_covers_snapshot_beyond_num_rows():
         np.testing.assert_array_equal(np.sort(got["k"]), np.arange(60))
     finally:
         tbl.read_ts = None
+
+
+def test_distributed_kv_scan_sizes_from_snapshot():
+    """The SPMD planner sizes shard capacity from snapshot_live_rows: a
+    pre-delete snapshot holding more rows than num_rows must distribute
+    completely (regression: sizing from num_rows dropped the tail)."""
+    import numpy as np
+
+    from cockroach_tpu.parallel import mesh as mesh_mod
+    from cockroach_tpu.sql import Session, sql
+
+    sess = Session()
+    sess.execute("create table ds (k int primary key, v int)")
+    rows = ", ".join(f"({i}, {i * 2})" for i in range(1200))
+    sess.execute(f"insert into ds values {rows}")
+    tbl = sess.catalog.tables["ds"]
+    snap_ts = sess.db.clock.now()
+    sess.execute("delete from ds where k >= 600")
+    assert tbl.num_rows == 600
+    tbl.read_ts = snap_ts
+    try:
+        assert tbl.snapshot_live_rows() == 1200
+        rel = sql(sess.catalog, "select count(*) as n, sum(v) as s from ds")
+        got = rel.run_distributed(mesh_mod.make_mesh(8))
+        assert int(got["n"][0]) == 1200
+        assert int(got["s"][0]) == sum(i * 2 for i in range(1200))
+    finally:
+        tbl.read_ts = None
