@@ -22,21 +22,20 @@ of the ladder), BENCH_BACKEND_RETRIES,
 BENCH_BACKEND_TIMEOUT (seconds for the subprocess backend probe).
 """
 
+import faulthandler
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 import numpy as np
 
-
-def _scrub_to_cpu() -> None:
-    """Drop every non-CPU backend so a broken accelerator plugin cannot hang
-    or crash the bench."""
-    from cockroach_tpu.utils.backend import force_cpu_backend
-
-    force_cpu_backend()
+# SIGUSR1 -> dump all thread stacks to stderr (diagnosing tunnel hangs:
+# `kill -USR1 <pid>` shows whether the bench is wedged in compile, transfer,
+# or host code without killing the run)
+faulthandler.register(signal.SIGUSR1, all_threads=True)
 
 
 _probe_diag: list[str] = []
@@ -72,82 +71,6 @@ def _probe_backend(timeout_s: float) -> str | None:
     print(f"# backend probe failed rc={out.returncode}: {' | '.join(tail)}",
           file=sys.stderr, flush=True)
     return None
-
-
-def _init_backend():
-    """Backend acquisition. The TPU number IS the deliverable (r1-r3 all
-    fell back), so the probe window is wide: repeated subprocess probes with
-    growing timeouts across ~BENCH_TPU_WINDOW_S (default 900s — the tunnel
-    has been observed to recover server-side on minutes timescales), rather
-    than two quick tries. Only after the window is exhausted does the bench
-    scrub to CPU, carrying the probes' diagnostics into the emitted JSON so
-    a CPU ladder is attributable to a dead tunnel, not a silent default.
-    Returns (jax_module, platform_str)."""
-    window_s = float(os.environ.get("BENCH_TPU_WINDOW_S", "900"))
-    timeout_s = float(os.environ.get("BENCH_BACKEND_TIMEOUT", "120"))
-    platform = None
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        platform = "cpu"
-    else:
-        t0 = time.time()
-        attempt = 0
-        while time.time() - t0 < window_s:
-            attempt += 1
-            remaining = window_s - (time.time() - t0)
-            platform = _probe_backend(min(timeout_s, max(30.0, remaining)))
-            if platform is not None:
-                print(f"# backend probe ok on attempt {attempt}: {platform}",
-                      file=sys.stderr, flush=True)
-                break
-            timeout_s = min(timeout_s * 1.5, 300.0)
-            time.sleep(min(20.0, max(0.0, window_s - (time.time() - t0))))
-        if platform is None:
-            _partial["errors"].append(
-                "tpu unreachable for "
-                f"{window_s:.0f}s ({attempt} probes): "
-                + "; ".join(_probe_diag[-3:])
-            )
-    if platform is None or platform == "cpu":
-        _scrub_to_cpu()
-    import jax
-
-    # the probe only proves a THROWAWAY subprocess could init the backend;
-    # the tunnel can still wedge the in-process init, which except can't
-    # catch — a watchdog guarantees the one-JSON-line contract regardless
-    watchdog = _start_watchdog(
-        timeout_s * 1.5, "in-process backend init hung"
-    )
-    try:
-        # the probe subprocess validated this backend; init in-process
-        platform = jax.devices()[0].platform
-    except Exception as e:
-        # device vanished between probe and init — record a CPU number
-        # rather than nothing
-        print(f"# in-process backend init failed ({e}); falling back to cpu",
-              file=sys.stderr, flush=True)
-        _scrub_to_cpu()
-        platform = jax.devices()[0].platform
-    finally:
-        watchdog.cancel()
-    return jax, platform
-
-
-def _start_watchdog(timeout_s: float, what: str):
-    """If not cancelled within timeout_s, emit the error JSON line and hard-
-    exit (a wedged PJRT init cannot be interrupted from Python)."""
-    import threading
-
-    def fire():
-        print(json.dumps({
-            "metric": "tpch_bench_failed", "value": 0, "unit": "rows/sec",
-            "vs_baseline": 0.0, "error": f"watchdog: {what}",
-        }), flush=True)
-        os._exit(0)
-
-    t = threading.Timer(timeout_s, fire)
-    t.daemon = True
-    t.start()
-    return t
 
 
 def _pandas_baseline(qname, cat, res) -> float:
@@ -340,10 +263,13 @@ def _emit(final: bool) -> None:
         }), flush=True)
         return
     queries = [d for d in detail.values() if "vs_pandas" in d]
-    vals = [d["rows_per_sec"] for d in queries]
-    ratios = [d["vs_pandas"] for d in queries]
-    geomean = float(np.exp(np.mean(np.log(vals))))
-    geomean_ratio = float(np.exp(np.mean(np.log(ratios))))
+    if queries:
+        vals = [d["rows_per_sec"] for d in queries]
+        ratios = [d["vs_pandas"] for d in queries]
+        geomean = float(np.exp(np.mean(np.log(vals))))
+        geomean_ratio = float(np.exp(np.mean(np.log(ratios))))
+    else:
+        geomean, geomean_ratio = 0.0, 0.0
     out = {
         "metric": (f"tpch_sf{_partial['sf']:g}_{_partial['platform']}"
                    "_geomean_rows_per_sec"),
@@ -359,9 +285,93 @@ def _emit(final: bool) -> None:
     print(json.dumps(out), flush=True)
 
 
-def main() -> None:
+def _worker(job: str) -> None:
+    """Run ONE ladder item in THIS process (spawned by main with a hard
+    timeout): init the backend, load cached data, run the query + pandas
+    baseline, print one JSON result line on stdout. Isolation is the point —
+    the r4 tunnel wedged *inside* q1's first compile (28 min, zero CPU, no
+    exception to catch), so each item must be killable without losing the
+    ladder, and each retry gets a fresh PJRT connection."""
     sf = float(os.environ.get("TPCH_SF", "1.0"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # JAX_PLATFORMS=cpu is NOT enough: the injected plugin dials the
+        # hardware tunnel even then (and hangs when it's wedged) — the
+        # factory must be dropped before any device touch
+        from cockroach_tpu.utils.backend import force_cpu_backend
+
+        force_cpu_backend()
+    import jax  # noqa: F401  (backend chosen by env set in parent)
+
+    from cockroach_tpu.utils.backend import enable_compile_cache
+
+    enable_compile_cache()
+    platform = jax.devices()[0].platform
+    if job == "ycsb":
+        from cockroach_tpu.bench.ycsb import run_ycsb_e
+
+        y = run_ycsb_e(n_keys=1 << 20, ops=512, scan_len=64,
+                       concurrency=128)
+        print("RESULT " + json.dumps({
+            "job": job, "platform": platform,
+            "load_keys_per_sec": y["load_keys_per_sec"],
+            "scan_rows_per_sec": round(y["rows_per_sec"]),
+            "ops_per_sec": round(y["ops_per_sec"], 1),
+            "compactions": y["compactions"],
+        }), flush=True)
+        return
+    from cockroach_tpu.bench import tpch
+
+    t0 = time.time()
+    cat = tpch.gen_tpch_cached(sf=sf)
+    nrows = cat.get("lineitem").num_rows
+    print(f"# gen/load sf={sf}: {nrows} lineitems in {time.time()-t0:.1f}s "
+          f"on {platform}", file=sys.stderr, flush=True)
+    rps, ratio, warm = _bench_query(job, cat, nrows, runs)
+    print("RESULT " + json.dumps({
+        "job": job, "platform": platform,
+        "rows_per_sec": round(rps),
+        "vs_pandas": round(ratio, 3),
+        "warmup_s": round(warm, 1),
+    }), flush=True)
+
+
+def _run_worker(job: str, timeout_s: float, env: dict) -> dict | None:
+    """Spawn a worker for one ladder item; returns its parsed RESULT dict or
+    None (error recorded in _partial). Worker stderr passes through."""
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", job],
+            env=env, timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr or b"")
+        tail = (tail.decode(errors="replace") if isinstance(tail, bytes)
+                else tail).strip().splitlines()[-3:]
+        _partial["errors"].append(
+            f"{job}: worker timed out after {timeout_s:.0f}s"
+            + (f" (last: {' | '.join(tail)})" if tail else "")
+        )
+        print(f"# {job} worker TIMED OUT ({timeout_s:.0f}s)",
+              file=sys.stderr, flush=True)
+        return None
+    for line in (out.stderr or "").splitlines():
+        print(line, file=sys.stderr, flush=True)
+    for line in (out.stdout or "").splitlines():
+        if line.startswith("RESULT "):
+            print(f"# {job} done in {time.time()-t0:.0f}s",
+                  file=sys.stderr, flush=True)
+            return json.loads(line[len("RESULT "):])
+    tail = (out.stderr or "").strip().splitlines()[-3:]
+    _partial["errors"].append(
+        f"{job}: worker rc={out.returncode}: {' | '.join(tail)}"
+    )
+    return None
+
+
+def main() -> None:
+    sf = float(os.environ.get("TPCH_SF", "1.0"))
     deadline_s = float(os.environ.get("BENCH_TOTAL_S", "2700"))
     # north-star ladder (BASELINE.md): Q3/Q9/Q18 + the Q1 single-table base
     qnames = [q.strip() for q in
@@ -370,81 +380,82 @@ def main() -> None:
     _partial["sf"] = sf
     start = time.time()
 
-    jax, platform = _init_backend()
+    # probe (subprocess-isolated) but DO NOT init in this process: the
+    # parent must stay off-device so a wedged tunnel can only ever stall a
+    # killable worker, never the emitter of the final JSON line
+    window_s = float(os.environ.get("BENCH_TPU_WINDOW_S", "900"))
+    timeout_s = float(os.environ.get("BENCH_BACKEND_TIMEOUT", "120"))
+    platform = None
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        platform = "cpu"
+    else:
+        t0 = time.time()
+        attempt = 0
+        while time.time() - t0 < window_s:
+            attempt += 1
+            remaining = window_s - (time.time() - t0)
+            platform = _probe_backend(min(timeout_s, max(30.0, remaining)))
+            if platform is not None:
+                print(f"# backend probe ok on attempt {attempt}: {platform}",
+                      file=sys.stderr, flush=True)
+                break
+            timeout_s = min(timeout_s * 1.5, 300.0)
+            time.sleep(min(20.0, max(0.0, window_s - (time.time() - t0))))
+        if platform is None:
+            _partial["errors"].append(
+                f"tpu unreachable for {window_s:.0f}s ({attempt} probes): "
+                + "; ".join(_probe_diag[-3:])
+            )
+    env = dict(os.environ)
+    if platform is None or platform == "cpu":
+        platform = "cpu"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_FORCE_CPU"] = "1"
+        if "TPCH_SF" not in os.environ:
+            # TPU unreachable: record a complete CPU ladder at a scale the
+            # deadline can hold rather than a partial one at SF1
+            sf = 0.2
+            print(f"# cpu fallback: dropping to sf={sf}", file=sys.stderr,
+                  flush=True)
+    env["TPCH_SF"] = f"{sf:g}"
+    _partial["sf"] = sf
     _partial["platform"] = platform
-    if platform == "cpu" and "TPCH_SF" not in os.environ:
-        # TPU unreachable: record a complete CPU ladder at a scale the
-        # deadline can hold rather than a partial one at SF1
-        sf = 0.2
-        _partial["sf"] = sf
-        print(f"# cpu fallback: dropping to sf={sf}", file=sys.stderr,
-              flush=True)
 
-    from cockroach_tpu.utils.backend import enable_compile_cache
-
-    enable_compile_cache()
-
-    from cockroach_tpu.bench import tpch
-
-    t0 = time.time()
-    cat = tpch.gen_tpch_cached(sf=sf)
-    nrows = cat.get("lineitem").num_rows
-    print(f"# gen sf={sf}: {nrows} lineitems in {time.time()-t0:.1f}s "
-          f"on {platform}", file=sys.stderr, flush=True)
-
-    # the deadline guarantees the one-JSON-line contract even if a compile
-    # wedges: emit whatever completed, then hard-exit
-    def fire():
-        print("# deadline hit — emitting partial result",
-              file=sys.stderr, flush=True)
-        _emit(final=False)
-        os._exit(0)
-
-    import threading
-
-    killer = threading.Timer(max(60.0, deadline_s - (time.time() - start)),
-                             fire)
-    killer.daemon = True
-    killer.start()
-
-    for qname in qnames:
-        try:
-            rps, ratio, warm = _bench_query(qname, cat, nrows, runs)
-            _partial["detail"][qname] = {
-                "rows_per_sec": round(rps),
-                "vs_pandas": round(ratio, 3),
-                "warmup_s": round(warm, 1),
-            }
-        except Exception as e:  # keep benching the rest of the ladder
-            _partial["errors"].append(f"{qname}: {type(e).__name__}: {e}")
-            print(f"# {qname} FAILED: {e}", file=sys.stderr, flush=True)
-
-    # BASELINE config #5: YCSB-E at 1M keys (bulk ingest + scan-heavy ops)
+    jobs = list(qnames)
     if os.environ.get("BENCH_YCSB", "1") != "0":
-        try:
-            from cockroach_tpu.bench.ycsb import run_ycsb_e
-
-            y = run_ycsb_e(n_keys=1 << 20, ops=512, scan_len=64,
-                           concurrency=128)
-            _partial["detail"]["ycsb_e_1m"] = {
-                "load_keys_per_sec": y["load_keys_per_sec"],
-                "scan_rows_per_sec": round(y["rows_per_sec"]),
-                "ops_per_sec": round(y["ops_per_sec"], 1),
-                "compactions": y["compactions"],
-            }
-            print(f"# ycsb-e 1M keys: load {y['load_keys_per_sec']}/s, "
-                  f"scans {y['rows_per_sec']:.0f} rows/s",
-                  file=sys.stderr, flush=True)
-        except Exception as e:
-            _partial["errors"].append(f"ycsb: {type(e).__name__}: {e}")
-
-    killer.cancel()
-    if not _partial["detail"]:
-        raise RuntimeError("; ".join(_partial["errors"]) or "no queries ran")
+        jobs.append("ycsb")
+    for i, job in enumerate(jobs):
+        remaining = deadline_s - (time.time() - start) - 30.0
+        if remaining < 60.0:
+            _partial["errors"].append(
+                f"{job}: skipped (deadline: {remaining:.0f}s left)"
+            )
+            continue
+        # even budget over what's left, floored so one slot can absorb a
+        # long first compile; a wedged worker forfeits only its own slot
+        budget = max(300.0, remaining / (len(jobs) - i))
+        budget = min(budget, remaining)
+        res = _run_worker(job, budget, env)
+        if res is None:
+            continue
+        _partial["platform"] = res.pop("platform", platform)
+        job_name = res.pop("job")
+        if job_name == "ycsb":
+            _partial["detail"]["ycsb_e_1m"] = res
+        else:
+            _partial["detail"][job_name] = res
     _emit(final=True)
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        try:
+            _worker(sys.argv[2])
+        except BaseException as e:
+            print(f"# worker {sys.argv[2]} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+            sys.exit(1)
+        sys.exit(0)
     try:
         main()
     except BaseException as e:  # ALWAYS emit one parseable JSON line

@@ -1373,23 +1373,40 @@ class MergeJoinOp(OneInputOperator):
         self.build.close()
 
 
+# one-hot membership beats scatter only while the [rows, G] matrix stays a
+# cheap fused VPU pass; past this, scatter's O(rows + G) wins
+_ONEHOT_MAX_G = 64
+
+
 class SmallGroupAggregateOp(OneInputOperator):
-    """Dense-code aggregation for planner-known small group cardinality —
-    the MXU/VPU-friendly hashAggregator specialization (e.g. TPC-H Q1's
-    returnflag x linestatus). Group keys must be dictionary-coded columns
-    with known sizes; each column gets one extra code for NULL, so every
-    distinct key combination (SQL GROUP BY semantics, NULLs included) is
-    its own dense group.
+    """Dense-code aggregation for planner-bounded group key spaces — the
+    hashAggregator specialization where the packed key IS the (collision-
+    free) hash-table slot. Two kernels by cardinality:
+
+    - tiny G (<= _ONEHOT_MAX_G, e.g. TPC-H Q1's returnflag x linestatus):
+      one-hot membership matrix, a single fused VPU pass;
+    - large-but-bounded G (e.g. GROUP BY l_orderkey with catalog bounds):
+      segment scatters — O(rows) scatter + O(G) states, NO sort and NO
+      live-count host sync (the sort path's per-spool capacity sync costs a
+      tunnel RTT on remote-attached TPU).
+
+    Keys are dictionary codes (lo=0) or integer-family columns bounded by
+    catalog/ANALYZE stats (key_lows offsets). Rows outside the planned
+    bounds (stale stats) scatter to a detectable overflow slot; the
+    operator re-runs the spool through the general sort path in that case
+    rather than mis-grouping, checking the overflow count ONCE per spool.
 
     States are positionally aligned [G] arrays, so cross-tile (and
-    cross-device) merging is elementwise — no sorting anywhere."""
+    cross-device) merging is elementwise."""
 
     def __init__(self, child: Operator, group_cols: tuple[int, ...],
-                 aggs: tuple[agg_ops.AggSpec, ...], key_sizes: tuple[int, ...]):
+                 aggs: tuple[agg_ops.AggSpec, ...], key_sizes: tuple[int, ...],
+                 key_lows: tuple[int, ...] | None = None):
         super().__init__(child)
         self.group_cols = group_cols
         self.aggs = aggs
         self.key_sizes = key_sizes
+        self.key_lows = key_lows or (0,) * len(group_cols)
         base = child.output_schema
         self.base_schema = base
         self.partial_specs, _, self.final_map = partial_layout(
@@ -1407,6 +1424,10 @@ class SmallGroupAggregateOp(OneInputOperator):
             for gi, s in child.col_stats.items()
             if gi in group_cols
         }
+        # group keys keep exact bounds even without upstream stats: the
+        # output column g is in [lo, lo+size)
+        for pos, (size, lo) in enumerate(zip(self.key_sizes, self.key_lows)):
+            self.col_stats.setdefault(pos, (lo, lo + size - 1))
         self._emitted = False
 
     def init(self):
@@ -1419,30 +1440,45 @@ class SmallGroupAggregateOp(OneInputOperator):
         strides = self.strides
         G = self.G
         sizes = self.key_sizes
+        lows = self.key_lows
         pspecs = self.partial_specs
 
+        # the one-hot kernel covers the plain reductions only; statistical
+        # states (sum_f/sum_sq) always take the scatter kernel
+        use_onehot = G <= _ONEHOT_MAX_G and all(
+            s.func in ("sum", "count", "count_rows", "min", "max",
+                       "any_not_null") for s in pspecs
+        )
+
         def tile_fn(b: Batch):
-            code = agg_ops.dense_group_codes(b, gcols, strides, sizes)
-            states, rows = agg_ops.smallgroup_partial_states(
-                b, base, code, G, pspecs
-            )
-            return states, rows
+            code, oob = agg_ops.dense_group_codes(b, gcols, strides, sizes,
+                                                  lows)
+            if use_onehot:
+                states, rows = agg_ops.dense_onehot_states(
+                    b, base, code, G, pspecs
+                )
+            else:
+                states, rows = agg_ops.dense_scatter_states(
+                    b, base, code, G, pspecs
+                )
+            return states, rows, jnp.sum(oob & b.mask, dtype=jnp.int64)
 
         def merge_fn(acc, new):
-            astates, arows = acc
-            nstates, nrows = new
+            astates, arows, aoob = acc
+            nstates, nrows, noob = new
             return (agg_ops.merge_dense_states(pspecs, astates, nstates),
-                    arows + nrows)
+                    arows + nrows, aoob + noob)
 
         def finalize_fn(acc):
-            states, rows = acc
+            states, rows, _ = acc
             return agg_ops.dense_finalize(
-                base, gcols, strides, sizes, G, self.final_map, states, rows
+                base, gcols, strides, sizes, G, self.final_map, states, rows,
+                key_lows=lows,
             )
 
         self._tile_raw = tile_fn
         self._tile_fn = jax.jit(tile_fn)
-        self._merge_fn = jax.jit(merge_fn)
+        self._merge_fn = jax.jit(merge_fn, donate_argnums=0)
         self._finalize_fn = jax.jit(finalize_fn)
 
     def _next(self):
@@ -1454,4 +1490,17 @@ class SmallGroupAggregateOp(OneInputOperator):
         self._emitted = True
         if acc is None:
             return None
+        if int(acc[2]) > 0:
+            # stale-stats overflow: re-run the whole spool through the
+            # general sort-groupby path (correctness over speed; ONE check
+            # per spool, after the streaming pass)
+            from ..utils import log
+
+            log.warning(log.SQL_EXEC,
+                        "dense agg overflow; sort-path fallback",
+                        oob_rows=int(acc[2]))
+            fb = AggregateOp(self.child, self.group_cols, self.aggs,
+                             input_schema=self.base_schema)
+            fb.init()
+            return fb._next()
         return self._finalize_fn(acc)

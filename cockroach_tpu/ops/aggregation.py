@@ -531,29 +531,88 @@ def dense_layout(key_sizes: tuple[int, ...]) -> tuple[int, tuple[int, ...]]:
     return G, tuple(reversed(strides))
 
 
-def dense_group_codes(batch: Batch, group_cols, strides, key_sizes):
-    """Per-row dense group code from dictionary-coded key columns (NULL maps
-    to the extra per-column code)."""
+def dense_group_codes(batch: Batch, group_cols, strides, key_sizes,
+                      key_lows=None):
+    """Per-row dense group code from bounded key columns (NULL maps to the
+    extra per-column code). key_lows[i] offsets integer-family keys whose
+    catalog stats put them in [lo, lo+size) — dictionary codes use lo=0."""
     code = jnp.zeros((batch.capacity,), jnp.int32)
-    for gi, st, size in zip(group_cols, strides, key_sizes):
+    oob = jnp.zeros((batch.capacity,), jnp.bool_)
+    lows = key_lows or (0,) * len(group_cols)
+    for gi, st, size, lo in zip(group_cols, strides, key_sizes, lows):
         c = batch.cols[gi]
-        ci = jnp.where(c.valid, c.data.astype(jnp.int32), size)
+        v = c.data.astype(jnp.int32) - jnp.int32(lo)
+        # rows outside the planned bounds (stale stats) are flagged, not
+        # clipped into a neighboring group — callers route them to a
+        # detectable overflow slot and fall back to the sort path
+        oob = oob | (c.valid & ((v < 0) | (v >= size)))
+        ci = jnp.where(c.valid, jnp.clip(v, 0, size - 1), size)
         code = code + ci * st
-    return code
+    return code, oob
+
+
+def dense_scatter_states(
+    batch: Batch,
+    schema: Schema,
+    codes,
+    G: int,
+    specs: tuple[AggSpec, ...],
+):
+    """Scatter-based dense-code partial aggregation: rows with group code g
+    reduce into slot g of [G] state arrays via segment_* ops — O(rows)
+    scatters plus O(G) state traffic, NO sort and NO one-hot (the
+    smallgroup one-hot matmul is O(rows x G), viable only for tiny G).
+    The missing middle this covers: bounded-but-large key spaces like
+    TPC-H's GROUP BY l_orderkey (reference hash agg: hash_aggregator.go:62;
+    here the dense code IS the hash table slot, collision-free).
+
+    Returns (state_cols, group_rows) positionally aligned by code —
+    cross-tile/device merge stays elementwise (merge_dense_states)."""
+    live = batch.mask
+    seg = jnp.where(live, codes.astype(jnp.int32), G)  # dead rows drop
+    group_rows = jax.ops.segment_sum(
+        live.astype(jnp.int64), seg, num_segments=G
+    )
+    out = []
+    for spec in specs:
+        col = None
+        t = None
+        if spec.col is not None:
+            t = schema.types[spec.col]
+            col = batch.cols[spec.col]
+        data, valid = _segment_agg(spec, col, live, seg, G, t)
+        out.append((data, valid))
+    return out, group_rows
+
+
+def dense_onehot_states(
+    batch: Batch,
+    schema: Schema,
+    codes,
+    G: int,
+    specs: tuple[AggSpec, ...],
+):
+    """One-hot dense partial states (alias of smallgroup_partial_states) —
+    O(rows x G), the right shape only for tiny G where the [rows, G]
+    membership matrix rides the VPU in one fused pass."""
+    return smallgroup_partial_states(batch, schema, codes, G, specs)
 
 
 def dense_finalize(base: Schema, group_cols, strides, key_sizes, G,
-                   final_map, states, rows) -> Batch:
+                   final_map, states, rows, key_lows=None) -> Batch:
     """Decode dense group codes back into key columns and finalize the
-    aggregate states — shared by SmallGroupAggregateOp and the SPMD path."""
+    aggregate states — shared by SmallGroupAggregateOp and the SPMD path.
+    key_lows restores integer-stat key offsets (see dense_group_codes)."""
     gid = jnp.arange(G, dtype=jnp.int32)
+    lows = key_lows or (0,) * len(group_cols)
     cols = []
-    for gi, st, size in zip(group_cols, strides, key_sizes):
+    for gi, st, size, lo in zip(group_cols, strides, key_sizes, lows):
         code_i = (gid // st) % (size + 1)
         t = base.types[gi]
         valid = code_i < size  # code==size means NULL key
         cols.append(Column(
-            data=jnp.where(valid, code_i, 0).astype(t.dtype), valid=valid,
+            data=jnp.where(valid, code_i + jnp.int32(lo), 0).astype(t.dtype),
+            valid=valid,
         ))
     mask = rows > 0
     for (d, v) in states:

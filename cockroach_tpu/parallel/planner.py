@@ -300,9 +300,11 @@ class _Lowering:
 
         def emit(env):
             b = inner(env)
-            code = agg_ops.dense_group_codes(b, gcols, strides, sizes)
-            states, rows = agg_ops.smallgroup_partial_states(
-                b, base, code, G, pspecs
+            code, _ = agg_ops.dense_group_codes(b, gcols, strides, sizes)
+            states, rows = (
+                agg_ops.dense_onehot_states(b, base, code, G, pspecs)
+                if G <= 64
+                else agg_ops.dense_scatter_states(b, base, code, G, pspecs)
             )
             if not replicated:
                 states = agg_ops.psum_dense_states(pspecs, states, AXIS)

@@ -12,6 +12,49 @@ from ..utils import settings
 from . import spec as S
 
 
+def _plan_dense_agg(child: Operator, group_cols, aggs):
+    """(key_sizes, key_lows) for the dense scatter aggregation when every
+    group key is bounded — by catalog/ANALYZE stats (integer families) or
+    dictionary size (strings) — and the packed code space fits the
+    sql.distsql.dense_agg_states budget. The dense code replaces the hash
+    table slot (reference: colexechash hashtable.go:215) collision-free."""
+    from ..coldata.types import Family
+    from ..ops.aggregation import STAT_FUNCS
+
+    if not settings.get("sql.distsql.dense_agg.enabled"):
+        return None
+    for spec in aggs:
+        # dense states cover the decomposable aggregates; avg/var decompose
+        # in partial_layout, so only truly unsupported funcs bail
+        if spec.func not in ("sum", "count", "count_rows", "min", "max",
+                             "avg", "any_not_null") + STAT_FUNCS:
+            return None
+    sizes, lows = [], []
+    G = 1
+    budget = settings.get("sql.distsql.dense_agg_states")
+    for gi in group_cols:
+        t = child.output_schema.types[gi]
+        if t.family is Family.STRING and gi in child.dictionaries:
+            size, lo = len(child.dictionaries[gi]), 0
+        elif t.family in (Family.FLOAT, Family.BYTES, Family.JSON,
+                          Family.STRING):
+            return None
+        else:
+            st = child.col_stats.get(gi)
+            if st is None:
+                return None
+            lo, hi = int(st[0]), int(st[1])
+            size = hi - lo + 1
+            if size <= 0:
+                return None
+        sizes.append(size)
+        lows.append(lo)
+        G *= size + 1  # +1: the per-key NULL code (dense_layout)
+        if G > budget:
+            return None
+    return tuple(sizes), tuple(lows)
+
+
 def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
     if isinstance(plan, S.TableScan):
         return ops.ScanOp(
@@ -30,6 +73,13 @@ def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
             return ops.SmallGroupAggregateOp(
                 child, plan.group_cols, plan.aggs, plan.key_sizes
             )
+        if plan.mode == "complete":
+            dense = _plan_dense_agg(child, plan.group_cols, plan.aggs)
+            if dense is not None:
+                sizes, lows = dense
+                return ops.SmallGroupAggregateOp(
+                    child, plan.group_cols, plan.aggs, sizes, key_lows=lows
+                )
         return ops.AggregateOp(child, plan.group_cols, plan.aggs, plan.mode)
     if isinstance(plan, S.ScalarAggregate):
         return ops.ScalarAggregateOp(build(plan.input, catalog), plan.aggs)

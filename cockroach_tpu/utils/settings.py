@@ -234,6 +234,13 @@ DENSE_AGG = register_bool(
     "(falls back to the general sort-groupby path when off)",
     metamorphic=True,
 )
+DENSE_AGG_STATES = register_int(
+    "sql.distsql.dense_agg_states", 1 << 23,
+    "maximum dense group-code space (product of per-key bounds) for the "
+    "scatter-based dense aggregation path; larger key spaces use the "
+    "general sort-groupby path",
+    lo=64, hi=1 << 28,
+)
 COLLECT_STATS = register_bool(
     "sql.stats.collect_execution_stats", False,
     "collect per-operator ComponentStats on every query; stats are recorded "
