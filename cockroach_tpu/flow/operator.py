@@ -101,6 +101,15 @@ class Operator:
         None means this operator is a pipeline barrier."""
         return None
 
+    def post_run_update(self) -> bool:
+        """End-of-query hook: adaptive operators fetch their deferred device
+        counters here (ONE sync at query end, never per tile — a host sync
+        costs a tunnel RTT on remote-attached TPU) and update sticky
+        execution choices. Returns True when this run's OUTPUT was invalid
+        (e.g. a speculative emission capacity overflowed) and the runtime
+        must re-run the query with the corrected choices."""
+        return False
+
     def close(self) -> None:
         """Closer analog (colexecop/operator.go:194)."""
 

@@ -378,11 +378,13 @@ class ProjectOp(OneInputOperator):
         }
         for i, d in dict_overrides:
             self.dictionaries[i] = d
-        self.col_stats = {
-            i: self.child.col_stats[e.idx]
-            for i, e in enumerate(exprs)
-            if isinstance(e, ex.ColRef) and e.idx in self.child.col_stats
-        }
+        # bounds propagate through computed columns (EXTRACT/arithmetic),
+        # not just bare references — keeps dense-key planning alive
+        self.col_stats = {}
+        for i, e in enumerate(exprs):
+            b = ex.expr_bounds(e, schema, self.child.col_stats)
+            if b is not None:
+                self.col_stats[i] = b
 
         def raw(b: Batch) -> Batch:
             cols = []
@@ -826,6 +828,29 @@ class HashJoinOp(OneInputOperator):
             spec.build_unique or spec.join_type in ("semi", "anti")
         )
         self._analytic = None
+        # Adaptive compact emission. A selective probe (e.g. TPC-H Q18's
+        # lineitem against 14 surviving orders) emits probe-aligned tiles
+        # that are almost entirely dead; every downstream kernel then pays
+        # O(tile x ncols) for a handful of rows. Sticky modes:
+        #   learn       first run: probe output materializes with a live
+        #               count per tile (device futures, fetched ONCE at
+        #               query end in post_run_update)
+        #   compact     output compacts in-kernel to _emit_cap; counts keep
+        #               recording so an overflow (count > cap: results
+        #               truncated) is detected at query end and the runtime
+        #               re-runs with a corrected cap
+        #   transparent dense probes: fully fused into the consumer (no
+        #               materialization, no counts)
+        from ..utils import settings as _settings
+
+        self._emit_mode = (
+            "learn" if (self._fusable and _settings.get(
+                "sql.distsql.join_compact_emit"))
+            else "transparent"
+        )
+        self._emit_cap = None
+        self._emit_counts: list = []
+        self._emit_tilecap = 0
 
     def _plan_analytic(self):
         """Dense analytic build detection: the build side is a position-
@@ -932,7 +957,15 @@ class HashJoinOp(OneInputOperator):
     def _set_probe(self, kind: str):
         """Install the probe function for the index strategy chosen at build
         time. All strategies share the (probe, build_batch, index) calling
-        convention so fusion and the pull path stay uniform."""
+        convention so fusion and the pull path stay uniform. Cached per
+        strategy kind: a fresh closure per init() would invalidate every
+        downstream jit composition keyed on its identity (re-tracing the
+        whole fused segment once per query run)."""
+        if getattr(self, "_probe_kind", None) == kind and (
+                kind != "analytic" or self._probe_analytic == self._analytic):
+            return
+        self._probe_kind = kind
+        self._probe_analytic = self._analytic if kind == "analytic" else None
         pschema = self.child.output_schema
         bschema = self.build.output_schema
         pkeys, bkeys = self.probe_keys, self.build_keys
@@ -1073,6 +1106,17 @@ class HashJoinOp(OneInputOperator):
             return None
         if getattr(self, "_grace", None) is not None:
             return None  # spilled: the Grace join drives the probe itself
+        if not self._initialized:
+            self.init()
+        if self._emit_mode != "transparent":
+            # learn/compact: this join is a tile SOURCE — it drives the
+            # child chain through its own (chain o probe [o compact])
+            # kernel, records a live count per tile (device future, fetched
+            # once per query in post_run_update) and hands downstream
+            # consumers small compacted tiles to compose their kernels on.
+            # Costs one extra async dispatch per tile; saves O(tile x
+            # ncols) per downstream operator when the probe is selective.
+            return self, _identity_fn, ()
         if self.fused_depth() > settings.get("sql.distsql.max_fused_joins"):
             # compile-size safety valve: very deep probe pipelines split at
             # this join (it runs as its own per-operator jit) so one fused
@@ -1081,8 +1125,6 @@ class HashJoinOp(OneInputOperator):
         parts = self.child.stream_parts()
         if parts is None:
             return None
-        if not self._initialized:
-            self.init()
         self._ensure_built()
         if getattr(self, "_grace", None) is not None:
             return None  # the build spilled while spooling
@@ -1101,6 +1143,90 @@ class HashJoinOp(OneInputOperator):
             self._chain_raw = raw
         return src, self._chain_fn, cargs + (self._build_batch, self._index)
 
+    def _emit_kernel(self, cfn, nc):
+        """(chain o probe o count [o compact]) jit for source-mode emission,
+        cached on (chain fn, probe fn, emission cap)."""
+        from ..coldata.batch import compact as compact_batch
+
+        key = (cfn, self._probe_raw, self._emit_cap)
+        if getattr(self, "_emit_kern_key", None) == key:
+            return self._emit_kern
+        raw = self._probe_raw
+        cap = self._emit_cap
+
+        def kern(t, *a):
+            out = raw(cfn(t, *a[:nc]) if cfn is not None else t,
+                      a[nc], a[nc + 1])
+            cnt = jnp.sum(out.mask, dtype=jnp.int64)
+            if cap is not None:
+                out = compact_batch(out, capacity=cap)
+            return out, cnt
+
+        self._emit_kern = jax.jit(kern)
+        self._emit_kern_key = key
+        return self._emit_kern
+
+    def stream_tiles(self):
+        """Source-mode drive loop (learn/compact emission)."""
+        self._ensure_built()
+        if getattr(self, "_grace", None) is not None:
+            # build spilled mid-spool: serve grace output as plain tiles
+            while True:
+                b = self._grace._next()
+                if b is None:
+                    return
+                yield b
+            return
+        parts = self.child.stream_parts()
+        if parts is not None:
+            src, cfn, cargs = parts
+            kern = self._emit_kernel(cfn, len(cargs))
+            args = cargs + (self._build_batch, self._index)
+            for t in src.stream_tiles():
+                out, cnt = kern(t, *args)
+                self._emit_counts.append(cnt)
+                if self._emit_cap is None:
+                    self._emit_tilecap = max(self._emit_tilecap, out.capacity)
+                yield out
+            return
+        kern = self._emit_kernel(None, 0)
+        while True:
+            b = self.child.next_batch()
+            if b is None:
+                return
+            out, cnt = kern(b, self._build_batch, self._index)
+            self._emit_counts.append(cnt)
+            if self._emit_cap is None:
+                self._emit_tilecap = max(self._emit_tilecap, out.capacity)
+            yield out
+
+    def post_run_update(self) -> bool:
+        if not self._emit_counts:
+            return False
+        counts = np.asarray(jax.block_until_ready(
+            jnp.stack(self._emit_counts)
+        ))
+        self._emit_counts = []
+        mx = int(counts.max()) if counts.size else 0
+        overflow = (
+            self._emit_mode == "compact" and self._emit_cap is not None
+            and mx > self._emit_cap
+        )
+        tile = self._emit_tilecap
+        if tile and mx * 4 <= tile:
+            self._emit_cap = max(1024, _next_pow2(2 * mx))
+            self._emit_mode = "compact"
+        else:
+            self._emit_mode = "transparent"
+            self._emit_cap = None
+        if overflow:
+            from ..utils import log
+
+            log.warning(log.SQL_EXEC,
+                        "join emission cap overflowed; re-running",
+                        max_rows=mx)
+        return overflow
+
     def _next(self):
         self._ensure_built()
         if getattr(self, "_grace", None) is not None:
@@ -1109,6 +1235,14 @@ class HashJoinOp(OneInputOperator):
         if p is None:
             return None
         if self._probe_raw is not None:
+            if self._emit_mode != "transparent":
+                out, cnt = self._emit_kernel(None, 0)(
+                    p, self._build_batch, self._index
+                )
+                self._emit_counts.append(cnt)
+                if self._emit_cap is None:
+                    self._emit_tilecap = max(self._emit_tilecap, out.capacity)
+                return out
             return self._probe_fn(p, self._build_batch, self._index)
         if self._out_cap <= 0:
             # initial capacity: assume FK-ish fanout <= 1 per probe row
@@ -1444,10 +1578,21 @@ class SmallGroupAggregateOp(OneInputOperator):
         pspecs = self.partial_specs
 
         # the one-hot kernel covers the plain reductions only; statistical
-        # states (sum_f/sum_sq) always take the scatter kernel
-        use_onehot = G <= _ONEHOT_MAX_G and all(
-            s.func in ("sum", "count", "count_rows", "min", "max",
-                       "any_not_null") for s in pspecs
+        # states (sum_f/sum_sq) always take the scatter kernel. Platform
+        # split (segscan.use_scans rationale inverted): on CPU scatter is a
+        # cheap serial loop and one-hot is O(rows x G) real work, so scatter
+        # wins at EVERY G; on TPU the [rows, G] membership matrix rides the
+        # VPU in one fused pass while scatter serializes, so tiny G keeps
+        # one-hot
+        from ..ops import segscan
+
+        use_onehot = (
+            segscan.use_scans()
+            and G <= _ONEHOT_MAX_G
+            and all(
+                s.func in ("sum", "count", "count_rows", "min", "max",
+                           "any_not_null") for s in pspecs
+            )
         )
 
         def tile_fn(b: Batch):

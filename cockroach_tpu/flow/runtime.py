@@ -35,6 +35,17 @@ def _shrink_for_readback(b):
     return b
 
 
+def _post_run_updates(op) -> bool:
+    """Give every operator its end-of-query adaptive update (deferred
+    device-counter fetch — the ONE host sync speculative execution pays per
+    query). Returns True when any operator invalidated this run's output
+    (speculative emission capacity overflowed) and the query must re-run."""
+    rerun = op.post_run_update()
+    for c in op.children():
+        rerun = _post_run_updates(c) or rerun
+    return rerun
+
+
 def run_operator(root) -> dict[str, np.ndarray]:
     import time
 
@@ -43,15 +54,26 @@ def run_operator(root) -> dict[str, np.ndarray]:
 
     metric.QUERIES.inc()
     t0 = time.perf_counter()
-    outs: list[dict[str, np.ndarray]] = []
     try:
-        root.init()
-        while True:
-            b = root.next_batch()
-            if b is None:
+        # speculative-capacity retry loop: operators run with sticky learned
+        # shapes and validate their deferred counters after the pull; an
+        # overflow (rare: first run after a data change) re-runs the query
+        # with corrected capacities rather than paying a sync per tile
+        for attempt in range(4):
+            outs: list[dict[str, np.ndarray]] = []
+            root.init()
+            while True:
+                b = root.next_batch()
+                if b is None:
+                    break
+                b = _shrink_for_readback(b)
+                outs.append(to_host(b, root.output_schema, root.dictionaries))
+            if not _post_run_updates(root):
                 break
-            b = _shrink_for_readback(b)
-            outs.append(to_host(b, root.output_schema, root.dictionaries))
+        else:
+            raise RuntimeError(
+                "speculative emission capacities failed to converge"
+            )
     except _PASSTHROUGH:
         raise
     except Exception as e:

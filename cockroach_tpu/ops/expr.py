@@ -199,6 +199,68 @@ def _binop_type(op: str, lt: SQLType, rt: SQLType) -> SQLType:
 # Evaluation (inside trace)
 
 
+def expr_bounds(e: Expr, schema: Schema, col_stats: dict) -> tuple | None:
+    """(lo, hi) value bounds of an integer-family expression, derived from
+    input column stats — the statistics-propagation analog of the
+    reference's statisticsBuilder (opt/memo/statistics_builder.go) applied
+    to scalar projections, so dense-key planning (aggregation slots, packed
+    join keys, sort operands) survives computed columns like
+    EXTRACT(YEAR FROM o_orderdate)."""
+    if isinstance(e, ColRef):
+        s = col_stats.get(e.idx)
+        return None if s is None else (int(s[0]), int(s[1]))
+    if isinstance(e, Const):
+        try:
+            v = int(e.value)
+        except (TypeError, ValueError):
+            return None
+        return (v, v)
+    if isinstance(e, ExtractYear):
+        b = expr_bounds(e.arg, schema, col_stats)
+        if b is None:
+            return None
+        return (_year_of_day(b[0]), _year_of_day(b[1]))
+    if isinstance(e, BinOp) and e.op in ("+", "-", "*"):
+        lt = expr_type(e.left, schema)
+        rt = expr_type(e.right, schema)
+        # DECIMAL arithmetic rescales operands (scale alignment /
+        # multiplication scale growth) — raw bounds would be in the wrong
+        # units; only plain integer/date arithmetic propagates
+        if (lt.family in (Family.FLOAT, Family.DECIMAL)
+                or rt.family in (Family.FLOAT, Family.DECIMAL)):
+            return None
+        lb = expr_bounds(e.left, schema, col_stats)
+        rb = expr_bounds(e.right, schema, col_stats)
+        if lb is None or rb is None:
+            return None
+        if e.op == "+":
+            return (lb[0] + rb[0], lb[1] + rb[1])
+        if e.op == "-":
+            return (lb[0] - rb[1], lb[1] - rb[0])
+        prods = [a * b for a in lb for b in rb]
+        return (min(prods), max(prods))
+    if isinstance(e, Cast):
+        if e.to.family in (Family.FLOAT, Family.STRING, Family.BYTES):
+            return None
+        # int-to-int casts preserve value bounds (the cast matrix rounds
+        # DECIMAL scale changes; bounds stay conservative by using both)
+        b = expr_bounds(e.arg, schema, col_stats)
+        ft = expr_type(e.arg, schema)
+        if b is None or ft.family is Family.FLOAT:
+            return None
+        if ft.family is Family.DECIMAL or e.to.family is Family.DECIMAL:
+            return None  # scale changes rescale values; skip
+        return b
+    return None
+
+
+def _year_of_day(days: int) -> int:
+    import datetime
+
+    return (datetime.date(1970, 1, 1)
+            + datetime.timedelta(days=int(days))).year
+
+
 def eval_expr(e: Expr, cols, schema: Schema):
     """Evaluate e over a batch's columns -> (data, valid). `cols` is the tuple
     of Column; arrays are full-tile, mask applied by the caller."""

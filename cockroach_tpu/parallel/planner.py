@@ -301,9 +301,11 @@ class _Lowering:
         def emit(env):
             b = inner(env)
             code, _ = agg_ops.dense_group_codes(b, gcols, strides, sizes)
+            from ..ops import segscan
+
             states, rows = (
                 agg_ops.dense_onehot_states(b, base, code, G, pspecs)
-                if G <= 64
+                if G <= 64 and segscan.use_scans()
                 else agg_ops.dense_scatter_states(b, base, code, G, pspecs)
             )
             if not replicated:

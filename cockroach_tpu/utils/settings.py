@@ -234,6 +234,12 @@ DENSE_AGG = register_bool(
     "(falls back to the general sort-groupby path when off)",
     metamorphic=True,
 )
+JOIN_COMPACT_EMIT = register_bool(
+    "sql.distsql.join_compact_emit", True,
+    "adaptively compact selective join probe output in-kernel (learned "
+    "sticky capacity, overflow-checked once per query)",
+    metamorphic=True,
+)
 DENSE_AGG_STATES = register_int(
     "sql.distsql.dense_agg_states", 1 << 23,
     "maximum dense group-code space (product of per-key bounds) for the "
