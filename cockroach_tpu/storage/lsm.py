@@ -163,6 +163,65 @@ class _Memtable:
         return len(self.ts)
 
 
+class _TsCache:
+    """Newest committed write timestamp per key — the kvserver/tscache role
+    backing the WriteTooOld check, LSM-shaped so BULK ingest stays O(1)
+    python-side: each ingest lands as one sorted numpy key batch (void
+    dtype: memcmp order), single writes overlay a dict, and lookups take
+    max(overlay, binary search per batch). Batches fold together once the
+    list grows, keeping the per-lookup batch count bounded. The prior
+    per-key dict build was ~1M tobytes+dict inserts per 1M-key ingest —
+    measured as a third of YCSB load time."""
+
+    _MAX_BATCHES = 8
+
+    def __init__(self, key_width: int):
+        self.kw = key_width
+        self.over: dict[bytes, int] = {}
+        self.batches: list[tuple[np.ndarray, np.ndarray]] = []
+
+    def _void(self, keys_u8: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(keys_u8).view(f"V{self.kw}").reshape(-1)
+
+    def bulk(self, keys_u8: np.ndarray, ts) -> None:
+        """[N, kw] uint8 keys committed at ts (scalar or [N] array)."""
+        if len(keys_u8) == 0:
+            return
+        v = self._void(keys_u8)
+        t = (np.full(len(v), int(ts), np.int64) if np.isscalar(ts)
+             else np.asarray(ts, np.int64))
+        order = np.argsort(v, kind="stable")
+        self.batches.append((v[order], t[order]))
+        if len(self.batches) > self._MAX_BATCHES:
+            self._fold()
+
+    def _fold(self) -> None:
+        ks = np.concatenate([k for k, _ in self.batches])
+        ts = np.concatenate([t for _, t in self.batches])
+        order = np.argsort(ks, kind="stable")
+        k, t = ks[order], ts[order]
+        new = np.concatenate([[True], k[1:] != k[:-1]])
+        gid = np.cumsum(new) - 1
+        mx = np.zeros(int(gid[-1]) + 1, np.int64)
+        np.maximum.at(mx, gid, t)
+        self.batches = [(k[new], mx)]
+
+    def get(self, b: bytes, _default: int = 0) -> int:
+        t = self.over.get(b, 0)
+        if self.batches and len(b) <= self.kw:
+            q = np.frombuffer(b.ljust(self.kw, b"\x00"),
+                              dtype=f"V{self.kw}")[0]
+            for keys, ts in self.batches:
+                i = int(np.searchsorted(keys, q))
+                if i < len(keys) and keys[i] == q:
+                    t = max(t, int(ts[i]))
+        return t
+
+    def put(self, b: bytes, ts: int) -> None:
+        if ts > self.over.get(b, 0):
+            self.over[b] = ts
+
+
 class Engine:
     """MVCC LSM engine over device-resident sorted runs.
 
@@ -213,7 +272,7 @@ class Engine:
         self._locks: dict[bytes, int] = {}
         # host-side newest-committed-timestamp index (tscache analog): keeps
         # the per-write WriteTooOld check off the device
-        self._newest_committed: dict[bytes, int] = {}
+        self._newest_committed = _TsCache(key_width)
         # read caches, invalidated by generation counters
         self._gen = 0  # bumps whenever the run set changes
         # per-run host key bytes for iterator seeks (block-index analog);
@@ -377,8 +436,8 @@ class Engine:
         self._seq = max(self._seq, seq)
         if txn != 0:
             self._locks[b] = int(txn)
-        elif ts > self._newest_committed.get(b, 0):
-            self._newest_committed[b] = ts
+        else:
+            self._newest_committed.put(b, ts)
         self.mem.keys.append(b)
         self.mem.ts.append(ts)
         self.mem.seq.append(seq)
@@ -487,14 +546,9 @@ class Engine:
 
         metric.ENGINE_INGESTS.inc()
         metric.ENGINE_RUNS.set(len(self.runs))
-        # vectorized tscache update (bytes() per row is host work, but one
-        # pass over the batch, not one device trip per key)
-        t = int(ts)
-        nc = self._newest_committed
-        for row in keys:
-            b = row.tobytes().rstrip(b"\x00")
-            if t > nc.get(b, 0):
-                nc[b] = t
+        # one sorted-batch tscache insert for the whole ingest (no per-key
+        # host work — see _TsCache)
+        self._newest_committed.bulk(kb[:n], int(ts))
         if len(self.runs) > self.l0_trigger:
             self.compact(bottom=False)
 
@@ -786,10 +840,7 @@ class Engine:
         enc = [
             (s.encode() if isinstance(s, str) else bytes(s)) for s in starts
         ]
-        sw = np.stack([
-            np.asarray(K.encode_bound(s, self.key_width)) for s in enc
-        ])
-        starts_words = jnp.asarray(sw)
+        starts_words = jnp.asarray(K.encode_bounds(enc, self.key_width))
         B = len(enc)
         max_cap = max(s.capacity for s in sources)
         window = _pad(max(16, 4 * max_keys), _CAND_ALIGN)
@@ -869,8 +920,8 @@ class Engine:
                              int(txn), commit)
         if commit:
             for k, t in self._locks.items():
-                if t == txn and commit_ts > self._newest_committed.get(k, 0):
-                    self._newest_committed[k] = int(commit_ts)
+                if t == txn:
+                    self._newest_committed.put(k, int(commit_ts))
         self._locks = {k: t for k, t in self._locks.items() if t != txn}
         self.flush_mem_only()
         self.runs = [
@@ -1022,11 +1073,9 @@ class Engine:
                     # a global floor would block writers on EVERY key until
                     # the clock passed the restored max timestamp
                     idx = np.nonzero(cm)[0]
-                    ks = K.decode_keys(np.asarray(r.key)[idx])
-                    ts = np.asarray(r.ts)[idx]
-                    for kk, tt in zip(ks, ts):
-                        if int(tt) > eng._newest_committed.get(kk, 0):
-                            eng._newest_committed[kk] = int(tt)
+                    eng._newest_committed.bulk(
+                        np.asarray(r.key)[idx], np.asarray(r.ts)[idx]
+                    )
             im = m & (np.asarray(r.txn) != 0)
             if im.any():
                 ks = K.decode_keys(np.asarray(r.key)[np.nonzero(im)[0]])
