@@ -279,6 +279,7 @@ class Engine:
         # keyed by id with a strong run ref so ids can't be reused
         self._run_key_cache: dict[int, tuple] = {}
         self._runs_view_cache: tuple[int, mvcc.KVBlock] | None = None
+        self._scan_windows: dict[int, int] = {}  # max_keys -> learned window
         self._mem_cache: tuple[int, mvcc.KVBlock] | None = None
         self._overlay_cache = None  # ((gen, mem len), merged view)
         # durable write-ahead log
@@ -843,7 +844,13 @@ class Engine:
         starts_words = jnp.asarray(K.encode_bounds(enc, self.key_width))
         B = len(enc)
         max_cap = max(s.capacity for s in sources)
-        window = _pad(max(16, 4 * max_keys), _CAND_ALIGN)
+        # sticky converged window (keyed by max_keys): version-dense key
+        # ranges force window growth past 4*max_keys, and re-learning the
+        # growth by retrying EVERY batch would pay the whole ladder of
+        # extra device passes per call
+        window = self._scan_windows.get(
+            max_keys, _pad(max(16, 4 * max_keys), _CAND_ALIGN)
+        )
         while True:
             win, sel, conflict, complete, truncated = (
                 mvcc.multi_scan_sources(
@@ -871,6 +878,7 @@ class Engine:
                 window < max_cap
             ):
                 window = min(_pad(window * 4, _CAND_ALIGN), _pad(max_cap))
+                self._scan_windows[max_keys] = window
                 continue
             keys_np = np.asarray(keys_d)
             vals_np = np.asarray(vals_d)

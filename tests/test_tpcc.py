@@ -1,5 +1,6 @@
-"""TPC-C (reduced) over the transactional KV layer: NewOrder/Payment as
-multi-statement transactions with the 3.3.2-style consistency invariants
+"""TPC-C over the transactional KV layer: the full five-transaction spec
+mix (NewOrder/Payment/OrderStatus/Delivery/StockLevel) as multi-statement
+SQL transactions with the 3.3.2-style consistency invariants
 (reference: pkg/workload/tpcc + roachtest's tpcc check)."""
 
 import numpy as np
@@ -12,16 +13,17 @@ from cockroach_tpu.sql import Session
 @pytest.fixture
 def sess():
     s = Session(val_width=256)
-    tpcc.load(s, warehouses=2, districts=4, customers=6)
+    tpcc.load(s, warehouses=2, districts=4, customers=6, items=20)
     return s
 
 
 def test_new_order_allocates_sequential_ids(sess):
-    ids = [tpcc.new_order(sess, 1, 2, 3, ol_cnt=5, entry_day=20000 + i)
+    ids = [tpcc.new_order(sess, 1, 2, 3, ol_cnt=5, entry_day=20000 + i,
+                          items=20)
            for i in range(4)]
     assert ids == [1, 2, 3, 4], "district cursor must allocate sequentially"
     # another district's cursor is independent
-    assert tpcc.new_order(sess, 2, 1, 1, 5, 20010) == 1
+    assert tpcc.new_order(sess, 2, 1, 1, 5, 20010, items=20) == 1
     tpcc.check_consistency(sess, warehouses=2, districts=4)
 
 
@@ -37,10 +39,69 @@ def test_payment_maintains_w_ytd_invariant(sess):
     assert abs(float(res["s"][0]) - (2 * 4 * 6 * 10.0 + 210.0)) < 1e-6
 
 
-def test_mix_and_invariants(sess):
+def test_delivery_pops_oldest_and_credits_customer(sess):
+    # three orders in district (1,1) for customer 2; one in (1,2)
+    for i in range(3):
+        tpcc.new_order(sess, 1, 1, 2, ol_cnt=4, entry_day=20000 + i,
+                       items=20)
+    tpcc.new_order(sess, 1, 2, 5, ol_cnt=3, entry_day=20010, items=20)
+    bal0 = float(sess.execute(
+        "select c_balance from customer where c_pk = 1010002"
+    )["c_balance"][0])
+    n = tpcc.delivery(sess, 1, carrier_id=7, delivery_day=20020,
+                      districts=4)
+    assert n == 2, "one delivery per non-empty district queue"
+    # oldest order of (1,1) delivered: carrier stamped, queue popped
+    o1 = 101 * 1000000 + 1
+    row = sess.execute(
+        f"select o_carrier_id, o_total from orders where o_pk = {o1}")
+    assert int(row["o_carrier_id"][0]) == 7
+    left = sess.execute(
+        "select count(*) as n from new_order where no_w_id = 1 "
+        "and no_d_id = 1")
+    assert int(left["n"][0]) == 2, "two undelivered orders remain"
+    # customer credited exactly the order total
+    bal1 = float(sess.execute(
+        "select c_balance from customer where c_pk = 1010002"
+    )["c_balance"][0])
+    assert abs((bal1 - bal0) - float(row["o_total"][0])) < 1e-6
+    # order lines stamped with the delivery day
+    lr = sess.execute(
+        f"select min(ol_delivery_d) as lo, max(ol_delivery_d) as hi "
+        f"from order_line where ol_o_pk = {o1}")
+    assert int(lr["lo"][0]) == 20020 and int(lr["hi"][0]) == 20020
+    tpcc.check_consistency(sess, warehouses=2, districts=4)
+
+
+def test_stock_level_counts_low_stock_items(sess):
+    for i in range(5):
+        tpcc.new_order(sess, 1, 3, 1, ol_cnt=8, entry_day=20000 + i,
+                       items=20)
+    # threshold above the start quantity counts every ordered item;
+    # threshold 0 counts none
+    n_all = tpcc.stock_level(sess, 1, 3, threshold=tpcc.STOCK_START + 100)
+    n_none = tpcc.stock_level(sess, 1, 3, threshold=0)
+    assert n_none == 0
+    distinct = sess.execute(
+        "select count(*) as n from "
+        "(select distinct ol_i_id from order_line where ol_d_id = 3)")
+    assert n_all == int(distinct["n"][0]) > 0
+
+
+def test_order_status_reads_latest_order(sess):
+    tpcc.new_order(sess, 2, 2, 4, ol_cnt=6, entry_day=20000, items=20)
+    tpcc.new_order(sess, 2, 2, 4, ol_cnt=9, entry_day=20001, items=20)
+    st = tpcc.order_status(sess, 2, 2, 4)
+    assert st["latest_o_id"] == 2 and st["latest_lines"] == 9
+
+
+def test_full_mix_and_invariants(sess):
     out = tpcc.run_mix(sess, txns=30, warehouses=2, districts=4,
-                       customers=6)
+                       customers=6, items=20)
     assert out["new_orders"] > 0 and out["txns"] == 30
+    assert out["tpmC"] > 0
+    # all five transaction types exercised across the mix (seeded)
+    assert sum(out["counts"].values()) == 30 - out["give_ups"]
     tpcc.check_consistency(sess, warehouses=2, districts=4)
     # order totals queryable through SQL
     res = sess.execute(
