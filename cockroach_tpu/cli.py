@@ -135,12 +135,36 @@ def main(argv=None) -> int:
                     help="preload TPC-H tables at this scale factor")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (skip the TPU tunnel)")
+    ap.add_argument("--start", action="store_true",
+                    help="server mode (the `cockroach start` analog): run a "
+                         "Node serving pgwire + the HTTP admin API until "
+                         "interrupted")
+    ap.add_argument("--pg-port", type=int, default=26257,
+                    help="pgwire listen port for --start (0 = ephemeral)")
+    ap.add_argument("--http-port", type=int, default=8080,
+                    help="HTTP admin port for --start (0 = ephemeral)")
     args = ap.parse_args(argv)
 
     if args.cpu:
         from .utils.backend import force_cpu_backend
 
         force_cpu_backend()
+
+    if args.start:
+        import time as _time
+
+        from .server.node import Node
+
+        node = Node().start(pg_port=args.pg_port, http_port=args.http_port)
+        print(f"node {node.node_id} serving: "
+              f"pgwire 127.0.0.1:{node.pg.addr[1]} "
+              f"http 127.0.0.1:{node.admin.port}", flush=True)
+        try:
+            while True:
+                _time.sleep(1)
+        except KeyboardInterrupt:
+            node.stop()
+        return 0
 
     from .sql import Session
 

@@ -14,6 +14,7 @@ carry heartbeats when multi-host lands.
 from __future__ import annotations
 
 import struct
+import time
 from dataclasses import dataclass
 
 from .txn import DB, TransactionRetryError
@@ -65,9 +66,27 @@ class NodeLiveness:
         """reader: pass the open Txn inside txn closures so the read lands
         in the txn's read spans (commit-time refresh validates it) and a
         concurrent writer's intent converts to TransactionRetryError rather
-        than surfacing WriteIntentError out of db.get."""
-        v = (reader if reader is not None else self.db).get(
-            self._key(node_id))
+        than surfacing WriteIntentError out of db.get.
+
+        Non-transactional status reads (is_live from the admin API or the
+        jobs adoption loop) instead retry briefly past a concurrent
+        heartbeat's intent: a status probe must never fail just because a
+        heartbeat is mid-commit (the reference's liveness cache serves such
+        reads from gossiped state for the same reason)."""
+        if reader is not None:
+            v = reader.get(self._key(node_id))
+        else:
+            from ..storage.lsm import WriteIntentError
+
+            deadline = time.time() + 0.5
+            while True:
+                try:
+                    v = self.db.get(self._key(node_id))
+                    break
+                except WriteIntentError:
+                    if time.time() >= deadline:
+                        raise
+                    time.sleep(0.005)
         if v is None:
             return None
         epoch, exp, nid = _REC.unpack(v)
@@ -130,8 +149,20 @@ class NodeLiveness:
         return self.db.txn(op)
 
     def livenesses(self) -> list[LivenessRecord]:
+        from ..storage.lsm import WriteIntentError
+
+        deadline = time.time() + 0.5
+        while True:
+            try:
+                rows = self.db.scan(_PREFIX, _PREFIX + b"\xff")
+                break
+            except WriteIntentError:
+                # a peer's heartbeat is mid-commit; status reads wait it out
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.005)
         out = []
-        for _, v in self.db.scan(_PREFIX, _PREFIX + b"\xff"):
+        for _, v in rows:
             epoch, exp, nid = _REC.unpack(v)
             out.append(LivenessRecord(nid, epoch, exp))
         return out

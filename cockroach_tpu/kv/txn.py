@@ -113,18 +113,23 @@ class Txn:
 
     def _write(self, key: bytes, value, tomb: bool) -> None:
         self._check_open()
-        other = self.db.engine.other_intent(key, self.txn_id)
-        if other is not None:
-            raise TransactionRetryError(
-                f"key {key!r} locked by txn {other}"
-            )
-        if self.db.engine.newest_committed_ts(key) > self.read_ts:
-            # WriteTooOld: someone committed above our snapshot
-            raise TransactionRetryError(f"write too old on {key!r}")
-        if tomb:
-            self.db.engine.delete(key, ts=self.read_ts, txn=self.txn_id)
-        else:
-            self.db.engine.put(key, value, ts=self.read_ts, txn=self.txn_id)
+        # the lock-check + write pair holds the engine mutex so a concurrent
+        # txn can't interleave between the check and the intent landing
+        # (latch-acquisition atomicity, concurrency_manager.SequenceReq)
+        with self.db.engine.mu:
+            other = self.db.engine.other_intent(key, self.txn_id)
+            if other is not None:
+                raise TransactionRetryError(
+                    f"key {key!r} locked by txn {other}"
+                )
+            if self.db.engine.newest_committed_ts(key) > self.read_ts:
+                # WriteTooOld: someone committed above our snapshot
+                raise TransactionRetryError(f"write too old on {key!r}")
+            if tomb:
+                self.db.engine.delete(key, ts=self.read_ts, txn=self.txn_id)
+            else:
+                self.db.engine.put(key, value, ts=self.read_ts,
+                                   txn=self.txn_id)
         self._write_keys.append(key)
 
     # -- lifecycle ----------------------------------------------------------
@@ -132,18 +137,22 @@ class Txn:
     def commit(self) -> int:
         self._check_open()
         commit_ts = self.db.clock.now()
-        # refresh: reads must still be valid at commit_ts
-        for s, e, is_point in self._read_spans:
-            if self.db.engine.has_committed_writes_in(
-                s, e, self.read_ts, commit_ts, point=is_point
-            ):
-                self.rollback()
-                raise TransactionRetryError(
-                    f"read span {s!r} invalidated before commit"
-                )
-        self.db.engine.resolve_intents(
-            self.txn_id, commit_ts, commit=True
-        )
+        # refresh + resolve are one atomic section under the engine mutex:
+        # a write landing between a validated refresh and the intent
+        # resolution would invalidate the just-checked read spans
+        with self.db.engine.mu:
+            # refresh: reads must still be valid at commit_ts
+            for s, e, is_point in self._read_spans:
+                if self.db.engine.has_committed_writes_in(
+                    s, e, self.read_ts, commit_ts, point=is_point
+                ):
+                    self.rollback()
+                    raise TransactionRetryError(
+                        f"read span {s!r} invalidated before commit"
+                    )
+            self.db.engine.resolve_intents(
+                self.txn_id, commit_ts, commit=True
+            )
         self._finished = True
         from ..utils import metric
 
@@ -183,16 +192,18 @@ class DB:
     # the same WriteIntentError (callers retry after the owner resolves).
     def put(self, key, value) -> int:
         k = _b(key)
-        self._check_lock(k)
-        ts = self.clock.now()
-        self.engine.put(k, value, ts=ts)
+        with self.engine.mu:
+            self._check_lock(k)
+            ts = self.clock.now()
+            self.engine.put(k, value, ts=ts)
         return ts
 
     def delete(self, key) -> int:
         k = _b(key)
-        self._check_lock(k)
-        ts = self.clock.now()
-        self.engine.delete(k, ts=ts)
+        with self.engine.mu:
+            self._check_lock(k)
+            ts = self.clock.now()
+            self.engine.delete(k, ts=ts)
         return ts
 
     def _check_lock(self, key: bytes) -> None:

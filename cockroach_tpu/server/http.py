@@ -1,0 +1,164 @@
+"""HTTP admin API — the pkg/server status/admin endpoint reduction.
+
+Reference: pkg/server serves the db-console's data plane over HTTP —
+`/_status/vars` (prometheus text exposition), `/health`, `/_status/nodes`
+(node liveness + metadata, api_v2*.go), `/_status/jobs`, and timeseries
+queries (pkg/ts/server.go). The TypeScript console itself is out of scope
+(SURVEY §2.7: "keep HTTP JSON APIs first"); this module is those APIs over
+the Node's subsystems, so an operator can curl the same surfaces.
+
+Endpoints (all GET):
+  /health             -> {"nodeId": N, "isLive": bool}  (healthz alias too)
+  /_status/vars       -> prometheus text (utils/metric Registry.scrape)
+  /_status/nodes      -> {"nodes": [liveness records + epoch + liveness]}
+  /_status/jobs       -> {"jobs": [job records]}
+  /_status/settings   -> {"settings": {name: value}}
+  /ts/query?name=&start=&end= -> {"datapoints": [[ts_ms, value], ...]}
+
+Built on http.server (stdlib) with a daemon thread per server; the Node
+owns start/stop. One handler class per Node instance via a closure so two
+nodes in one process (tests) never share state.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..utils import log, metric, settings
+
+
+class AdminServer:
+    """HTTP admin endpoint bound to one Node. serve_background() returns
+    after bind so the caller knows the port; close() joins the thread."""
+
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- endpoint payloads (plain methods: unit-testable without sockets) ----
+
+    def health(self) -> dict:
+        n = self.node
+        try:
+            live = n.liveness.is_live(n.node_id)
+        except Exception:
+            live = False
+        return {"nodeId": n.node_id, "isLive": bool(live)}
+
+    def nodes(self) -> dict:
+        out = []
+        for rec in self.node.liveness.livenesses():
+            out.append({
+                "nodeId": rec.node_id,
+                "epoch": rec.epoch,
+                "expiration": rec.expiration,
+                "isLive": self.node.liveness.is_live(rec.node_id),
+            })
+        return {"nodes": out}
+
+    def jobs(self) -> dict:
+        out = []
+        for j in self.node.jobs.jobs():
+            out.append({
+                "id": j.job_id,
+                "type": j.job_type,
+                "state": j.state,
+                "claimNode": j.claim_node,
+                "claimEpoch": j.claim_epoch,
+            })
+        return {"jobs": out}
+
+    def settings_payload(self) -> dict:
+        return {"settings": {
+            name: s.get() for name, s in settings.all_settings().items()
+        }}
+
+    def ts_query(self, name: str, start_ms: int, end_ms: int) -> dict:
+        pts = self.node.tsdb.query(name, start_ms=start_ms, end_ms=end_ms)
+        return {"name": name,
+                "datapoints": [[int(t), float(v)] for t, v in pts]}
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _make_handler(self):
+        admin = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # quiet: requests land in the structured log, not stderr
+            def log_message(self, fmt, *args):  # noqa: N802
+                log.debug(log.OPS, "http " + fmt % args)
+
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, obj, code: int = 200) -> None:
+                self._reply(code, json.dumps(obj).encode(),
+                            "application/json")
+
+            def do_GET(self):  # noqa: N802
+                try:
+                    u = urlparse(self.path)
+                    if u.path in ("/health", "/healthz"):
+                        self._json(admin.health())
+                    elif u.path == "/_status/vars":
+                        self._reply(200, metric.DEFAULT.scrape().encode(),
+                                    "text/plain; version=0.0.4")
+                    elif u.path == "/_status/nodes":
+                        self._json(admin.nodes())
+                    elif u.path == "/_status/jobs":
+                        self._json(admin.jobs())
+                    elif u.path == "/_status/settings":
+                        self._json(admin.settings_payload())
+                    elif u.path == "/ts/query":
+                        q = parse_qs(u.query)
+                        name = (q.get("name") or [""])[0]
+                        if not name:
+                            self._json({"error": "name required"}, 400)
+                            return
+                        start = int((q.get("start") or ["0"])[0])
+                        end = int((q.get("end") or [str(1 << 62)])[0])
+                        self._json(admin.ts_query(name, start, end))
+                    else:
+                        self._json({"error": f"unknown path {u.path}"}, 404)
+                except BrokenPipeError:
+                    pass  # client went away mid-reply
+                except Exception as e:  # one bad request never kills serving
+                    try:
+                        self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+                    except Exception:
+                        pass
+
+        return Handler
+
+    def serve_background(self) -> "AdminServer":
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port), self._make_handler()
+        )
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"admin-http-n{self.node.node_id}", daemon=True,
+        )
+        self._thread.start()
+        log.info(log.OPS, "admin http serving", port=self.port)
+        return self
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

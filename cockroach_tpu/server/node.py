@@ -77,13 +77,20 @@ class Node:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self, gossip_port: int = 0,
-              pg_port: int | None = None) -> "Node":
+              pg_port: int | None = None,
+              http_port: int | None = None) -> "Node":
         self._stop.clear()
         self.liveness.heartbeat()  # own record exists before anything reads
 
         self._spawn(self._heartbeat_loop, "liveness-heartbeat")
         self._spawn(self._metrics_loop, "tsdb-poller")
         self._spawn(self._adopt_loop, "jobs-adopt")
+
+        self.admin = None
+        if http_port is not None:
+            from .http import AdminServer
+
+            self.admin = AdminServer(self, port=http_port).serve_background()
 
         self.pg = None
         if pg_port is not None:
@@ -126,6 +133,9 @@ class Node:
         if getattr(self, "pg", None) is not None:
             self.pg.close()
             self.pg = None
+        if getattr(self, "admin", None) is not None:
+            self.admin.close()
+            self.admin = None
         log.info(log.OPS, "node stopped", node=self.node_id)
 
     def _spawn(self, fn, name: str) -> None:

@@ -31,6 +31,7 @@ from __future__ import annotations
 import functools
 import os
 import struct
+import threading
 from dataclasses import dataclass, field
 
 import jax
@@ -222,6 +223,23 @@ class _TsCache:
             self.over[b] = ts
 
 
+def _locked(fn):
+    """Serialize a public Engine method under the engine mutex.
+
+    The reference sequences concurrent requests through latches + the lock
+    table (concurrency_manager.SequenceReq); this engine's reduced analog is
+    one reentrant store mutex. Without it, a Node's background threads
+    (liveness heartbeats, the tsdb ticker, jobs adoption) race
+    resolve_intents' run-set rewrite against concurrent memtable appends and
+    leave orphaned intent rows behind (observed: a committed heartbeat's
+    intent resurrected by a racing flush)."""
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        with self.mu:
+            return fn(self, *a, **kw)
+    return wrapper
+
+
 class Engine:
     """MVCC LSM engine over device-resident sorted runs.
 
@@ -243,6 +261,7 @@ class Engine:
         compact_width: int = 4,
     ):
         assert key_width % 8 == 0
+        self.mu = threading.RLock()
         from ..utils import settings
 
         self.key_width = key_width
@@ -404,9 +423,11 @@ class Engine:
 
     # -- writes -------------------------------------------------------------
 
+    @_locked
     def put(self, key: bytes | str, value: bytes | str, ts: int, txn: int = 0):
         self._append(key, value, ts, txn, tomb=False)
 
+    @_locked
     def delete(self, key: bytes | str, ts: int, txn: int = 0):
         self._append(key, b"", ts, txn, tomb=True)
 
@@ -473,6 +494,7 @@ class Engine:
         self._mem_cache = (n, blk)
         return blk
 
+    @_locked
     def ingest(self, keys: np.ndarray, values: np.ndarray, ts: int,
                seq: int | None = None,
                vlens: np.ndarray | None = None) -> None:
@@ -553,12 +575,14 @@ class Engine:
         if len(self.runs) > self.l0_trigger:
             self.compact(bottom=False)
 
+    @_locked
     def flush(self):
         """Memtable -> sorted immutable run (Pebble memtable flush)."""
         self.flush_mem_only()
         if len(self.runs) > self.l0_trigger:
             self.compact(bottom=False)
 
+    @_locked
     def flush_mem_only(self):
         blk = self._mem_block()
         if blk is None:
@@ -574,6 +598,7 @@ class Engine:
         metric.ENGINE_FLUSHES.inc()
         metric.ENGINE_RUNS.set(len(self.runs))
 
+    @_locked
     def compact(self, bottom: bool = True):
         """Compaction. bottom=True merges everything and elides bottom-level
         tombstones (a full/manual compaction); bottom=False is the
@@ -746,6 +771,7 @@ class Engine:
 
     # -- reads --------------------------------------------------------------
 
+    @_locked
     def scan(
         self,
         start: bytes | str | None,
@@ -809,6 +835,7 @@ class Engine:
             vls = np.asarray(view.vlen)[idx]
             return [(k, bytes(v[:n])) for k, v, n in zip(ks, vals, vls)]
 
+    @_locked
     def scan_batch(
         self,
         starts: list[bytes | str],
@@ -893,6 +920,7 @@ class Engine:
                 ])
             return out
 
+    @_locked
     def get(self, key: bytes | str, ts: int, txn: int = 0) -> bytes | None:
         b = key.encode() if isinstance(key, str) else bytes(key)
         sw = K.encode_bound(b, self.key_width)
@@ -919,6 +947,7 @@ class Engine:
 
     # -- intents ------------------------------------------------------------
 
+    @_locked
     def resolve_intents(self, txn: int, commit_ts: int, commit: bool):
         """Commit or abort all of txn's intents across memtable + runs.
         WAL-logged: without a resolution record, crash replay would
@@ -942,6 +971,7 @@ class Engine:
         ]
         self._gen += 1
 
+    @_locked
     def has_committed_writes_in(
         self, start: bytes | None, end: bytes | None, ts_lo: int, ts_hi: int,
         point: bool = False,
@@ -966,6 +996,7 @@ class Engine:
         )
         return bool(np.asarray(jnp.any(hit)))
 
+    @_locked
     def other_intent(self, key: bytes, txn: int) -> int | None:
         """Txn id of another transaction's intent on `key`, if any —
         the lock-table point lookup the write path does before laying an
@@ -975,6 +1006,7 @@ class Engine:
         holder = self._locks.get(b)
         return holder if holder is not None and holder != txn else None
 
+    @_locked
     def newest_committed_ts(self, key: bytes) -> int:
         """Timestamp of the newest committed version of `key` (0 if none) —
         powers the WriteTooOld check. O(1) HOST lookup: the engine indexes
@@ -985,11 +1017,13 @@ class Engine:
         b = key.encode() if isinstance(key, str) else bytes(key)
         return self._newest_committed.get(b, 0)
 
+    @_locked
     def intent_keys(self, txn: int) -> list[bytes]:
         return sorted(k for k, t in self._locks.items() if t == txn)
 
     # -- stats / checkpoint -------------------------------------------------
 
+    @_locked
     def compute_stats(self) -> MVCCStats:
         view = self._merged_view()
         s = self.stats
@@ -1007,6 +1041,7 @@ class Engine:
         s.live_count = int(np.asarray(sel).sum())
         return s
 
+    @_locked
     def checkpoint(self, path: str):
         """Persist the engine state (CreateCheckpoint analog); the WAL
         truncates afterwards — everything below the checkpoint is durable

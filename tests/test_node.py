@@ -208,3 +208,59 @@ def test_fenced_node_stops_all_loops():
         assert n1._stop.is_set(), "fenced node kept running"
     finally:
         n1.stop()
+
+def test_admin_http_endpoints():
+    """The pkg/server status API reduction: /health, /_status/vars
+    (prometheus), /_status/nodes, /_status/jobs, /_status/settings and
+    /ts/query all answer over real HTTP against a running node."""
+    import json
+    import urllib.request
+
+    node = Node(node_id=7, metrics_interval_s=0.05,
+                heartbeat_interval_s=0.05)
+    node.start(gossip_port=None, http_port=0)
+    try:
+        base = f"http://127.0.0.1:{node.admin.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                return r.status, r.read()
+
+        st, body = get("/health")
+        assert st == 200
+        h = json.loads(body)
+        assert h["nodeId"] == 7 and h["isLive"] is True
+
+        st, body = get("/_status/vars")
+        assert st == 200
+        assert b"# TYPE storage_writes counter" in body
+
+        st, body = get("/_status/nodes")
+        assert json.loads(body)["nodes"][0]["nodeId"] == 7
+
+        node.jobs.create("backup", {"dest": "/tmp/x"})
+        st, body = get("/_status/jobs")
+        jobs = json.loads(body)["jobs"]
+        assert any(j["type"] == "backup" for j in jobs)
+
+        st, body = get("/_status/settings")
+        assert "sql.distsql.dense_agg_states" in json.loads(body)["settings"]
+
+        # wait for the metrics ticker, then read the series over HTTP
+        deadline = time.time() + 5
+        pts = []
+        while time.time() < deadline:
+            st, body = get("/ts/query?name=storage_writes")
+            pts = json.loads(body)["datapoints"]
+            if len(pts) >= 1:
+                break
+            time.sleep(0.05)
+        assert pts and all(len(p) == 2 for p in pts)
+
+        try:
+            get("/no/such/path")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        node.stop()
