@@ -1393,18 +1393,19 @@ class UnionOp(Operator):
 
 
 class MergeJoinOp(OneInputOperator):
-    """Single-key merge join: spool+sort the build side by exact key order,
-    stream probe tiles through vectorized binary search (mergejoiner.go
-    analog; no hash, no collision loop)."""
+    """Merge join: spool+sort the build side by exact (possibly composite)
+    key order, stream probe tiles through vectorized lexicographic binary
+    search (mergejoiner.go analog; no hash, no collision loop)."""
 
-    def __init__(self, probe: Operator, build: Operator, probe_key: int,
-                 build_key: int, spec):
+    def __init__(self, probe: Operator, build: Operator, probe_key,
+                 build_key, spec):
         from ..ops import join as join_ops
+        from ..ops.merge_join import _norm_keys
 
         super().__init__(probe)
         self.build = build
-        self.probe_key = probe_key
-        self.build_key = build_key
+        self.probe_key = _norm_keys(probe_key)
+        self.build_key = _norm_keys(build_key)
         self.spec = spec
         self.output_schema = join_ops.join_output_schema(
             probe.output_schema, build.output_schema, spec
@@ -1417,21 +1418,15 @@ class MergeJoinOp(OneInputOperator):
                 self.dictionaries[off + i] = d
             for i, s in build.col_stats.items():
                 self.col_stats[off + i] = s
-        # STRING keys need a shared rank space: remap build codes into the
-        # probe dictionary's rank table
-        self.probe_rank = None
-        self.build_rank = None
-        pt = probe.output_schema.types[probe_key]
-        if pt.family is Family.STRING:
-            pd = probe.dictionaries[probe_key]
-            bd = build.dictionaries[build_key]
-            self.probe_rank = pd.ranks
-            ranks = []
-            for i, v in enumerate(bd.values):
-                code = pd.code_of(str(v))
-                ranks.append(pd.ranks[code] if code >= 0
-                             else len(pd.values) + i)
-            self.build_rank = np.array(ranks, dtype=np.int32)
+        # STRING keys need a shared rank space per key position: remap
+        # build codes into the probe dictionary's rank table (shared helper
+        # with the SPMD lowering so the two paths can't diverge)
+        from ..ops.merge_join import rank_tables_for
+
+        self.probe_rank, self.build_rank = rank_tables_for(
+            probe.output_schema, self.probe_key, probe.dictionaries,
+            self.build_key, build.dictionaries,
+        )
         self._built = False
 
     def children(self):

@@ -41,6 +41,7 @@ from . import segscan
 @dataclass(frozen=True)
 class AggSpec:
     # sum | count | count_rows | min | max | avg | any_not_null
+    # | bool_and | bool_or
     # | var | stddev | var_pop | stddev_pop | sum_sq (internal state)
     func: str
     col: int | None = None  # input column index (None for count_rows)
@@ -52,8 +53,12 @@ STAT_FUNCS = ("var", "stddev", "var_pop", "stddev_pop")
 
 
 def agg_output_type(spec: AggSpec, schema: Schema) -> SQLType:
+    from ..coldata.types import BOOL
+
     if spec.func in ("count", "count_rows"):
         return INT64
+    if spec.func in ("bool_and", "bool_or"):
+        return BOOL
     if spec.func in ("avg",) + STAT_FUNCS or spec.func == "sum_sq":
         return FLOAT64
     t = schema.types[spec.col]
@@ -121,6 +126,15 @@ def _segment_agg(spec: AggSpec, col: Column | None, live, seg, cap,
         sent = _minmax_sentinel(col.data.dtype, False)
         vals = jnp.where(contributes, col.data, sent)
         return jax.ops.segment_max(vals, seg, num_segments=cap), nonempty
+    if spec.func in ("bool_and", "bool_or"):
+        # AND = min over {0,1}, OR = max; non-contributing rows carry the
+        # identity. int32 lanes: XLA segment reductions over pred are
+        # unreliable on some backends
+        is_and = spec.func == "bool_and"
+        vals = jnp.where(contributes, col.data.astype(jnp.bool_),
+                         jnp.bool_(is_and)).astype(jnp.int32)
+        fn = jax.ops.segment_min if is_and else jax.ops.segment_max
+        return (fn(vals, seg, num_segments=cap).astype(jnp.bool_), nonempty)
     raise ValueError(f"unknown aggregate {spec.func}")
 
 
@@ -177,6 +191,12 @@ def _scan_agg_entries(spec: AggSpec, col: Column | None, live,
         sent = _minmax_sentinel(col.data.dtype, False)
         vals = jnp.where(contributes, col.data, sent)
         return [cnt_entry, (jnp.maximum, vals)], lambda c, s: (s, c > 0)
+    if spec.func in ("bool_and", "bool_or"):
+        is_and = spec.func == "bool_and"
+        vals = jnp.where(contributes, col.data.astype(jnp.bool_),
+                         jnp.bool_(is_and))
+        op = jnp.logical_and if is_and else jnp.logical_or
+        return [cnt_entry, (op, vals)], lambda c, s: (s, c > 0)
     raise ValueError(f"unknown aggregate {spec.func}")
 
 
@@ -329,6 +349,8 @@ _MERGE_FUNC = {
     "min": "min",
     "max": "max",
     "any_not_null": "any_not_null",
+    "bool_and": "bool_and",
+    "bool_or": "bool_or",
 }
 
 
@@ -508,6 +530,11 @@ def psum_dense_states(specs: tuple[AggSpec, ...], states, axis_name: str):
             rd = jax.tree_util.tree_map(
                 lambda x: jax.lax.psum(x, axis_name), d
             )
+        elif spec.func in ("bool_and", "bool_or"):
+            # AND = min over {0,1} lanes, OR = max (pred collectives are
+            # unreliable on some backends: ride int32)
+            fn = jax.lax.pmin if spec.func == "bool_and" else jax.lax.pmax
+            rd = fn(d.astype(jnp.int32), axis_name).astype(jnp.bool_)
         else:
             raise ValueError(spec.func)
         rv = jax.lax.psum(v.astype(jnp.int32), axis_name) > 0
@@ -663,6 +690,11 @@ def scalar_tile_states(batch: Batch, aggs: tuple[AggSpec, ...], base: Schema):
             q_ = jnp.sum(jnp.where(m, d * d, 0.0))
             ok = cnt > 0 if spec.func.endswith("_pop") else cnt > 1
             out.append(((s_, q_, cnt), ok))
+        elif spec.func in ("bool_and", "bool_or"):
+            is_and = spec.func == "bool_and"
+            vals = jnp.where(m, c.data.astype(jnp.bool_), jnp.bool_(is_and))
+            red = jnp.all(vals) if is_and else jnp.any(vals)
+            out.append((red, cnt > 0))
         else:
             raise ValueError(spec.func)
     return out
@@ -685,6 +717,10 @@ def scalar_merge_states(aggs: tuple[AggSpec, ...], acc, new):
             out.append((jnp.minimum(a, n), av | nv))
         elif spec.func == "max":
             out.append((jnp.maximum(a, n), av | nv))
+        elif spec.func == "bool_and":
+            out.append((a & n, av | nv))
+        elif spec.func == "bool_or":
+            out.append((a | n, av | nv))
         else:
             raise ValueError(spec.func)
     return out

@@ -2,15 +2,18 @@
 
 Reference: pkg/sql/colexec/colexecjoin/mergejoiner.go streams two inputs
 sorted on the join key, advancing two cursors (per-join-type generated
-variants). On TPU the cursor walk becomes vectorized binary search over
-order-preserving uint64 key lanes (sort_ops.order_keys): with EXACT keys
-(not hashes) there are no collisions, so each probe row's match run is just
-[searchsorted left, searchsorted right) in the build tile — no advance loop
-at all. Duplicate handling reuses the count+emit pattern of the hash join.
+variants, composite ordered keys included). On TPU the cursor walk becomes
+vectorized binary search over order-preserving uint64 key lanes
+(sort_ops.order_keys): with EXACT keys (not hashes) there are no
+collisions, so each probe row's match run is just [searchsorted left,
+searchsorted right) in the build tile — no advance loop at all. Duplicate
+handling reuses the count+emit pattern of the hash join.
 
-Single-key joins only (the composite-key case routes to the hash join; the
-reference's merge joiner is likewise used when the plan's interesting order
-covers the join key).
+Composite keys compare lexicographically: the build side sorts on all key
+lanes at once (multi-operand lax.sort), and the probe's binary search
+composes per-lane compares into one tuple compare per step (log2(n) steps
+x ncols gathers — the generated mergejoiner's multi-column cursor compare,
+vectorized).
 """
 
 from __future__ import annotations
@@ -66,13 +69,100 @@ def _u64_key(batch: Batch, key: int, schema: Schema, rank_table=None):
     return jnp.where(active, payload, _SENTINEL), active
 
 
+def _norm_keys(key) -> tuple[int, ...]:
+    return (key,) if isinstance(key, int) else tuple(key)
+
+
+def rank_tables_for(probe_schema: Schema, probe_key, probe_dicts,
+                    build_key, build_dicts):
+    """Per-key-position STRING rank tables: the probe dictionary's rank
+    space, with build codes remapped into it (absent build values rank past
+    the probe's range so they compare unequal to everything). One shared
+    helper so the flow (MergeJoinOp) and SPMD (_lower_mergejoin) paths can
+    never diverge. Returns (probe_ranks, build_ranks) tuples aligned with
+    the normalized key positions (None for non-STRING keys)."""
+    from ..coldata.types import Family
+
+    pkeys = _norm_keys(probe_key)
+    bkeys = _norm_keys(build_key)
+    probe_ranks: list = []
+    build_ranks: list = []
+    for pk, bk in zip(pkeys, bkeys):
+        if probe_schema.types[pk].family is not Family.STRING:
+            probe_ranks.append(None)
+            build_ranks.append(None)
+            continue
+        pd = probe_dicts[pk]
+        bd = build_dicts[bk]
+        probe_ranks.append(pd.ranks)
+        ranks = []
+        for i, v in enumerate(bd.values):
+            code = pd.code_of(str(v))
+            ranks.append(pd.ranks[code] if code >= 0
+                         else len(pd.values) + i)
+        build_ranks.append(np.array(ranks, dtype=np.int32))
+    return tuple(probe_ranks), tuple(build_ranks)
+
+
+def _norm_ranks(rank_tables, nkeys: int) -> tuple:
+    """Accept the legacy single-table form (one table for a single key) or
+    a tuple/dict keyed by key position."""
+    if rank_tables is None:
+        return (None,) * nkeys
+    if isinstance(rank_tables, dict):
+        return tuple(rank_tables.get(i) for i in range(nkeys))
+    if isinstance(rank_tables, (list, tuple)):
+        assert len(rank_tables) == nkeys
+        return tuple(rank_tables)
+    assert nkeys == 1
+    return (rank_tables,)
+
+
+def _u64_keys(batch: Batch, keys: tuple[int, ...], schema: Schema,
+              rank_tables) -> tuple[tuple[jax.Array, ...], jax.Array]:
+    """(per-column order lanes, combined active). A row is active only when
+    EVERY key column is non-NULL (SQL: one NULL key kills the match)."""
+    ranks = _norm_ranks(rank_tables, len(keys))
+    lanes = []
+    active = batch.mask
+    for k, rt in zip(keys, ranks):
+        lane, a = _u64_key(batch, k, schema, rt)
+        lanes.append(lane)
+        active = active & a
+    return tuple(lanes), active
+
+
+def lex_bsearch(sorted_lanes: tuple[jax.Array, ...],
+                query_lanes: tuple[jax.Array, ...],
+                side: str = "left") -> jax.Array:
+    """Branchless unrolled binary search over LEXICOGRAPHIC tuples.
+    Same step structure as join.bsearch (log2(n) static gather+select
+    rounds), with the scalar compare replaced by a composed tuple compare
+    — ncols gathers per step instead of one."""
+    n = sorted_lanes[0].shape[0]
+    bits = max(1, int(n).bit_length())
+    pos = jnp.zeros(query_lanes[0].shape, jnp.int32)
+    for sb in range(bits - 1, -1, -1):
+        cand = pos + (1 << sb)
+        at = jnp.clip(cand - 1, 0, n - 1)
+        lt = jnp.zeros(pos.shape, jnp.bool_)
+        eq = jnp.ones(pos.shape, jnp.bool_)
+        for sl, ql in zip(sorted_lanes, query_lanes):
+            v = sl[at]
+            lt = lt | (eq & (v < ql))
+            eq = eq & (v == ql)
+        ok = lt if side == "left" else (lt | eq)
+        pos = jnp.where((cand <= n) & ok, cand, pos)
+    return pos
+
+
 def merge_join(
     probe: Batch,
     probe_schema: Schema,
-    probe_key: int,
+    probe_key,
     build: Batch,
     build_schema: Schema,
-    build_key: int,
+    build_key,
     spec: JoinSpec,
     out_capacity: int,
     probe_rank_table=None,
@@ -81,18 +171,22 @@ def merge_join(
 ):
     """Returns (out_batch, total_rows); retry with a bigger tile if
     total_rows > out_capacity (same capacity-bucketing contract as
-    hash_join_general). `build_index` caches the build-side sorted keys."""
+    hash_join_general). `build_index` caches the build-side sorted keys.
+    probe_key/build_key: one column index or a tuple of them (composite
+    ordered keys, compared lexicographically)."""
+    pkeys = _norm_keys(probe_key)
+    bkeys = _norm_keys(build_key)
     cap = probe.capacity
     bcap = build.capacity
     if build_index is None:
         build_index = build_merge_index(
-            build, build_schema, build_key, build_rank_table
+            build, build_schema, bkeys, build_rank_table
         )
-    sk, order, prefix = build_index
-    pk, p_active = _u64_key(probe, probe_key, probe_schema, probe_rank_table)
+    sks, order, prefix = build_index
+    pks, p_active = _u64_keys(probe, pkeys, probe_schema, probe_rank_table)
 
-    lo = jnp.searchsorted(sk, pk, side="left").astype(jnp.int32)
-    hi = jnp.searchsorted(sk, pk, side="right").astype(jnp.int32)
+    lo = lex_bsearch(sks, pks, side="left")
+    hi = lex_bsearch(sks, pks, side="right")
     # count only ACTIVE build rows in the run (dead/NULL rows share the key
     # lanes of inactive rows and sort to the run's tail)
     cnt = jnp.where(p_active, prefix[hi] - prefix[lo], 0)
@@ -149,18 +243,20 @@ def merge_join(
     return Batch(cols=pcols + bcols, mask=out_live), total
 
 
-def build_merge_index(build: Batch, schema: Schema, key: int, rank_table=None):
-    """Sort build rows by exact key order -> (sorted_keys, orig_index,
-    active_prefix). Inactive (dead/NULL-key) rows sort AFTER actives within
-    an equal-key run, and active_prefix[i] counts active rows before sorted
-    position i — so a probe run [lo, hi) has its active matches contiguous
-    at [lo, lo + prefix[hi] - prefix[lo])."""
-    bk, active = _u64_key(build, key, schema, rank_table)
+def build_merge_index(build: Batch, schema: Schema, key, rank_table=None):
+    """Sort build rows by exact (composite) key order -> (sorted_key_lanes,
+    orig_index, active_prefix). Inactive (dead/NULL-key) rows sort AFTER
+    actives within an equal-key run, and active_prefix[i] counts active rows
+    before sorted position i — so a probe run [lo, hi) has its active
+    matches contiguous at [lo, lo + prefix[hi] - prefix[lo])."""
+    keys = _norm_keys(key)
+    lanes, active = _u64_keys(build, keys, schema, rank_table)
     perm = jnp.arange(build.capacity, dtype=jnp.int32)
-    sk, _, order = jax.lax.sort([bk, ~active, perm], num_keys=2)
+    out = jax.lax.sort([*lanes, ~active, perm], num_keys=len(lanes) + 1)
+    sks, order = tuple(out[:len(lanes)]), out[-1]
     sorted_active = active[order]
     prefix = jnp.concatenate([
         jnp.zeros((1,), jnp.int32),
         jnp.cumsum(sorted_active.astype(jnp.int32)),
     ])
-    return sk, order, prefix
+    return sks, order, prefix

@@ -429,18 +429,11 @@ class _Lowering:
         out_schema = join_ops.join_output_schema(pl.schema, bl.schema,
                                                  plan.spec)
         dicts = self._join_dicts(pl, bl, plan.spec)
-        # STRING keys share the probe dictionary's rank space (MergeJoinOp)
-        probe_rank = build_rank = None
-        if pl.schema.types[plan.probe_key].family is Family.STRING:
-            pd = pl.dicts[plan.probe_key]
-            bd = bl.dicts[plan.build_key]
-            probe_rank = pd.ranks
-            ranks = []
-            for i, v in enumerate(bd.values):
-                code = pd.code_of(str(v))
-                ranks.append(pd.ranks[code] if code >= 0
-                             else len(pd.values) + i)
-            build_rank = np.array(ranks, dtype=np.int32)
+        # STRING keys share the probe dictionary's rank space, per key
+        # position (shared helper with MergeJoinOp; composite keys included)
+        probe_rank, build_rank = mj_ops.rank_tables_for(
+            pl.schema, plan.probe_key, pl.dicts, plan.build_key, bl.dicts,
+        )
         out_cap = _pow2(pl.cap * 2 * self.factor)
         pemit, bemit = pl.emit, bl.emit
         pschema, bschema = pl.schema, bl.schema

@@ -153,11 +153,12 @@ class Union(PlanNode):
 
 @dataclass(frozen=True)
 class MergeJoin(PlanNode):
-    """Single-key merge join over order-preserving key lanes
-    (mergejoiner.go analog; composite keys route to HashJoin)."""
+    """Merge join over order-preserving key lanes (mergejoiner.go analog).
+    probe_key/build_key: one column index or a tuple of them (composite
+    ordered keys, compared lexicographically)."""
 
     probe: PlanNode
     build: PlanNode
-    probe_key: int
-    build_key: int
+    probe_key: int | tuple[int, ...]
+    build_key: int | tuple[int, ...]
     spec: JoinSpec = JoinSpec()

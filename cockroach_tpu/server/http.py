@@ -30,6 +30,25 @@ from urllib.parse import parse_qs, urlparse
 from ..utils import log, metric, settings
 
 
+def _status_read(fn, deadline_s: float = 0.5):
+    """Run a status-endpoint read, retrying briefly past WriteIntentError:
+    background loops (jobs adoption, heartbeats) commit constantly, and an
+    operator's curl must never 500 just because a txn was mid-commit (the
+    reference serves these endpoints from caches for the same reason)."""
+    import time
+
+    from ..storage.lsm import WriteIntentError
+
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            return fn()
+        except WriteIntentError:
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.005)
+
+
 class AdminServer:
     """HTTP admin endpoint bound to one Node. serve_background() returns
     after bind so the caller knows the port; close() joins the thread."""
@@ -52,19 +71,22 @@ class AdminServer:
         return {"nodeId": n.node_id, "isLive": bool(live)}
 
     def nodes(self) -> dict:
+        now = self.node.db.clock.now()
         out = []
+        # liveness computed from the records just read — no per-node
+        # re-read (each would retake the engine mutex)
         for rec in self.node.liveness.livenesses():
             out.append({
                 "nodeId": rec.node_id,
                 "epoch": rec.epoch,
                 "expiration": rec.expiration,
-                "isLive": self.node.liveness.is_live(rec.node_id),
+                "isLive": rec.live_at(now),
             })
         return {"nodes": out}
 
     def jobs(self) -> dict:
         out = []
-        for j in self.node.jobs.jobs():
+        for j in _status_read(self.node.jobs.jobs):
             out.append({
                 "id": j.job_id,
                 "type": j.job_type,

@@ -31,11 +31,13 @@ from . import parser as P
 from .rel import Rel
 
 AGG_FUNCS = {"sum", "avg", "min", "max", "count", "stddev", "stddev_samp",
-             "stddev_pop", "variance", "var_samp", "var_pop"}
+             "stddev_pop", "variance", "var_samp", "var_pop",
+             "bool_and", "bool_or", "every"}
 
 # SQL spellings -> kernel aggregate names (sample variants are the defaults,
-# matching CockroachDB/Postgres)
-_AGG_CANON = {"variance": "var", "var_samp": "var", "stddev_samp": "stddev"}
+# matching CockroachDB/Postgres; EVERY is the standard spelling of bool_and)
+_AGG_CANON = {"variance": "var", "var_samp": "var", "stddev_samp": "stddev",
+              "every": "bool_and"}
 
 
 class BindError(Exception):

@@ -260,13 +260,18 @@ class Rel:
                 dicts[base + i] = self.dicts[sp.col]
         return Rel(self.catalog, node, schema, dicts)
 
-    def merge_join(self, build: "Rel", on: tuple[str, str],
+    def merge_join(self, build: "Rel", on,
                    how: str = "inner") -> "Rel":
-        """Single-key merge join (sorted-key binary search, no hashing)."""
+        """Merge join (sorted-key binary search, no hashing). `on` is one
+        (probe_col, build_col) pair or a list of pairs (composite key,
+        compared lexicographically)."""
         from ..ops import join as join_ops
 
-        pk = self.idx(on[0])
-        bk = build.idx(on[1])
+        pairs = [on] if isinstance(on[0], str) else list(on)
+        pk = tuple(self.idx(p) for p, _ in pairs)
+        bk = tuple(build.idx(b) for _, b in pairs)
+        if len(pairs) == 1:
+            pk, bk = pk[0], bk[0]
         spec = join_ops.JoinSpec(how, build_unique=False)
         node = S.MergeJoin(self.plan, build.plan, pk, bk, spec)
         if how in ("semi", "anti"):

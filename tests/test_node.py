@@ -216,8 +216,11 @@ def test_admin_http_endpoints():
     import json
     import urllib.request
 
+    # generous TTL: on a cold process the first engine reads serialize
+    # behind multi-second kernel compiles under the store mutex, and a
+    # 1s-TTL record would expire before /health evaluates it
     node = Node(node_id=7, metrics_interval_s=0.05,
-                heartbeat_interval_s=0.05)
+                heartbeat_interval_s=0.1, ttl_ms=30000)
     node.start(gossip_port=None, http_port=0)
     try:
         base = f"http://127.0.0.1:{node.admin.port}"

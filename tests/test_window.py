@@ -320,3 +320,55 @@ def test_ntile_percent_rank_cume_dist(cat):
     np.testing.assert_allclose(
         np.asarray(got["cd"], np.float64)[order], df["cd"].to_numpy(),
         rtol=1e-12)
+
+def test_merge_join_composite_keys():
+    """Composite ordered keys compare lexicographically (the generated
+    mergejoiner's multi-column cursor, reference mergejoiner.go) —
+    including duplicates, NULLs in either key column, and a STRING
+    component with different dictionaries on the two sides."""
+    import cockroach_tpu.catalog as catalog_mod
+    from cockroach_tpu.coldata.types import INT64, STRING, Schema
+
+    cat = catalog_mod.Catalog()
+    cat.add(catalog_mod.Table.from_strings(
+        "t1", Schema.of(a=INT64, s=STRING, x=INT64),
+        {"a": np.array([1, 1, 2, 2, 3, 0]),
+         "s": np.array(["u", "v", "u", "u", "u", "u"], dtype=object),
+         "x": np.arange(6)},
+        valids={"a": np.array([1, 1, 1, 1, 1, 0], dtype=bool),
+                "s": np.array([1, 1, 1, 1, 0, 1], dtype=bool)},
+    ))
+    cat.add(catalog_mod.Table.from_strings(
+        "t2", Schema.of(b=INT64, t=STRING, y=INT64),
+        {"b": np.array([1, 2, 2, 3, 0]),
+         "t": np.array(["v", "u", "u", "w", "u"], dtype=object),
+         "y": np.arange(5) * 10},
+        valids={"b": np.array([1, 1, 1, 1, 0], dtype=bool)},
+    ))
+    t1 = Rel.scan(cat, "t1")
+    t2 = Rel.scan(cat, "t2")
+    res = t1.merge_join(t2, [("a", "b"), ("s", "t")]).run()
+    df = pd.DataFrame(res).sort_values(["a", "x", "y"]).reset_index(drop=True)
+    # expected: (1,v)x1 match, (2,u)x2 rows x 2 dups = 4, NULLs never match
+    # pandas merge treats NaN keys as equal; SQL does not — drop the NULL
+    # rows from the oracle input (they can never match)
+    p1 = pd.DataFrame({"a": [1, 1, 2, 2],
+                       "s": ["u", "v", "u", "u"],
+                       "x": np.arange(4)})
+    p2 = pd.DataFrame({"b": [1, 2, 2, 3],
+                       "t": ["v", "u", "u", "w"],
+                       "y": np.arange(4) * 10})
+    want = p1.merge(p2, left_on=["a", "s"], right_on=["b", "t"]).sort_values(
+        ["a", "x", "y"]).reset_index(drop=True)
+    assert len(df) == len(want) == 5
+    np.testing.assert_array_equal(df.a, want.a)
+    np.testing.assert_array_equal(df.y, want.y)
+    # semi/anti with composite keys
+    semi = t1.merge_join(t2, [("a", "b"), ("s", "t")], how="semi").run()
+    assert sorted(semi["x"]) == [1, 2, 3]
+    anti = t1.merge_join(t2, [("a", "b"), ("s", "t")], how="anti").run()
+    assert sorted(anti["x"]) == [0, 4, 5]
+    # matches the hash join on the same composite key
+    hj = t1.join(t2, on=[("a", "b"), ("s", "t")], build_unique=False).run()
+    assert sorted(zip(df.a, df.y)) == sorted(
+        zip(hj["a"], hj["y"]))
