@@ -1,0 +1,397 @@
+"""DistSender / RangeCache / multi-Store — the kvclient routing reduction.
+
+Reference: the keyspace is split into ranges; range descriptors live in
+meta ranges; DistSender (kvcoord/dist_sender.go:663) splits every batch by
+range using the RangeDescriptorCache, routes each piece to the range's
+leaseholder store, and retries with a fresh descriptor on
+RangeKeyMismatchError when its cache was stale. Store.Send
+(kvserver/store_send.go:41) verifies the request lies within a range it
+owns.
+
+TPU-native reduction, single process, N stores (one Engine each):
+
+- ``Meta``: the authoritative descriptor table (the meta-range role) —
+  sorted host list, copy-on-write snapshots so concurrent readers never
+  see a half-applied split.
+- ``RangeCache``: per-DistSender cached descriptors; binary search by key,
+  evicted on RangeKeyMismatchError (stale routing), refilled from Meta.
+- ``Store``: an Engine + the set of range ids it owns; every request
+  verifies its span against the CURRENT descriptor before touching the
+  engine (the bounds check that makes stale caches detectable).
+- ``DistSender``: implements the Engine surface DB/Txn already consume
+  (put/get/scan/scan_batch/resolve_intents/...), so ``DB(DistSender(...),
+  clock)`` drops in with the txn layer unchanged. Cross-range scans split
+  by range boundary and concatenate per-store results in key order.
+- admin ops: ``split_at`` (metadata-only, like the reference's AdminSplit
+  — both halves stay on the store), ``move_range`` (scan + ingest into
+  the target store — the snapshot-rebalance role).
+
+Replication (multiple replicas per range, raft) stays out of scope per
+SURVEY §7; each range has exactly one home store.
+
+Boundary: the SQL columnar fast path (kv/table.py KVTable.device_batch)
+reads one engine's merged device view directly and therefore runs over a
+single-store DB today; DistSender serves the kv.DB/Txn surface (point ops,
+scans, batched scans, bulk ingest, intents). Routing SQL table shards
+across stores is the next step (per-store views + a merge stage).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..storage.lsm import Engine
+from ..utils import log, metric
+
+
+class RangeKeyMismatchError(Exception):
+    """The routed store does not own the request's span (stale cache)."""
+
+
+@dataclass(frozen=True)
+class RangeDescriptor:
+    range_id: int
+    start_key: bytes  # inclusive
+    end_key: bytes | None  # exclusive; None = +inf
+    store_id: int
+    generation: int = 0
+
+    def contains(self, key: bytes) -> bool:
+        return key >= self.start_key and (
+            self.end_key is None or key < self.end_key
+        )
+
+
+class Meta:
+    """Authoritative descriptor table. Descriptors tile the keyspace:
+    [b"", split1), [split1, split2), ... [splitN, None)."""
+
+    def __init__(self, first_store: int = 1):
+        self._lock = threading.RLock()
+        self._next_id = 2
+        self._descs: list[RangeDescriptor] = [
+            RangeDescriptor(1, b"", None, first_store)
+        ]
+
+    def snapshot(self) -> list[RangeDescriptor]:
+        with self._lock:
+            return list(self._descs)
+
+    def lookup(self, key: bytes) -> RangeDescriptor:
+        with self._lock:
+            i = self._find(key)
+            return self._descs[i]
+
+    def _find(self, key: bytes) -> int:
+        starts = [d.start_key for d in self._descs]
+        return max(0, bisect.bisect_right(starts, key) - 1)
+
+    def split_at(self, key: bytes) -> tuple[RangeDescriptor, RangeDescriptor]:
+        """AdminSplit: [s, e) -> [s, key) + [key, e), both on the same
+        store. Metadata-only, like the reference (data does not move)."""
+        if not key:
+            raise ValueError("cannot split at the minimum key")
+        with self._lock:
+            i = self._find(key)
+            d = self._descs[i]
+            if d.start_key == key:
+                return d, d  # already a boundary
+            left = RangeDescriptor(d.range_id, d.start_key, key, d.store_id,
+                                   d.generation + 1)
+            right = RangeDescriptor(self._next_id, key, d.end_key,
+                                    d.store_id, 0)
+            self._next_id += 1
+            self._descs = (
+                self._descs[:i] + [left, right] + self._descs[i + 1:]
+            )
+            metric.RANGE_SPLITS.inc()
+            log.info(log.OPS, "range split", at=key.decode(errors="replace"),
+                     left=left.range_id, right=right.range_id)
+            return left, right
+
+    def reassign(self, range_id: int, to_store: int) -> RangeDescriptor:
+        with self._lock:
+            for i, d in enumerate(self._descs):
+                if d.range_id == range_id:
+                    nd = RangeDescriptor(d.range_id, d.start_key, d.end_key,
+                                         to_store, d.generation + 1)
+                    self._descs = (
+                        self._descs[:i] + [nd] + self._descs[i + 1:]
+                    )
+                    return nd
+            raise KeyError(f"no range {range_id}")
+
+
+class RangeCache:
+    """Per-sender descriptor cache (kvclient/rangecache role): lookups hit
+    the cache; a RangeKeyMismatch evicts the stale entry and refills from
+    Meta. Deliberately NOT invalidated by Meta writes — staleness is
+    detected at the store, exactly like the reference."""
+
+    def __init__(self, meta: Meta):
+        self.meta = meta
+        self._by_start: dict[bytes, RangeDescriptor] = {}
+        self.misses = 0
+        self.evictions = 0
+
+    def lookup(self, key: bytes) -> RangeDescriptor:
+        for d in self._by_start.values():
+            if d.contains(key):
+                return d
+        self.misses += 1
+        d = self.meta.lookup(key)
+        self._by_start[d.start_key] = d
+        return d
+
+    def evict(self, d: RangeDescriptor) -> None:
+        self.evictions += 1
+        self._by_start.pop(d.start_key, None)
+
+
+class Store:
+    """One Engine + ownership verification (Store.Send's bounds check)."""
+
+    def __init__(self, store_id: int, meta: Meta, **engine_kw):
+        self.store_id = store_id
+        self.meta = meta
+        self.engine = Engine(**engine_kw)
+
+    def check(self, desc: RangeDescriptor, start: bytes,
+              end: bytes | None) -> RangeDescriptor:
+        """Verify this store currently owns `desc`'s range and the span
+        [start, end) (or point [start]) lies within it. Returns the
+        CURRENT descriptor — like the reference's RangeKeyMismatchError
+        carrying fresher descriptors, so the sender can repair its cache
+        even when a narrowed range still answers the request."""
+        cur = self.meta.lookup(start)
+        if cur.store_id != self.store_id or cur.range_id != desc.range_id:
+            raise RangeKeyMismatchError(
+                f"store {self.store_id} does not own r{desc.range_id} "
+                f"for key {start!r} (now r{cur.range_id}@s{cur.store_id})"
+            )
+        hi = end if end is not None else start
+        if cur.end_key is not None and hi is not None and (
+            hi > cur.end_key or (end is None and start >= cur.end_key)
+        ):
+            raise RangeKeyMismatchError(
+                f"span [{start!r}, {end!r}) exceeds r{cur.range_id} "
+                f"bounds [{cur.start_key!r}, {cur.end_key!r})"
+            )
+        return cur
+
+
+def _b(x) -> bytes:
+    return x.encode() if isinstance(x, str) else bytes(x)
+
+
+class DistSender:
+    """Routes Engine-surface requests by range. Implements everything
+    kv.DB/kv.Txn consume from an Engine, so it substitutes transparently.
+
+    Concurrency: one reentrant mutex spanning all stores (`mu`) — the
+    same latch reduction Engine.mu provides single-store. Individual
+    engines keep their own mutexes for direct access."""
+
+    def __init__(self, stores: list[Store], meta: Meta):
+        assert stores, "need at least one store"
+        self.meta = meta
+        self.stores = {s.store_id: s for s in stores}
+        self.cache = RangeCache(meta)
+        self.mu = threading.RLock()
+        first = stores[0].engine
+        self.key_width = first.key_width
+        self.val_width = first.val_width
+
+    # -- routing core --------------------------------------------------------
+
+    def _route_point(self, key: bytes):
+        """(store, descriptor) for one key, retrying past stale cache.
+        The returned descriptor is the store's CURRENT one — a cached
+        entry that routed correctly but had stale bounds (a split kept
+        this half in place) is repaired in the cache on the way out."""
+        for _ in range(4):
+            d = self.cache.lookup(key)
+            store = self.stores[d.store_id]
+            try:
+                cur = store.check(d, key, None)
+            except RangeKeyMismatchError:
+                self.cache.evict(d)
+                continue
+            if cur.generation != d.generation or cur.end_key != d.end_key:
+                self.cache.evict(d)
+                self.cache._by_start[cur.start_key] = cur
+            return store, cur
+        # cache kept going stale (concurrent splits): go authoritative
+        d = self.meta.lookup(key)
+        return self.stores[d.store_id], d
+
+    def _route_span(self, start: bytes | None, end: bytes | None):
+        """Split [start, end) into per-range pieces (DistSender's batch
+        truncation, dist_sender.go:1191): yields (store, piece_start,
+        piece_end) in key order."""
+        cursor = start if start is not None else b""
+        while True:
+            store, d = self._route_point(cursor)
+            piece_end = d.end_key
+            if end is not None and (piece_end is None or end <= piece_end):
+                yield store, cursor, end
+                return
+            if piece_end is None:
+                yield store, cursor, end
+                return
+            yield store, cursor, piece_end
+            cursor = piece_end
+
+    # -- Engine surface ------------------------------------------------------
+
+    def put(self, key, value, ts: int, txn: int = 0):
+        k = _b(key)
+        store, _ = self._route_point(k)
+        return store.engine.put(k, value, ts=ts, txn=txn)
+
+    def delete(self, key, ts: int, txn: int = 0):
+        k = _b(key)
+        store, _ = self._route_point(k)
+        return store.engine.delete(k, ts=ts, txn=txn)
+
+    def get(self, key, ts: int, txn: int = 0):
+        k = _b(key)
+        store, _ = self._route_point(k)
+        return store.engine.get(k, ts=ts, txn=txn)
+
+    def scan(self, start, end, ts: int, txn: int = 0, max_keys=None):
+        out: list[tuple[bytes, bytes]] = []
+        s = _b(start) if start is not None else None
+        e = _b(end) if end is not None else None
+        for store, ps, pe in self._route_span(s, e):
+            left = None if max_keys is None else max_keys - len(out)
+            if left is not None and left <= 0:
+                break
+            out.extend(store.engine.scan(ps, pe, ts=ts, txn=txn,
+                                         max_keys=left))
+        return out
+
+    def scan_batch(self, starts, ts: int, txn: int = 0, max_keys: int = 64):
+        """Batched scans grouped BY STORE so each store runs one device
+        pass (the Streamer's per-range request grouping,
+        kvstreamer/streamer.go:517). Results reassemble in request order;
+        a scan whose window crosses its range's end is truncated at the
+        boundary and continued on the next range host-side."""
+        encs = [_b(s) for s in starts]
+        by_store: dict[int, list[int]] = {}
+        descs = []
+        for i, k in enumerate(encs):
+            store, d = self._route_point(k)
+            by_store.setdefault(store.store_id, []).append(i)
+            descs.append(d)
+        results: list[list[tuple[bytes, bytes]]] = [None] * len(encs)
+        for sid, idxs in by_store.items():
+            eng = self.stores[sid].engine
+            got = eng.scan_batch([encs[i] for i in idxs], ts=ts, txn=txn,
+                                 max_keys=max_keys)
+            for i, rows in zip(idxs, got):
+                d = descs[i]
+                if d.end_key is not None:
+                    rows = [(k, v) for k, v in rows if k < d.end_key]
+                results[i] = rows
+        # continue truncated scans past their range boundary
+        for i, rows in enumerate(results):
+            d = descs[i]
+            while d.end_key is not None and len(rows) < max_keys:
+                nxt = self.scan(d.end_key, None, ts=ts, txn=txn,
+                                max_keys=max_keys - len(rows))
+                rows = rows + nxt
+                break  # self.scan already walked the remaining ranges
+            results[i] = rows[:max_keys]
+        return results
+
+    def ingest(self, keys: np.ndarray, values: np.ndarray, ts: int,
+               **kw) -> None:
+        """Bulk ingest split by range boundary (AddSSTable routing)."""
+        if len(keys) == 0:
+            return
+        kb = [bytes(k).rstrip(b"\x00") for k in np.asarray(keys)]
+        piece_of = [self._route_point(k)[0].store_id for k in kb]
+        order = np.argsort(piece_of, kind="stable")
+        arr = np.asarray(piece_of)[order]
+        for sid in np.unique(arr):
+            sel = order[arr == sid]
+            self.stores[int(sid)].engine.ingest(
+                np.asarray(keys)[sel], np.asarray(values)[sel], ts, **kw
+            )
+
+    # engine-wide ops forward to every store
+    def resolve_intents(self, txn: int, commit_ts: int, commit: bool):
+        for s in self.stores.values():
+            s.engine.resolve_intents(txn, commit_ts, commit)
+
+    def has_committed_writes_in(self, start, end, ts_lo, ts_hi,
+                                point: bool = False) -> bool:
+        if point or end is None:
+            store, _ = self._route_point(_b(start) if start else b"")
+            return store.engine.has_committed_writes_in(
+                start, end, ts_lo, ts_hi, point=point)
+        for store, ps, pe in self._route_span(_b(start) if start else None,
+                                              _b(end)):
+            if store.engine.has_committed_writes_in(ps, pe, ts_lo, ts_hi):
+                return True
+        return False
+
+    def other_intent(self, key: bytes, txn: int):
+        store, _ = self._route_point(_b(key))
+        return store.engine.other_intent(key, txn)
+
+    def newest_committed_ts(self, key: bytes) -> int:
+        store, _ = self._route_point(_b(key))
+        return store.engine.newest_committed_ts(key)
+
+    def intent_keys(self, txn: int) -> list[bytes]:
+        out: list[bytes] = []
+        for s in self.stores.values():
+            out.extend(s.engine.intent_keys(txn))
+        return sorted(out)
+
+    def flush(self):
+        for s in self.stores.values():
+            s.engine.flush()
+
+    def compact(self, bottom: bool = True):
+        for s in self.stores.values():
+            s.engine.compact(bottom=bottom)
+
+    # -- admin ---------------------------------------------------------------
+
+    def split_at(self, key) -> None:
+        self.meta.split_at(_b(key))
+
+    def move_range(self, range_id: int, to_store: int) -> int:
+        """Relocate a range's data: scan every version in-span from the
+        old store, ingest into the new one, clear the old span, then flip
+        the descriptor. The snapshot-rebalance reduction (the reference
+        streams a raft snapshot then deletes the old replica). Runs under
+        the sender mutex: a metadata flip mid-copy would lose writes."""
+        with self.mu:
+            src_desc = None
+            for d in self.meta.snapshot():
+                if d.range_id == range_id:
+                    src_desc = d
+                    break
+            if src_desc is None:
+                raise KeyError(f"no range {range_id}")
+            if src_desc.store_id == to_store:
+                return 0
+            src = self.stores[src_desc.store_id].engine
+            dst = self.stores[to_store].engine
+            moved = src.export_span(src_desc.start_key, src_desc.end_key)
+            dst.import_rows(moved)
+            src.clear_span(src_desc.start_key, src_desc.end_key)
+            self.meta.reassign(range_id, to_store)
+            metric.RANGE_MOVES.inc()
+            n = len(moved["ts"]) if moved else 0
+            log.info(log.OPS, "range moved", range=range_id,
+                     to_store=to_store, rows=n)
+            return n

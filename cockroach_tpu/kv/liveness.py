@@ -14,7 +14,6 @@ carry heartbeats when multi-host lands.
 from __future__ import annotations
 
 import struct
-import time
 from dataclasses import dataclass
 
 from .txn import DB, TransactionRetryError
@@ -76,17 +75,9 @@ class NodeLiveness:
         if reader is not None:
             v = reader.get(self._key(node_id))
         else:
-            from ..storage.lsm import WriteIntentError
+            from ..utils.errors import retry_past_intents
 
-            deadline = time.time() + 0.5
-            while True:
-                try:
-                    v = self.db.get(self._key(node_id))
-                    break
-                except WriteIntentError:
-                    if time.time() >= deadline:
-                        raise
-                    time.sleep(0.005)
+            v = retry_past_intents(lambda: self.db.get(self._key(node_id)))
         if v is None:
             return None
         epoch, exp, nid = _REC.unpack(v)
@@ -149,18 +140,11 @@ class NodeLiveness:
         return self.db.txn(op)
 
     def livenesses(self) -> list[LivenessRecord]:
-        from ..storage.lsm import WriteIntentError
+        from ..utils.errors import retry_past_intents
 
-        deadline = time.time() + 0.5
-        while True:
-            try:
-                rows = self.db.scan(_PREFIX, _PREFIX + b"\xff")
-                break
-            except WriteIntentError:
-                # a peer's heartbeat is mid-commit; status reads wait it out
-                if time.time() >= deadline:
-                    raise
-                time.sleep(0.005)
+        # a peer's heartbeat may be mid-commit; status reads wait it out
+        rows = retry_past_intents(
+            lambda: self.db.scan(_PREFIX, _PREFIX + b"\xff"))
         out = []
         for _, v in rows:
             epoch, exp, nid = _REC.unpack(v)

@@ -30,23 +30,7 @@ from urllib.parse import parse_qs, urlparse
 from ..utils import log, metric, settings
 
 
-def _status_read(fn, deadline_s: float = 0.5):
-    """Run a status-endpoint read, retrying briefly past WriteIntentError:
-    background loops (jobs adoption, heartbeats) commit constantly, and an
-    operator's curl must never 500 just because a txn was mid-commit (the
-    reference serves these endpoints from caches for the same reason)."""
-    import time
-
-    from ..storage.lsm import WriteIntentError
-
-    deadline = time.time() + deadline_s
-    while True:
-        try:
-            return fn()
-        except WriteIntentError:
-            if time.time() >= deadline:
-                raise
-            time.sleep(0.005)
+from ..utils.errors import retry_past_intents as _status_read  # noqa: E402
 
 
 class AdminServer:

@@ -54,6 +54,12 @@ _REC_RESOLVE = 1
 # key field (AddSSTable's link-don't-copy durability: the run file is
 # fsynced BEFORE the record is appended, so replay can always reload it)
 _REC_INGEST = 2
+# import records carry a side-file of full per-row MVCC fields (the
+# snapshot-apply half of a range relocation); clear records carry the
+# cleared span's [start, end) bounds in key/value (end b"" + flag=False
+# means open-ended) — the replica-removal half
+_REC_IMPORT = 3
+_REC_CLEAR = 4
 
 
 def _words_to_bytes(words) -> bytes:
@@ -397,6 +403,32 @@ class Engine:
                         # the re-log, so the run lands exactly once
                         self.ingest(z["key"][:n], z["value"][:n], ts,
                                     seq=seq, vlens=z["vlen"][:n])
+                elif kind == _REC_IMPORT:
+                    if seq > self._seq:
+                        side = os.path.join(os.path.dirname(path) or ".",
+                                            key.decode())
+                        try:
+                            z = np.load(side)
+                            rows = {f: z[f] for f in (
+                                "key", "ts", "seq", "txn", "tomb", "value",
+                                "vlen")}
+                        except (FileNotFoundError, ValueError, OSError,
+                                KeyError, EOFError,
+                                __import__("zipfile").BadZipFile) as e:
+                            from ..utils import log
+
+                            log.warning(log.STORAGE,
+                                        "import side file missing/torn on "
+                                        "replay; run dropped",
+                                        file=side, error=str(e))
+                            continue
+                        self.import_rows(rows)
+                        # restore the marker allocated at emit time (the
+                        # imported rows' own max seq may be lower)
+                        self._seq = max(self._seq, seq)
+                elif kind == _REC_CLEAR:
+                    self.clear_span(key or None,
+                                    value if flag else None)
                 elif seq > self._seq:
                     self._raw_append(key, value, ts, seq, txn, bool(flag))
         finally:
@@ -1020,6 +1052,157 @@ class Engine:
     @_locked
     def intent_keys(self, txn: int) -> list[bytes]:
         return sorted(k for k, t in self._locks.items() if t == txn)
+
+    # -- range relocation (snapshot-rebalance primitives) -------------------
+
+    @_locked
+    def export_span(self, start: bytes | None, end: bytes | None) -> dict:
+        """Every VERSION in [start, end) — committed history, tombstones
+        and intents included — as host arrays (the raft-snapshot payload
+        role for kv/dist.py's move_range). Keys keep engine width."""
+        view = self._merged_view()
+        empty = {
+            "key": np.zeros((0, self.key_width), np.uint8),
+            "ts": np.zeros((0,), np.int64), "seq": np.zeros((0,), np.int64),
+            "txn": np.zeros((0,), np.int64),
+            "tomb": np.zeros((0,), np.bool_),
+            "value": np.zeros((0, self.val_width), np.uint8),
+            "vlen": np.zeros((0,), np.int32),
+        }
+        if view is None:
+            return empty
+        sw = K.encode_bound(start, self.key_width)
+        ew = K.encode_bound(end, self.key_width)
+        m, _ = _range_mask(view,
+                           None if sw is None else jnp.asarray(sw),
+                           None if ew is None else jnp.asarray(ew))
+        idx = np.nonzero(np.asarray(m))[0]
+        if not len(idx):
+            return empty
+        return {
+            "key": np.asarray(view.key)[idx],
+            "ts": np.asarray(view.ts)[idx],
+            "seq": np.asarray(view.seq)[idx],
+            "txn": np.asarray(view.txn)[idx],
+            "tomb": np.asarray(view.tomb)[idx],
+            "value": np.asarray(view.value)[idx],
+            "vlen": np.asarray(view.vlen)[idx],
+        }
+
+    @_locked
+    def import_rows(self, rows: dict) -> None:
+        """Land exported versions as one sorted run (the snapshot-apply
+        role). Rows keep their source-engine ts/seq/txn fields verbatim;
+        this engine's sequence high-water mark is raised past the largest
+        imported seq so future local writes always win same-(key, ts)
+        ties. Committed rows refresh the tscache, intents restore their
+        locks. WAL-logged via a side file (the ingest durability shape):
+        acknowledged imports survive process crashes."""
+        n = len(rows["ts"])
+        if n == 0:
+            return
+        if rows["key"].shape[1] != self.key_width:
+            raise ValueError("imported keys do not match engine key width")
+        if rows["value"].shape[1] > self.val_width:
+            raise ValueError("imported values wider than engine val width")
+        cap = _pad(n)
+
+        def padrow(a, fill=0):
+            out = np.full((cap,) + a.shape[1:], fill, a.dtype)
+            out[:n] = a
+            return out
+
+        vb = np.zeros((cap, self.val_width), np.uint8)
+        vb[:n, : rows["value"].shape[1]] = rows["value"]
+        seq = rows["seq"].astype(np.int64)
+        self._seq = max(self._seq, int(seq.max()))
+        if self._wal is not None and not self._replaying:
+            # durable-before-visible, the ingest() discipline: side file
+            # first (fsynced under wal_fsync), then the WAL record naming
+            # it. The marker seq is allocated ABOVE the current high-water
+            # mark (and raises it) so the replay gate `seq > self._seq` is
+            # strictly satisfied when earlier records have been re-applied.
+            marker = self._seq + 1
+            self._seq = marker
+            side = f"{self.wal_path}.import{int(marker):012d}.npz"
+            with open(side, "wb") as f:
+                np.savez(f, key=rows["key"], ts=rows["ts"], seq=seq,
+                         txn=rows["txn"], tomb=rows["tomb"],
+                         value=rows["value"], vlen=rows["vlen"])
+                f.flush()
+                if self.wal_fsync:
+                    os.fsync(f.fileno())
+            if self.wal_fsync:
+                dfd = os.open(os.path.dirname(side) or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            self._wal_record(_REC_IMPORT, os.path.basename(side).encode(),
+                             b"", 0, int(marker), 0, False)
+        blk = mvcc.KVBlock(
+            key=jnp.asarray(padrow(rows["key"])),
+            ts=jnp.asarray(padrow(rows["ts"])),
+            seq=jnp.asarray(padrow(seq)),
+            txn=jnp.asarray(padrow(rows["txn"])),
+            tomb=jnp.asarray(padrow(rows["tomb"])),
+            value=jnp.asarray(vb),
+            vlen=jnp.asarray(padrow(rows["vlen"])),
+            mask=jnp.asarray(np.arange(cap) < n),
+        )
+        self.runs.insert(0, mvcc.sort_block(blk))
+        self._gen += 1
+        self.stats.runs = len(self.runs)
+        committed = rows["txn"] == 0
+        if committed.any():
+            self._newest_committed.bulk(
+                rows["key"][committed], rows["ts"][committed]
+            )
+        for i in np.nonzero(~committed)[0]:
+            k = bytes(rows["key"][i]).rstrip(b"\x00")
+            self._locks[k] = int(rows["txn"][i])
+        if len(self.runs) > self.l0_trigger:
+            self.compact(bottom=False)
+
+    @_locked
+    def clear_span(self, start: bytes | None, end: bytes | None) -> None:
+        """Physically drop every version in [start, end) from the memtable
+        and all runs — replica removal after a range moves away. NOT an
+        MVCC delete: no tombstones, no history retained. WAL-logged (clear
+        records replay in log order, like intent resolutions) so a crash
+        cannot resurrect a departed range's data."""
+        if self._wal is not None and not self._replaying:
+            self._wal_record(_REC_CLEAR, start or b"", end or b"", 0, 0, 0,
+                             end is not None)
+        sw = K.encode_bound(start, self.key_width)
+        ew = K.encode_bound(end, self.key_width)
+        self.flush_mem_only()
+        swj = None if sw is None else jnp.asarray(sw)
+        ewj = None if ew is None else jnp.asarray(ew)
+        new_runs = []
+        for r in self.runs:
+            m, cnt = _range_mask(r, swj, ewj)
+            if int(np.asarray(cnt)) == 0:
+                new_runs.append(r)
+                continue
+            keep = r.mask & ~m
+            kept = int(np.asarray(jnp.sum(keep)))
+            if kept == 0:
+                continue
+            r2 = mvcc.KVBlock(
+                key=r.key, ts=r.ts, seq=r.seq, txn=r.txn, tomb=r.tomb,
+                value=r.value, vlen=r.vlen, mask=keep,
+            )
+            new_runs.append(_shrink(mvcc.sort_block(r2)))
+        self.runs = new_runs
+        # drop lock-table entries for the departed span
+        def _in(k: bytes) -> bool:
+            if start is not None and k < start:
+                return False
+            return end is None or k < end
+        self._locks = {k: t for k, t in self._locks.items() if not _in(k)}
+        self._gen += 1
+        self.stats.runs = len(self.runs)
 
     # -- stats / checkpoint -------------------------------------------------
 

@@ -54,3 +54,24 @@ def query_boundary(stage: str):
         return wrapped
 
     return deco
+
+
+def retry_past_intents(fn, deadline_s: float = 0.5):
+    """Run a status-level read, retrying briefly past WriteIntentError:
+    background loops (heartbeats, jobs adoption) commit constantly, and a
+    status probe (admin HTTP endpoint, is_live check) must never fail just
+    because a txn was mid-commit. The reference serves such reads from
+    caches/gossip for the same reason. Raises the final WriteIntentError
+    if the intent outlives the deadline (a genuinely wedged writer)."""
+    import time
+
+    from ..storage.lsm import WriteIntentError
+
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            return fn()
+        except WriteIntentError:
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.005)

@@ -140,3 +140,6 @@ QUERY_SECONDS = DEFAULT.histogram(
     "sql_query_seconds", "end-to-end query latency")
 TXN_COMMITS = DEFAULT.counter("txn_commits", "committed transactions")
 TXN_RETRIES = DEFAULT.counter("txn_retries", "transaction retries")
+RANGE_SPLITS = DEFAULT.counter("range_splits", "admin range splits")
+RANGE_MOVES = DEFAULT.counter(
+    "range_moves", "range relocations between stores")
