@@ -53,6 +53,8 @@ def run_ycsb_e(
     vectorized analog of the reference's concurrent YCSB workers (pkg/
     workload/ycsb runs many goroutines against one store; over a remote-
     attached TPU, batching is the only way past the 1/RTT serial floor)."""
+    import sys
+
     rng = np.random.default_rng(seed)
     eng = Engine(key_width=16, val_width=16, memtable_size=4096)
     t_load = time.time()
@@ -66,9 +68,16 @@ def run_ycsb_e(
         vals[:, 1:9] = keys[:, 7:15]  # value derived from key digits
         eng.ingest(keys, vals, ts=ts)
         ts += 1
+        print(f"# ycsb load {hi}/{n_keys} ({time.time()-t_load:.0f}s, "
+              f"{eng.stats.compactions} compactions)",
+              file=sys.stderr, flush=True)
     load_s = time.time() - t_load
     # warm the merged view + compile the scan kernel before timing
+    t_warm = time.time()
     eng.scan_batch([_key(0)] * concurrency, ts=ts, max_keys=scan_len)
+    print(f"# ycsb scan warmup {time.time()-t_warm:.0f}s "
+          f"(window={eng._scan_windows.get(scan_len)})",
+          file=sys.stderr, flush=True)
 
     next_pk = n_keys
     rows = 0
@@ -94,6 +103,8 @@ def run_ycsb_e(
                                   max_keys=scan_len)[:n_scans]:
             rows += len(got)
         done += group
+        print(f"# ycsb ops {done}/{ops} ({time.time()-t0:.1f}s)",
+              file=sys.stderr, flush=True)
     el = time.time() - t0
     return {
         "n_keys": n_keys,

@@ -18,10 +18,13 @@ TPU-native reduction, single process, N stores (one Engine each):
 - ``Store``: an Engine + the set of range ids it owns; every request
   verifies its span against the CURRENT descriptor before touching the
   engine (the bounds check that makes stale caches detectable).
-- ``DistSender``: implements the Engine surface DB/Txn already consume
-  (put/get/scan/scan_batch/resolve_intents/...), so ``DB(DistSender(...),
-  clock)`` drops in with the txn layer unchanged. Cross-range scans split
-  by range boundary and concatenate per-store results in key order.
+- ``DistSender``: implements the Engine surface DB/Txn and the SQL scan
+  path consume (put/get/scan/scan_batch/ingest/resolve_intents/
+  checkpoint/_merged_view/...), so ``DB(DistSender(...), clock)`` drops
+  in with the txn layer unchanged. Cross-range scans split by range
+  boundary and concatenate per-store results in key order. NOT forwarded:
+  the admission governor and LSM tuning knobs — those stay per-store
+  (consult ``stores[i].engine`` directly).
 - admin ops: ``split_at`` (metadata-only, like the reference's AdminSplit
   — both halves stay on the store), ``move_range`` (scan + ingest into
   the target store — the snapshot-rebalance role).
@@ -184,6 +187,20 @@ class Store:
         return cur
 
 
+def _sender_locked(fn):
+    """Serialize a DistSender request under the sender mutex — restores the
+    whole-keyspace atomicity the single-Engine @_locked surface provides
+    (Txn.commit's refresh+resolve section and move_range's export->clear
+    window must exclude concurrent writes on EVERY store)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        with self.mu:
+            return fn(self, *a, **kw)
+    return wrapper
+
+
 def _b(x) -> bytes:
     return x.encode() if isinstance(x, str) else bytes(x)
 
@@ -248,21 +265,25 @@ class DistSender:
 
     # -- Engine surface ------------------------------------------------------
 
+    @_sender_locked
     def put(self, key, value, ts: int, txn: int = 0):
         k = _b(key)
         store, _ = self._route_point(k)
         return store.engine.put(k, value, ts=ts, txn=txn)
 
+    @_sender_locked
     def delete(self, key, ts: int, txn: int = 0):
         k = _b(key)
         store, _ = self._route_point(k)
         return store.engine.delete(k, ts=ts, txn=txn)
 
+    @_sender_locked
     def get(self, key, ts: int, txn: int = 0):
         k = _b(key)
         store, _ = self._route_point(k)
         return store.engine.get(k, ts=ts, txn=txn)
 
+    @_sender_locked
     def scan(self, start, end, ts: int, txn: int = 0, max_keys=None):
         out: list[tuple[bytes, bytes]] = []
         s = _b(start) if start is not None else None
@@ -275,6 +296,7 @@ class DistSender:
                                          max_keys=left))
         return out
 
+    @_sender_locked
     def scan_batch(self, starts, ts: int, txn: int = 0, max_keys: int = 64):
         """Batched scans grouped BY STORE so each store runs one device
         pass (the Streamer's per-range request grouping,
@@ -298,70 +320,146 @@ class DistSender:
                 if d.end_key is not None:
                     rows = [(k, v) for k, v in rows if k < d.end_key]
                 results[i] = rows
-        # continue truncated scans past their range boundary
+        # continue truncated scans past their range boundary (self.scan
+        # walks ALL remaining ranges, so one continuation suffices)
         for i, rows in enumerate(results):
             d = descs[i]
-            while d.end_key is not None and len(rows) < max_keys:
-                nxt = self.scan(d.end_key, None, ts=ts, txn=txn,
-                                max_keys=max_keys - len(rows))
-                rows = rows + nxt
-                break  # self.scan already walked the remaining ranges
+            if d.end_key is not None and len(rows) < max_keys:
+                rows = rows + self.scan(d.end_key, None, ts=ts, txn=txn,
+                                        max_keys=max_keys - len(rows))
             results[i] = rows[:max_keys]
         return results
 
+    @_sender_locked
     def ingest(self, keys: np.ndarray, values: np.ndarray, ts: int,
                **kw) -> None:
-        """Bulk ingest split by range boundary (AddSSTable routing)."""
-        if len(keys) == 0:
+        """Bulk ingest split by range boundary (AddSSTable routing). One
+        meta snapshot + one vectorized searchsorted routes the whole batch
+        — never a per-key routing round trip."""
+        n = len(keys)
+        if n == 0:
             return
-        kb = [bytes(k).rstrip(b"\x00") for k in np.asarray(keys)]
-        piece_of = [self._route_point(k)[0].store_id for k in kb]
-        order = np.argsort(piece_of, kind="stable")
-        arr = np.asarray(piece_of)[order]
-        for sid in np.unique(arr):
-            sel = order[arr == sid]
-            self.stores[int(sid)].engine.ingest(
-                np.asarray(keys)[sel], np.asarray(values)[sel], ts, **kw
+        descs = self.meta.snapshot()  # sorted by start_key, tiles keyspace
+        ka = np.asarray(keys)
+        if len(descs) == 1:
+            self.stores[descs[0].store_id].engine.ingest(
+                ka, np.asarray(values), ts, **kw)
+            return
+        width = ka.shape[1]
+        starts = np.zeros((len(descs), width), np.uint8)
+        for i, d in enumerate(descs):
+            s = d.start_key[:width]
+            starts[i, :len(s)] = np.frombuffer(s, np.uint8)
+        kv = np.ascontiguousarray(ka).view(f"V{width}").reshape(-1)
+        sv = np.ascontiguousarray(starts).view(f"V{width}").reshape(-1)
+        piece_of = np.searchsorted(sv, kv, side="right") - 1
+        va = np.asarray(values)
+        for di in np.unique(piece_of):
+            sel = piece_of == di
+            self.stores[descs[int(di)].store_id].engine.ingest(
+                ka[sel], va[sel], ts, **kw
             )
 
     # engine-wide ops forward to every store
+    @_sender_locked
     def resolve_intents(self, txn: int, commit_ts: int, commit: bool):
         for s in self.stores.values():
             s.engine.resolve_intents(txn, commit_ts, commit)
 
+    @_sender_locked
     def has_committed_writes_in(self, start, end, ts_lo, ts_hi,
                                 point: bool = False) -> bool:
-        if point or end is None:
+        if point:
             store, _ = self._route_point(_b(start) if start else b"")
             return store.engine.has_committed_writes_in(
-                start, end, ts_lo, ts_hi, point=point)
-        for store, ps, pe in self._route_span(_b(start) if start else None,
-                                              _b(end)):
+                start, end, ts_lo, ts_hi, point=True)
+        # span refresh — open-ended spans (end=None) walk EVERY range the
+        # span covers; routing them as a point would skip all other stores
+        # and let an invalidated read commit
+        for store, ps, pe in self._route_span(
+            _b(start) if start is not None else None,
+            _b(end) if end is not None else None,
+        ):
             if store.engine.has_committed_writes_in(ps, pe, ts_lo, ts_hi):
                 return True
         return False
 
+    @_sender_locked
     def other_intent(self, key: bytes, txn: int):
         store, _ = self._route_point(_b(key))
         return store.engine.other_intent(key, txn)
 
+    @_sender_locked
     def newest_committed_ts(self, key: bytes) -> int:
         store, _ = self._route_point(_b(key))
         return store.engine.newest_committed_ts(key)
 
+    @_sender_locked
     def intent_keys(self, txn: int) -> list[bytes]:
         out: list[bytes] = []
         for s in self.stores.values():
             out.extend(s.engine.intent_keys(txn))
         return sorted(out)
 
+    # -- columnar read surface (SQL fast path) -------------------------------
+
+    @property
+    def _seq(self):
+        """Hashable write-sequence fingerprint across stores — KVTable's
+        per-engine caches key on (engine._seq, engine._gen)."""
+        return tuple(s.engine._seq for s in self.stores.values())
+
+    @property
+    def _gen(self):
+        return tuple(s.engine._gen for s in self.stores.values())
+
+    @_sender_locked
+    def _merged_view(self):
+        """One sorted device view over EVERY store — the cross-range
+        columnar scan (KVTable.device_batch reads this exactly like a
+        single engine's merged view). Cached per store-generation vector;
+        stores' own caches make the per-store halves incremental."""
+        from ..storage import mvcc
+        from ..storage.lsm import _pad
+
+        key = (self._seq, self._gen)
+        cached = getattr(self, "_view_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        views = []
+        for s in self.stores.values():
+            with s.engine.mu:
+                v = s.engine._merged_view()  # overlays memtable, cached
+            if v is not None:
+                views.append(v)
+        if not views:
+            view = None
+        elif len(views) == 1:
+            view = views[0]
+        else:
+            total = sum(v.capacity for v in views)
+            view = mvcc.merge_blocks(tuple(views), cap=_pad(total))
+        self._view_cache = (key, view)
+        return view
+
+    @_sender_locked
     def flush(self):
         for s in self.stores.values():
             s.engine.flush()
 
+    @_sender_locked
     def compact(self, bottom: bool = True):
         for s in self.stores.values():
             s.engine.compact(bottom=bottom)
+
+    @_sender_locked
+    def checkpoint(self, path: str):
+        """Checkpoint every store into a per-store subdirectory (the jobs
+        framework's backup resumer calls db.engine.checkpoint)."""
+        import os
+
+        for sid, s in self.stores.items():
+            s.engine.checkpoint(os.path.join(path, f"store{sid}"))
 
     # -- admin ---------------------------------------------------------------
 

@@ -1256,15 +1256,16 @@ class Engine:
             os.close(dfd)
         self._truncate_wal()
         if self.wal_path is not None:
-            # ingest side-files were only reachable through the truncated
-            # WAL; their rows are in the checkpoint runs now
+            # ingest/import side-files were only reachable through the
+            # truncated WAL; their rows are in the checkpoint runs now
             import glob
 
-            for side in glob.glob(f"{self.wal_path}.ingest*.npz"):
-                try:
-                    os.unlink(side)
-                except OSError:  # pragma: no cover - best-effort cleanup
-                    pass
+            for pat in ("ingest", "import"):
+                for side in glob.glob(f"{self.wal_path}.{pat}*.npz"):
+                    try:
+                        os.unlink(side)
+                    except OSError:  # pragma: no cover - best-effort
+                        pass
 
     @classmethod
     def open_checkpoint(cls, path: str, **kwargs) -> "Engine":
