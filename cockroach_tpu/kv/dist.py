@@ -32,11 +32,10 @@ TPU-native reduction, single process, N stores (one Engine each):
 Replication (multiple replicas per range, raft) stays out of scope per
 SURVEY §7; each range has exactly one home store.
 
-Boundary: the SQL columnar fast path (kv/table.py KVTable.device_batch)
-reads one engine's merged device view directly and therefore runs over a
-single-store DB today; DistSender serves the kv.DB/Txn surface (point ops,
-scans, batched scans, bulk ingest, intents). Routing SQL table shards
-across stores is the next step (per-store views + a merge stage).
+The SQL columnar fast path (kv/table.py KVTable.device_batch) reads
+``_merged_view()`` — here a cross-store merged device view — so SQL
+tables work over a split keyspace (see test_sql_over_multi_range_
+keyspace).
 """
 
 from __future__ import annotations
@@ -332,10 +331,12 @@ class DistSender:
 
     @_sender_locked
     def ingest(self, keys: np.ndarray, values: np.ndarray, ts: int,
-               **kw) -> None:
+               vlens=None, seq=None) -> None:
         """Bulk ingest split by range boundary (AddSSTable routing). One
         meta snapshot + one vectorized searchsorted routes the whole batch
-        — never a per-key routing round trip."""
+        — never a per-key routing round trip. Per-row vlens split with
+        the same selection; an explicit seq only makes sense against one
+        store's sequence space and is rejected on a split keyspace."""
         n = len(keys)
         if n == 0:
             return
@@ -343,8 +344,13 @@ class DistSender:
         ka = np.asarray(keys)
         if len(descs) == 1:
             self.stores[descs[0].store_id].engine.ingest(
-                ka, np.asarray(values), ts, **kw)
+                ka, np.asarray(values), ts, vlens=vlens, seq=seq)
             return
+        if seq is not None:
+            raise ValueError(
+                "explicit ingest seq is per-store; unsupported on a "
+                "split keyspace"
+            )
         width = ka.shape[1]
         starts = np.zeros((len(descs), width), np.uint8)
         for i, d in enumerate(descs):
@@ -354,10 +360,12 @@ class DistSender:
         sv = np.ascontiguousarray(starts).view(f"V{width}").reshape(-1)
         piece_of = np.searchsorted(sv, kv, side="right") - 1
         va = np.asarray(values)
+        vl = None if vlens is None else np.asarray(vlens)
         for di in np.unique(piece_of):
             sel = piece_of == di
             self.stores[descs[int(di)].store_id].engine.ingest(
-                ka[sel], va[sel], ts, **kw
+                ka[sel], va[sel], ts,
+                vlens=None if vl is None else vl[sel],
             )
 
     # engine-wide ops forward to every store

@@ -52,7 +52,12 @@ class AdminServer:
             live = n.liveness.is_live(n.node_id)
         except Exception:
             live = False
-        return {"nodeId": n.node_id, "isLive": bool(live)}
+        out = {"nodeId": n.node_id, "isLive": bool(live)}
+        disk = getattr(n, "disk", None)
+        if disk is not None:
+            out["diskSlow"] = disk.is_slow()
+            out["diskWriteP99Ms"] = round(disk.p99_ms(), 2)
+        return out
 
     def nodes(self) -> dict:
         now = self.node.db.clock.now()

@@ -82,6 +82,28 @@ class Node:
         self._stop.clear()
         self.liveness.heartbeat()  # own record exists before anything reads
 
+        # disk health: WAL-backed engines get a monitor fed by their own
+        # WAL appends plus a periodic probe (storage/disk.py)
+        self.disk = None
+        eng = self.db.engine
+        if getattr(eng, "wal_path", None):
+            import os
+
+            from ..storage.disk import DiskMonitor
+
+            self.disk = DiskMonitor(
+                os.path.dirname(eng.wal_path) or "."
+            ).start()
+            eng.disk_monitor = self.disk
+
+        # run pending upgrade migrations before serving (upgrademanager
+        # role: the store's persisted version catches up to the binary's)
+        from ..kv.upgrade import run_upgrades
+
+        ran = run_upgrades(self.db)
+        for name in ran:
+            log.info(log.OPS, "upgrade migration complete", name=name)
+
         self._spawn(self._heartbeat_loop, "liveness-heartbeat")
         self._spawn(self._metrics_loop, "tsdb-poller")
         self._spawn(self._adopt_loop, "jobs-adopt")
@@ -136,6 +158,9 @@ class Node:
         if getattr(self, "admin", None) is not None:
             self.admin.close()
             self.admin = None
+        if getattr(self, "disk", None) is not None:
+            self.disk.stop()
+            self.disk = None
         log.info(log.OPS, "node stopped", node=self.node_id)
 
     def _spawn(self, fn, name: str) -> None:

@@ -32,6 +32,7 @@ import functools
 import os
 import struct
 import threading
+import time
 from dataclasses import dataclass, field
 
 import jax
@@ -312,6 +313,9 @@ class Engine:
         self.wal_fsync = wal_fsync
         self._wal = None
         self._replaying = False
+        # optional DiskMonitor (storage/disk.py): when set, WAL appends
+        # feed its rolling write-latency window
+        self.disk_monitor = None
         if wal_path is not None:
             self._arm_wal(wal_path)
 
@@ -339,10 +343,16 @@ class Engine:
                     seq: int, txn: int, flag: bool) -> None:
         rec = _WAL_REC.pack(kind, ts, seq, txn, 1 if flag else 0,
                             len(key), len(value))
+        mon = self.disk_monitor  # one read: may be attached concurrently
+        t0 = time.time() if mon is not None else 0.0
         self._wal.write(rec + key + value)
         self._wal.flush()
         if self.wal_fsync:
             os.fsync(self._wal.fileno())
+        if mon is not None:
+            # the WAL append IS the write-latency signal the disk monitor
+            # tracks (pkg/storage/disk samples the same device)
+            mon.observe(time.time() - t0)
 
     def _replay_wal(self, path: str) -> int:
         """Recover state lost in a crash: re-apply writes above the restored

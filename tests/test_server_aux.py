@@ -1,0 +1,145 @@
+"""Disk health monitor + ballast + cluster version/upgrades."""
+
+import os
+
+from cockroach_tpu.kv import DB, Clock
+from cockroach_tpu.kv.upgrade import (
+    Migration,
+    active_version,
+    is_active,
+    run_upgrades,
+)
+from cockroach_tpu.storage.disk import (
+    DiskMonitor,
+    create_ballast,
+    release_ballast,
+)
+from cockroach_tpu.storage.lsm import Engine
+from cockroach_tpu.utils import settings
+
+
+def test_disk_monitor_flags_slow_and_recovers(tmp_path):
+    mon = DiskMonitor(str(tmp_path), window=16)
+    for _ in range(8):
+        mon.observe(0.001)  # 1ms: healthy
+    assert not mon.is_slow()
+    p99_healthy = mon.p99_ms()
+    assert 0 < p99_healthy < 5
+    # a stall pushes p99 past the threshold
+    for _ in range(16):
+        mon.observe(1.0)
+    assert mon.is_slow()
+    # recovery: fresh fast samples displace the stall window
+    for _ in range(16):
+        mon.observe(0.001)
+    assert not mon.is_slow()
+    # probe writes a real marker file and records its latency
+    ms = mon.probe()
+    assert ms >= 0 and os.path.exists(tmp_path / ".disk_probe")
+
+
+def test_wal_appends_feed_disk_monitor(tmp_path):
+    eng = Engine(key_width=16, val_width=16,
+                 wal_path=str(tmp_path / "w.wal"))
+    mon = DiskMonitor(str(tmp_path))
+    eng.disk_monitor = mon
+    for i in range(10):
+        eng.put(b"k%05d" % i, b"v", ts=i + 1)
+    assert len(mon.samples) >= 10  # every WAL append was observed
+
+
+def test_ballast_reserve_and_release(tmp_path):
+    p = create_ballast(str(tmp_path), size_bytes=1 << 20)
+    assert os.path.getsize(p) == 1 << 20
+    # idempotent
+    assert create_ballast(str(tmp_path), size_bytes=1 << 20) == p
+    assert release_ballast(str(tmp_path)) is True
+    assert not os.path.exists(p)
+    assert release_ballast(str(tmp_path)) is False
+
+
+def test_upgrades_run_in_order_and_persist(tmp_path):
+    db = DB(Engine(key_width=16, val_width=64), Clock())
+    # fresh store bootstraps at the target with no migrations run
+    ran = run_upgrades(db, to_version=(4, 2), migrations=[])
+    assert ran == [] and active_version(db) == (4, 2)
+
+    # an OLD store (simulate by rewinding the version key) runs pending
+    # migrations in order, bumping the version after each
+    import struct
+
+    db.put(b"\x01ver", struct.pack("<ii", 4, 0))
+    order = []
+    migs = [
+        Migration((4, 1), "add-index-x", lambda d: order.append("x")),
+        Migration((4, 2), "rewrite-desc", lambda d: order.append("d")),
+        Migration((4, 0), "too-old", lambda d: order.append("OLD")),
+    ]
+    migs.sort(key=lambda m: m.version)
+    ran = run_upgrades(db, to_version=(4, 2), migrations=migs)
+    assert ran == ["add-index-x", "rewrite-desc"]
+    assert order == ["x", "d"]  # (4,0) already active: skipped
+    assert active_version(db) == (4, 2)
+    assert is_active(db, (4, 1)) and not is_active(db, (4, 3))
+
+    # idempotent: nothing pending on a second pass
+    assert run_upgrades(db, to_version=(4, 2), migrations=migs) == []
+
+
+def test_crash_between_migrations_resumes_at_failure():
+    db = DB(Engine(key_width=16, val_width=64), Clock())
+    import struct
+
+    db.put(b"\x01ver", struct.pack("<ii", 1, 0))
+    order = []
+
+    def boom(d):
+        order.append("m2")
+        raise RuntimeError("mid-upgrade crash")
+
+    migs = [
+        Migration((1, 1), "m1", lambda d: order.append("m1")),
+        Migration((1, 2), "m2-crashes", boom),
+    ]
+    try:
+        run_upgrades(db, to_version=(1, 2), migrations=migs)
+        raise AssertionError("expected the migration to raise")
+    except RuntimeError:
+        pass
+    # m1's bump persisted; the retry re-runs ONLY m2
+    assert active_version(db) == (1, 1)
+    migs[1] = Migration((1, 2), "m2-fixed", lambda d: order.append("m2ok"))
+    ran = run_upgrades(db, to_version=(1, 2), migrations=migs)
+    assert ran == ["m2-fixed"] and order == ["m1", "m2", "m2ok"]
+    assert active_version(db) == (1, 2)
+
+
+def test_health_endpoint_reports_disk(tmp_path):
+    import json
+    import urllib.request
+
+    from cockroach_tpu.server.node import Node
+
+    eng = Engine(key_width=64, val_width=128,
+                 wal_path=str(tmp_path / "n.wal"))
+    node = Node(node_id=3, engine=eng, heartbeat_interval_s=0.1,
+                ttl_ms=30000)
+    node.start(gossip_port=None, http_port=0)
+    try:
+        assert node.disk is not None
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{node.admin.port}/health", timeout=5
+        ) as r:
+            h = json.loads(r.read())
+        assert "diskSlow" in h and h["diskSlow"] is False
+        # slow-disk flag surfaces through the endpoint
+        thr = settings.get("storage.disk.slow_threshold_ms")
+        for _ in range(300):
+            node.disk.observe(thr / 1e3 * 5)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{node.admin.port}/health", timeout=5
+        ) as r:
+            h = json.loads(r.read())
+        assert h["diskSlow"] is True
+    finally:
+        node.stop()
