@@ -118,6 +118,13 @@ class Dictionary:
     def __len__(self) -> int:
         return len(self.values)
 
+    def reset(self, values: np.ndarray) -> None:
+        """Rebuild this dictionary IN PLACE. Operators whose string output
+        values exist only at runtime (string_agg) pre-create an empty
+        Dictionary at plan-build time — so parent operators hold the
+        reference — and fill it here when the values materialize."""
+        self.__init__(values)
+
     def code_of(self, value: str) -> int:
         """Code for a literal value, or -1 if absent (predicate is then false)."""
         hits = np.nonzero(self.values.astype(str) == value)[0]

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..catalog import Table
-from ..coldata.batch import Batch, Column, concat
+from ..coldata.batch import Batch, Column, Dictionary, concat
 from ..coldata.types import FLOAT64, INT64, Family, Schema, SQLType
 from ..ops import aggregation as agg_ops
 from ..ops.aggregation import partial_layout
@@ -459,6 +459,26 @@ class AggregateOp(OneInputOperator):
         self.mode = mode
         self.group_cols = group_cols
         self.aggs = aggs
+        # string_agg runs OUTSIDE the device state pipeline: per-row
+        # (group key, string code) pairs are collected host-side during
+        # the spool and concatenated at finalize (the reference's concat
+        # agg accumulates variable-width bytes, which has no fixed-tile
+        # device representation). The device pipeline runs a count
+        # placeholder in its slot; _attach_saggs overwrites the column.
+        self._sagg = [(j, s) for j, s in enumerate(aggs)
+                      if s.func == "string_agg"]
+        if self._sagg:
+            if mode != "complete":
+                raise ValueError(
+                    "string_agg runs in complete mode only (distributed "
+                    "plans fall back to local execution, parallel/"
+                    "planner.py _needs_local)"
+                )
+            aggs = tuple(
+                agg_ops.AggSpec("count", s.col, s.name)
+                if s.func == "string_agg" else s
+                for s in aggs
+            )
         # the schema over which aggs/group_cols were written
         base = input_schema if input_schema is not None else child.output_schema
         self.base_schema = base
@@ -508,6 +528,11 @@ class AggregateOp(OneInputOperator):
         for pos, d in self.dictionaries.items():
             self.col_stats.setdefault(pos, (0, max(0, len(d) - 1)))
             self.key_stats.setdefault(pos, (0, max(0, len(d) - 1)))
+        # string_agg outputs get an empty Dictionary NOW (parents copy the
+        # reference at construction) and fill it in place at finalize
+        for j, _ in self._sagg:
+            self.dictionaries[len(group_cols) + j] = Dictionary(
+                np.array([], dtype=object))
         self._acc = None
         self._emitted = False
 
@@ -521,6 +546,7 @@ class AggregateOp(OneInputOperator):
         super().init()
         self._tiles: list[Batch] = []
         self._emitted = False
+        self._sagg_rows = {j: {} for j, _ in self._sagg}
         if hasattr(self, "_partial_fn"):
             return
         schema = self.base_schema
@@ -580,7 +606,22 @@ class AggregateOp(OneInputOperator):
             tile_raw, tile_jit = self._partial_raw, self._partial_fn
         spooled = 0
         spooled_bytes = 0
-        for part in _consume(self, "partial", tile_raw, tile_jit):
+        if self._sagg:
+            # plain pull (no fused chain): every input tile materializes
+            # its (group key, string code) pairs host-side before the
+            # device partial — the host collect cannot live inside a jit
+            def gen():
+                while True:
+                    b = self.child.next_batch()
+                    if b is None:
+                        return
+                    self._collect_sagg(b)
+                    yield tile_jit(b)
+
+            source = gen()
+        else:
+            source = _consume(self, "partial", tile_raw, tile_jit)
+        for part in source:
             self._tiles.append(part)
             spooled += part.capacity
             spooled_bytes += batch_bytes(part)
@@ -588,6 +629,78 @@ class AggregateOp(OneInputOperator):
                 self._tiles = [self._merge_down()]
                 spooled = self._tiles[0].capacity
                 spooled_bytes = batch_bytes(self._tiles[0])
+
+    # -- string_agg host path ------------------------------------------------
+
+    def _collect_sagg(self, b: Batch) -> None:
+        """Append (group key tuple -> string values) for every live row of
+        one input tile, in row order."""
+        mask = np.asarray(b.mask)
+        idx = np.nonzero(mask)[0]
+        if not len(idx):
+            return
+        keys = self._host_group_keys(b, idx)
+        for j, spec in self._sagg:
+            col = b.cols[spec.col]
+            data = np.asarray(col.data)[idx]
+            valid = np.asarray(col.valid)[idx]
+            d = self.child.dictionaries.get(spec.col)
+            store = self._sagg_rows[j]
+            for key, code, ok in zip(keys, data, valid):
+                if not ok:
+                    continue
+                v = (str(d.values[int(code)]) if d is not None
+                     else str(code))
+                store.setdefault(key, []).append(v)
+
+    def _host_group_keys(self, b: Batch, idx: np.ndarray) -> list[tuple]:
+        """Hashable per-row group keys (None for NULL key columns) over the
+        rows at `idx` — for SOURCE-schema batches (complete mode)."""
+        parts = []
+        for gi in self.group_cols:
+            c = b.cols[gi]
+            data = np.asarray(c.data)[idx]
+            valid = np.asarray(c.valid)[idx]
+            parts.append([
+                (None if not ok else data[i].item())
+                for i, ok in enumerate(valid)
+            ])
+        return list(zip(*parts)) if parts else [()] * len(idx)
+
+    def _attach_saggs(self, final: Batch) -> Batch:
+        """Overwrite each string_agg placeholder column with codes into a
+        runtime-built Dictionary of per-group concatenations."""
+        k = self.num_keys
+        mask = np.asarray(final.mask)
+        idx = np.nonzero(mask)[0]
+        # final batch group keys are at positions 0..k-1 (output schema)
+        gcols_saved = self.group_cols
+        try:
+            self.group_cols = tuple(range(k))
+            keys = self._host_group_keys(final, idx)
+        finally:
+            self.group_cols = gcols_saved
+        cols = list(final.cols)
+        for j, spec in self._sagg:
+            store = self._sagg_rows[j]
+            joined = [
+                spec.sep.join(store[key]) if store.get(key) else None
+                for key in keys
+            ]
+            uniq = sorted({v for v in joined if v is not None})
+            self.dictionaries[k + j].reset(np.array(uniq, dtype=object))
+            code_of = {v: c for c, v in enumerate(uniq)}
+            codes = np.zeros(final.capacity, np.int32)
+            valid = np.zeros(final.capacity, bool)
+            for row, v in zip(idx, joined):
+                if v is not None:
+                    codes[row] = code_of[v]
+                    valid[row] = True
+            cols[k + j] = Column(
+                data=jnp.asarray(codes),
+                valid=jnp.asarray(valid) & final.mask,
+            )
+        return Batch(cols=tuple(cols), mask=final.mask)
 
     def _merge_down(self) -> Batch:
         cap = _spool_cap(self._tiles)
@@ -614,7 +727,10 @@ class AggregateOp(OneInputOperator):
         self._tiles = []
         if self.mode == "partial":
             return acc
-        return self._finalize_fn(acc)
+        out = self._finalize_fn(acc)
+        if self._sagg:
+            out = self._attach_saggs(out)
+        return out
 
 
 class ScalarAggregateOp(OneInputOperator):

@@ -1,0 +1,30 @@
+"""Chunked record persistence — blobs across fixed-width engine values.
+
+One shared discipline for every system record that can outgrow a single
+engine value (table descriptors, job records, table statistics): the blob
+is prefixed with an 8-hex-char length header and split into
+value-width-sized chunks under consecutive chunk keys. The header makes
+STALE TRAILING CHUNKS harmless: a shorter rewrite leaves the old tail in
+place, and readers truncate to the declared length instead of choking on
+extra bytes (the bug class this module exists to kill — a 13-column
+descriptor shrunk by DROP COLUMN used to corrupt catalog bootstrap).
+"""
+
+from __future__ import annotations
+
+_HEADER = 8  # ascii hex length prefix
+
+
+def chunk_blob(blob: bytes, step: int) -> list[bytes]:
+    """Split header+blob into <=step-sized chunks (at least one)."""
+    assert step > _HEADER, f"chunk step {step} too small"
+    b = b"%08x" % len(blob) + blob
+    return [b[i:i + step] for i in range(0, len(b), step)] or [b]
+
+
+def unchunk(values: list[bytes]) -> bytes:
+    """Reassemble chunks (in key order) -> original blob, ignoring any
+    stale tail bytes past the declared length."""
+    b = b"".join(values)
+    total = int(b[:_HEADER], 16)
+    return b[_HEADER:_HEADER + total]

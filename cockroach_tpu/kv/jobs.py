@@ -69,43 +69,76 @@ class Registry:
         self._resumers[job_type] = resume_fn
 
     # -- record persistence --------------------------------------------------
+    #
+    # Records CHUNK across engine values via the shared kv/chunked.py
+    # discipline (descriptors and table stats use it too): payloads like a
+    # schema change's column definition outgrow one fixed-width value.
+    # Legacy single-value records (pre-chunking stores: a dot-less key)
+    # remain readable so restored checkpoints keep their job history.
 
     @staticmethod
-    def _key(job_id: int) -> bytes:
-        return _PREFIX + b"%08d" % job_id
+    def _chunk_key(job_id: int, chunk: int) -> bytes:
+        assert chunk < 100
+        return _PREFIX + b"%08d.%02d" % (job_id, chunk)
 
     def _write(self, t, job: Job) -> None:
+        from .chunked import chunk_blob
+
         rec = {
             "type": job.job_type, "state": job.state,
             "payload": job.payload, "progress": job.progress,
         }
-        # compact encoding: records live in fixed-width engine values
         if job.error:
             rec["error"] = job.error
         if job.claim_node:
             rec["claim_node"] = job.claim_node
             rec["claim_epoch"] = job.claim_epoch
-        t.put(self._key(job.job_id),
-              json.dumps(rec, separators=(",", ":")).encode("utf-8"))
+        blob = json.dumps(rec, separators=(",", ":")).encode("utf-8")
+        step = max(16, self.db.engine.val_width)
+        for ci, piece in enumerate(chunk_blob(blob, step)):
+            t.put(self._chunk_key(job.job_id, ci), piece)
 
     @staticmethod
-    def _from_record(job_id: int, v: bytes) -> Job:
-        d = json.loads(v.decode("utf-8"))
+    def _parse(job_id: int, blob: bytes) -> Job:
+        d = json.loads(blob.decode("utf-8"))
         return Job(job_id, d["type"], d["state"], d["payload"],
                    d["progress"], d.get("error", ""),
                    d.get("claim_node", 0), d.get("claim_epoch", 0))
 
+    @classmethod
+    def _from_chunks(cls, job_id: int,
+                     chunks: list[tuple[bytes, bytes]]) -> Job:
+        from .chunked import unchunk
+
+        return cls._parse(job_id, unchunk([v for _, v in sorted(chunks)]))
+
     def load(self, job_id: int) -> Job | None:
-        v = self.db.get(self._key(job_id))
-        if v is None:
-            return None
-        return self._from_record(job_id, v)
+        lo = self._chunk_key(job_id, 0)
+        hi = _PREFIX + b"%08d.\xff" % job_id
+        rows = self.db.scan(lo, hi)
+        if rows:
+            return self._from_chunks(job_id, rows)
+        legacy = self.db.get(_PREFIX + b"%08d" % job_id)
+        if legacy is not None:
+            return self._parse(job_id, legacy)
+        return None
 
     def jobs(self) -> list[Job]:
-        return [
-            self._from_record(int(k[len(_PREFIX):]), v)
-            for k, v in self.db.scan(_PREFIX, _PREFIX + b"\xff")
-        ]
+        by_id: dict[int, list[tuple[bytes, bytes]]] = {}
+        legacy: dict[int, bytes] = {}
+        for k, v in self.db.scan(_PREFIX, _PREFIX + b"\xff"):
+            tail = k[len(_PREFIX):]
+            if b"." in tail:
+                jid = int(tail.split(b".")[0])
+                by_id.setdefault(jid, []).append((k, v))
+            else:
+                legacy[int(tail)] = v  # pre-chunking single-value record
+        out = {jid: self._from_chunks(jid, chunks)
+               for jid, chunks in by_id.items()}
+        for jid, v in legacy.items():
+            # a chunked rewrite of the same job supersedes the legacy row
+            out.setdefault(jid, self._parse(jid, v))
+        return [out[jid] for jid in sorted(out)]
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -124,7 +157,7 @@ class Registry:
                 # the existing records' max id
                 top = 0
                 for k, _ in t.scan(_PREFIX, _PREFIX + b"\xff"):
-                    top = max(top, int(k[len(_PREFIX):]))
+                    top = max(top, int(k[len(_PREFIX):].split(b".")[0]))
             t.put(_SEQ_KEY, b"%d" % (top + 1))
             job = Job(top + 1, job_type, "pending", payload, {})
             self._write(t, job)
@@ -211,10 +244,17 @@ class Registry:
         my_epoch = self._my_epoch()
 
         def op(t):
-            v = t.get(self._key(job_id))
-            if v is None:
-                return None
-            cur = self._from_record(job_id, v)
+            # read through the txn so the chunk span lands in the read
+            # spans (claim races conflict at commit)
+            rows = t.scan(self._chunk_key(job_id, 0),
+                          _PREFIX + b"%08d.\xff" % job_id)
+            if rows:
+                cur = self._from_chunks(job_id, rows)
+            else:
+                legacy = t.get(_PREFIX + b"%08d" % job_id)
+                if legacy is None:
+                    return None
+                cur = self._parse(job_id, legacy)  # rewrite claims chunked
             if cur.state in ("succeeded", "failed"):
                 return cur
             if ((cur.claim_node, cur.claim_epoch)

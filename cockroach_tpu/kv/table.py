@@ -454,11 +454,14 @@ def write_descriptor(db: DB, t: KVTable) -> None:
         "table_id": t.table_id,
         "dict_table_id": t.dict_table_id,
     }
+    from .chunked import chunk_blob
+
     blob = json.dumps(desc).encode("utf-8")
-    step = max(1, db.engine.val_width - 1)
-    for ci in range(0, (len(blob) + step - 1) // step):
-        db.put(_descriptor_key(t.table_id, ci),
-               blob[ci * step:(ci + 1) * step])
+    step = max(16, db.engine.val_width - 1)
+    # length-headered chunks: a SHORTER rewrite (DROP COLUMN) leaves the
+    # old tail chunks in place and readers truncate past them
+    for ci, piece in enumerate(chunk_blob(blob, step)):
+        db.put(_descriptor_key(t.table_id, ci), piece)
 
 
 def load_catalog_from_engine(catalog, db: DB) -> list[str]:
@@ -474,10 +477,12 @@ def load_catalog_from_engine(catalog, db: DB) -> list[str]:
     for k, v in db.scan(_DESC_PREFIX, _DESC_PREFIX + b"\xff"):
         tid = k[len(_DESC_PREFIX):].split(b"|")[0]
         blobs.setdefault(tid, []).append((k, v))
+    from .chunked import unchunk
+
     out = []
     for tid in sorted(blobs):
-        chunks = b"".join(v for _, v in sorted(blobs[tid]))
-        desc = json.loads(chunks.decode("utf-8"))
+        blob = unchunk([v for _, v in sorted(blobs[tid])])
+        desc = json.loads(blob.decode("utf-8"))
         types = tuple(
             SQLType(F[d["family"]], width=d["width"],
                     precision=d["precision"], scale=d["scale"])

@@ -41,11 +41,12 @@ from . import segscan
 @dataclass(frozen=True)
 class AggSpec:
     # sum | count | count_rows | min | max | avg | any_not_null
-    # | bool_and | bool_or
+    # | bool_and | bool_or | string_agg
     # | var | stddev | var_pop | stddev_pop | sum_sq (internal state)
     func: str
     col: int | None = None  # input column index (None for count_rows)
     name: str = ""
+    sep: str = ","  # string_agg separator (ignored by every other func)
 
 
 # statistical aggregates decompose into (sum, sum of squares, count) states
@@ -59,6 +60,10 @@ def agg_output_type(spec: AggSpec, schema: Schema) -> SQLType:
         return INT64
     if spec.func in ("bool_and", "bool_or"):
         return BOOL
+    if spec.func == "string_agg":
+        from ..coldata.types import STRING
+
+        return STRING
     if spec.func in ("avg",) + STAT_FUNCS or spec.func == "sum_sq":
         return FLOAT64
     t = schema.types[spec.col]

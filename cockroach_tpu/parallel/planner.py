@@ -530,6 +530,25 @@ class _Lowering:
         return _LNode(emit, out_schema, dicts, ln.replicated, ln.cap)
 
 
+def _needs_local(plan) -> bool:
+    """True when the plan contains a construct the SPMD lowering cannot
+    express (today: string_agg's host-side concatenation)."""
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        aggs = getattr(n, "aggs", None)
+        if aggs and any(getattr(s, "func", "") == "string_agg"
+                        for s in aggs):
+            return True
+        for f in getattr(n, "__dataclass_fields__", {}):
+            v = getattr(n, f)
+            if isinstance(v, S.PlanNode):
+                stack.append(v)
+            elif isinstance(v, tuple):
+                stack.extend(x for x in v if isinstance(x, S.PlanNode))
+    return False
+
+
 class DistributedQuery:
     """One distributed query: plan rewrite + SPMD lowering + retry loop.
 
@@ -542,6 +561,15 @@ class DistributedQuery:
         self.catalog = catalog
         self.mesh = mesh
         self.D = mesh.shape[AXIS]
+        # unsupported-for-distribution constructs fall back to local
+        # operator execution — the reference's checkSupportForPlanNode
+        # discipline (distsql_physical_planner.go:541): distribute what we
+        # can, never fail a query for being non-distributable
+        self._local_fallback = _needs_local(plan)
+        if self._local_fallback:
+            self.plan = plan
+            self.dplan = plan  # explain() shows the (local) plan
+            return
         self.dplan = plan if already_distributed else distribute(
             plan, catalog, broadcast_rows
         )
@@ -634,6 +662,12 @@ class DistributedQuery:
     def run(self) -> dict[str, np.ndarray]:
         from ..utils.errors import query_boundary
 
+        if self._local_fallback:
+            from ..flow.runtime import run_operator
+            from ..plan import builder as plan_builder
+
+            return run_operator(plan_builder.build(self.plan, self.catalog))
+
         @query_boundary("distributed flow")
         def _go():
             out, schema, dicts = self.run_batch()
@@ -644,4 +678,8 @@ class DistributedQuery:
     def explain(self) -> str:
         from ..plan.explain import explain_plan
 
+        if self._local_fallback:
+            # checkSupportForPlanNode said no: the plan runs locally
+            return ("distribution: local (plan not distributable)\n"
+                    + explain_plan(self.dplan))
         return explain_plan(self.dplan)

@@ -32,6 +32,16 @@ def _plan_dense_agg(child: Operator, group_cols, aggs):
     sizes, lows = [], []
     G = 1
     budget = settings.get("sql.distsql.dense_agg_states")
+    import jax
+
+    if jax.default_backend() != "cpu":
+        # scatters serialize on the TPU VPU: big-G dense states lose to
+        # sort+segscan there (q18's 6M-wide orderkey space is the prime
+        # suspect in its 4.0s-TPU vs 0.31s-CPU gap; .drive_q18ab.py A/Bs
+        # the two paths on the chip)
+        budget = min(
+            budget, settings.get("sql.distsql.dense_agg.accel_max_states")
+        )
     for gi in group_cols:
         t = child.output_schema.types[gi]
         if t.family is Family.STRING and gi in child.dictionaries:

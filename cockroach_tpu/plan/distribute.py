@@ -73,6 +73,8 @@ def _rewrite(plan, catalog, broadcast_rows):
         return type(plan)(child, *_rest_fields(plan)), rep
 
     if isinstance(plan, S.Aggregate):
+        # (string_agg never reaches here: DistributedQuery._needs_local
+        # routes such plans to local operator execution before distribute)
         child, rep = _rewrite(plan.input, catalog, broadcast_rows)
         if plan.key_sizes is not None:
             # dense-state path: positionally-aligned [G] states merge with

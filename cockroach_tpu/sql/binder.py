@@ -32,7 +32,7 @@ from .rel import Rel
 
 AGG_FUNCS = {"sum", "avg", "min", "max", "count", "stddev", "stddev_samp",
              "stddev_pop", "variance", "var_samp", "var_pop",
-             "bool_and", "bool_or", "every"}
+             "bool_and", "bool_or", "every", "string_agg"}
 
 # SQL spellings -> kernel aggregate names (sample variants are the defaults,
 # matching CockroachDB/Postgres; EVERY is the standard spelling of bool_and)
@@ -1320,6 +1320,22 @@ class Binder:
                     continue
                 in_name = f"{name}_in"
                 pre.append((in_name, lower.lower(fc.args[0])))
+                if func == "string_agg":
+                    if not group_items:
+                        raise BindError(
+                            "string_agg without GROUP BY is not supported"
+                        )
+                    sep = ","
+                    if len(fc.args) > 1:
+                        a = fc.args[1]
+                        if not isinstance(a, P.StrLit):
+                            raise BindError(
+                                "string_agg separator must be a string "
+                                "literal"
+                            )
+                        sep = a.value
+                    agg_specs.append((name, func, in_name, sep))
+                    continue
                 agg_specs.append((name, func, in_name))
             rel2 = rel.project(pre)
         if group_items:

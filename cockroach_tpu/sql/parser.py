@@ -39,7 +39,7 @@ KEYWORDS = {
     "right", "outer", "on", "asc", "desc", "distinct", "all", "union",
     "substring", "for", "true", "false", "any", "some", "with",
     "create", "table", "primary", "key", "insert", "into", "values",
-    "update", "set", "delete", "default",
+    "update", "set", "delete", "default", "alter", "add", "column", "drop",
 }
 
 
@@ -260,6 +260,18 @@ class CreateTable(Node):
 
 
 @dataclass(frozen=True)
+class AlterTable(Node):
+    """ALTER TABLE <name> ADD COLUMN <def> [DEFAULT <lit>] | DROP COLUMN
+    <col>. Reference grammar: sql.y alter_table_cmd."""
+
+    name: str
+    action: str  # "add" | "drop"
+    column: ColumnDef | None = None  # add
+    default: Node | None = None  # add: DEFAULT expression
+    drop_name: str | None = None  # drop
+
+
+@dataclass(frozen=True)
 class Insert(Node):
     table: str
     columns: tuple[str, ...] | None  # None = all, in schema order
@@ -354,6 +366,8 @@ class Parser:
         UPDATE | DELETE. Reference grammar: pkg/sql/parser/sql.y."""
         if self.at_kw("create"):
             s = self.parse_create_table()
+        elif self.at_kw("alter"):
+            s = self.parse_alter_table()
         elif self.at_kw("insert"):
             s = self.parse_insert()
         elif self.at_kw("update"):
@@ -409,6 +423,40 @@ class Parser:
                 break
         self.expect_op(")")
         return CreateTable(name, tuple(cols))
+
+    def parse_alter_table(self) -> AlterTable:
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        name = self.next().value
+        if self.eat_kw("add"):
+            self.eat_kw("column")  # COLUMN is optional, like Postgres
+            cname = self.next().value
+            tname = self.next().value.lower()
+            prec = scale = None
+            if self.eat_op("("):
+                prec = int(self.next().value)
+                if self.eat_op(","):
+                    scale = int(self.next().value)
+                self.expect_op(")")
+            default = None
+            nnull = False
+            while True:
+                if self.eat_kw("default"):
+                    default = self.parse_expr()
+                elif self.eat_kw("not"):
+                    self.expect_kw("null")
+                    nnull = True
+                else:
+                    break
+            col = ColumnDef(cname, tname, prec, scale, False, nnull)
+            return AlterTable(name, "add", column=col, default=default)
+        if self.eat_kw("drop"):
+            self.eat_kw("column")
+            return AlterTable(name, "drop", drop_name=self.next().value)
+        t = self.peek()
+        raise SyntaxError(
+            f"expected ADD or DROP at {t.pos}: {t.value!r}"
+        )
 
     def parse_insert(self) -> Insert:
         self.expect_kw("insert")

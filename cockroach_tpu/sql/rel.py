@@ -149,12 +149,16 @@ class Rel:
         return self.project([(n, self.c(n)) for n in names])
 
     def groupby(self, by: list[str],
-                aggs: list[tuple[str, str, str | None]]) -> "Rel":
-        """aggs: (output name, func, input col name or None)."""
+                aggs: list[tuple]) -> "Rel":
+        """aggs: (output name, func, input col name or None) — string_agg
+        takes a 4th element, the separator."""
         gcols = tuple(self.idx(n) for n in by)
         specs = tuple(
-            agg_ops.AggSpec(f, None if cn is None else self.idx(cn), name)
-            for name, f, cn in aggs
+            agg_ops.AggSpec(
+                a[1], None if a[2] is None else self.idx(a[2]), a[0],
+                *((a[3],) if len(a) > 3 else ()),
+            )
+            for a in aggs
         )
         # dense-state path: all keys dictionary-coded with small product
         from ..utils import settings as _settings
@@ -180,7 +184,8 @@ class Rel:
         types = []
         for i in gcols:
             types.append(self.schema.types[i])
-        for name, f, cn in aggs:
+        for a in aggs:
+            name, f, cn = a[0], a[1], a[2]
             spec = agg_ops.AggSpec(f, None if cn is None else self.idx(cn), name)
             if f == "avg":
                 from ..coldata.types import FLOAT64
@@ -409,9 +414,15 @@ class Rel:
         """EXPLAIN of the distributed plan (Exchange/Broadcast/Gather
         stages visible). Pass the same broadcast_rows as run_distributed
         to see the plan that would actually execute."""
+        from ..parallel.planner import _needs_local
         from ..plan.distribute import distribute
         from ..plan.explain import explain_plan
 
+        if _needs_local(self.plan):
+            # run_distributed falls back to local execution for this plan
+            # (checkSupportForPlanNode discipline) — show that truth
+            return ("distribution: local (plan not distributable)\n"
+                    + explain_plan(self.plan))
         return explain_plan(
             distribute(self.plan, self.catalog, broadcast_rows)
         )

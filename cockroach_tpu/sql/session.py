@@ -137,6 +137,12 @@ class Session:
                     "DDL inside an explicit transaction is not supported"
                 )
             return self._create_table(stmt)
+        if isinstance(stmt, P.AlterTable):
+            if self._txn is not None:
+                raise BindError(
+                    "DDL inside an explicit transaction is not supported"
+                )
+            return self._alter_table(stmt)
         if isinstance(stmt, P.Insert):
             return self._insert(stmt)
         if isinstance(stmt, P.Update):
@@ -445,6 +451,23 @@ class Session:
             )
         create_kv_table(self.catalog, self.db, stmt.name, schema, pk=pks[0])
         return {"created": stmt.name}
+
+    def _alter_table(self, stmt: P.AlterTable):
+        """ALTER TABLE as a schema_change job: validate, create the job,
+        run the checkpointed backfill, swap the descriptor (the reference's
+        schema changes are jobs for exactly this crash-resume reason)."""
+        from .schemachange import plan_alter, register_schema_change_job
+
+        payload = plan_alter(self.catalog, self.db, stmt)
+        reg = self._jobs_registry()
+        register_schema_change_job(reg, self.catalog)
+        job = reg.create("schema_change", payload)
+        done = reg.adopt_and_resume(job.job_id)
+        if done.state != "succeeded":
+            raise BindError(
+                f"schema change failed: {done.error or done.state}"
+            )
+        return {"altered": stmt.name, "job_id": done.job_id}
 
     # -- DML -----------------------------------------------------------------
 

@@ -136,19 +136,20 @@ def _stats_key(table_id: int, chunk: int) -> bytes:
 
 
 def save_kv_stats(db, table_id: int, st: TableStats) -> None:
+    from ..kv.chunked import chunk_blob
+
     blob = st.to_json().encode("utf-8")
-    step = max(1, db.engine.val_width - 1)
-    # clear any longer previous version before writing the new chunks
-    for k, _ in db.scan(_stats_key(table_id, 0),
-                        _stats_key(table_id, 9999)):
-        db.delete(k)
-    for ci in range(0, (len(blob) + step - 1) // step):
-        db.put(_stats_key(table_id, ci), blob[ci * step:(ci + 1) * step])
+    step = max(16, db.engine.val_width - 1)
+    # length-headered chunks (kv/chunked.py): stale tail chunks from a
+    # longer previous version are ignored on read — no delete pass needed
+    for ci, piece in enumerate(chunk_blob(blob, step)):
+        db.put(_stats_key(table_id, ci), piece)
 
 
 def load_kv_stats(db, table_id: int) -> TableStats | None:
+    from ..kv.chunked import unchunk
+
     rows = db.scan(_stats_key(table_id, 0), _stats_key(table_id, 9999))
     if not rows:
         return None
-    blob = b"".join(v for _, v in rows)
-    return TableStats.from_json(blob.decode("utf-8"))
+    return TableStats.from_json(unchunk([v for _, v in rows]).decode("utf-8"))

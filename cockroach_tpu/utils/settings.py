@@ -247,6 +247,14 @@ DENSE_AGG_STATES = register_int(
     "general sort-groupby path",
     lo=64, hi=1 << 28,
 )
+DENSE_AGG_ACCEL_STATES = register_int(
+    "sql.distsql.dense_agg.accel_max_states", 1 << 19,
+    "tighter dense-state budget on accelerator backends: XLA:TPU scatters "
+    "serialize on the VPU (~100ms per 1M-row segment op, measured), so "
+    "big-G dense aggregation loses to the sort+segmented-scan path there "
+    "while staying the right choice on CPU (cheap serial scatters)",
+    lo=64, hi=1 << 28,
+)
 COLLECT_STATS = register_bool(
     "sql.stats.collect_execution_stats", False,
     "collect per-operator ComponentStats on every query; stats are recorded "
