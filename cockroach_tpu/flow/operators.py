@@ -529,10 +529,21 @@ class AggregateOp(OneInputOperator):
             self.col_stats.setdefault(pos, (0, max(0, len(d) - 1)))
             self.key_stats.setdefault(pos, (0, max(0, len(d) - 1)))
         # string_agg outputs get an empty Dictionary NOW (parents copy the
-        # reference at construction) and fill it in place at finalize
+        # reference at construction) and fill it in place at finalize.
+        # _runtime marks it: consumers whose PLAN depends on dictionary
+        # contents (sort ranks, dense-agg sizing) must refuse it — at init
+        # time it is still empty and would silently produce garbage
         for j, _ in self._sagg:
-            self.dictionaries[len(group_cols) + j] = Dictionary(
-                np.array([], dtype=object))
+            d = Dictionary(np.array([], dtype=object))
+            d._runtime = True
+            self.dictionaries[len(group_cols) + j] = d
+        # conversely, grouping BY a runtime-filled string column cannot
+        # work: the group codes would be computed against an empty dict
+        for gi in group_cols:
+            if getattr(self.child.dictionaries.get(gi), "_runtime", False):
+                raise ValueError(
+                    "grouping by a string_agg result is not supported"
+                )
         self._acc = None
         self._emitted = False
 
@@ -800,6 +811,14 @@ class SortOp(OneInputOperator):
             for k in self.keys
             if k.col in self.child.dictionaries
         }
+        for k in self.keys:
+            if getattr(self.child.dictionaries.get(k.col), "_runtime",
+                       False):
+                # the dict fills at the child's finalize — its ranks here
+                # are empty and would sort garbage
+                raise ValueError(
+                    "ORDER BY a string_agg result is not supported"
+                )
         schema = self.output_schema
         keys = self.keys
         col_stats = dict(self.child.col_stats)

@@ -24,7 +24,15 @@ def chunk_blob(blob: bytes, step: int) -> list[bytes]:
 
 def unchunk(values: list[bytes]) -> bytes:
     """Reassemble chunks (in key order) -> original blob, ignoring any
-    stale tail bytes past the declared length."""
+    stale tail bytes past the declared length.
+
+    Legacy records (written before the header existed) reassemble as the
+    raw concatenation: every caller stores JSON, whose first byte ('{')
+    can never appear in the hex header, so the formats self-discriminate
+    — a checkpoint from an older build stays restorable."""
     b = b"".join(values)
-    total = int(b[:_HEADER], 16)
-    return b[_HEADER:_HEADER + total]
+    head = b[:_HEADER]
+    if len(head) == _HEADER and all(c in b"0123456789abcdef" for c in head):
+        total = int(head, 16)
+        return b[_HEADER:_HEADER + total]
+    return b  # pre-header legacy record

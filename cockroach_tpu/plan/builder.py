@@ -45,6 +45,8 @@ def _plan_dense_agg(child: Operator, group_cols, aggs):
     for gi in group_cols:
         t = child.output_schema.types[gi]
         if t.family is Family.STRING and gi in child.dictionaries:
+            if getattr(child.dictionaries[gi], "_runtime", False):
+                return None  # fills at runtime: size unknown at plan time
             size, lo = len(child.dictionaries[gi]), 0
         elif t.family in (Family.FLOAT, Family.BYTES, Family.JSON,
                           Family.STRING):

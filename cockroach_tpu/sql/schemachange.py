@@ -133,8 +133,18 @@ def _schemas_for(catalog, payload):
 
 
 def backfill(reg, job, catalog) -> None:
-    """The schema_change resumer: chunked rewrite + checkpoint + swap."""
+    """The schema_change resumer: chunked rewrite + checkpoint + swap.
+
+    Crash-idempotence: a resume AFTER the descriptor already swapped must
+    not derive schemas from the post-swap descriptor (it would re-apply
+    the change on top of itself) — the catalog's current column set tells
+    us the swap completed, so the resume just finishes."""
     payload = job.payload
+    cur_names = catalog.tables[payload["table"]].schema.names
+    done = (payload["col"] in cur_names if payload["action"] == "add"
+            else payload["col"] not in cur_names)
+    if done:
+        return
     old, new, tbl = _schemas_for(catalog, payload)
     old_w = rowcodec.value_width(old)
     db = reg.db
@@ -178,15 +188,22 @@ def backfill(reg, job, catalog) -> None:
         last_pk = db.txn(rewrite)
         job.progress["last_pk"] = int(last_pk)
         reg.checkpoint(job)
-    _swap_descriptor(catalog, db, tbl, new, payload)
+    _swap_descriptor(catalog, db, tbl, new, payload, reg=reg, job=job)
 
 
-def _remap_dict_span(db, tbl, new_schema) -> None:
+def _remap_dict_span(db, tbl, new_schema, reg=None, job=None) -> None:
     """The persistent string dictionaries key on COLUMN POSITION
     ((col << 40) | code, kv/table.py): a drop that shifts later STRING
     columns left must rewrite their entries to the new positions, and a
-    dropped STRING column's entries are deleted."""
+    dropped STRING column's entries are deleted.
+
+    NOT re-runnable (a second pass would treat already-moved entries as
+    the dropped column's and delete them), so the job's remapped flag
+    commits IN THE SAME TXN as the moves: a crash either left everything
+    unmoved (flag clear, safe to run) or moved+flagged (skipped)."""
     if tbl.dict_table_id is None:
+        return
+    if job is not None and job.progress.get("dict_remapped"):
         return
     old_pos = {n: i for i, n in enumerate(tbl.schema.names)}
     new_pos = {n: i for i, n in enumerate(new_schema.names)}
@@ -211,16 +228,20 @@ def _remap_dict_span(db, tbl, new_schema) -> None:
             if dst is not None:
                 t.put(rowcodec.encode_pk(tbl.dict_table_id,
                                          (dst << 40) | code), v)
+        if job is not None:
+            job.progress["dict_remapped"] = True
+            reg._write(t, job)
 
     db.txn(rewrite)
 
 
-def _swap_descriptor(catalog, db, tbl, new_schema, payload) -> None:
+def _swap_descriptor(catalog, db, tbl, new_schema, payload,
+                     reg=None, job=None) -> None:
     """Install the new schema: fresh KVTable over the same spans, persist
     the descriptor, replace the catalog entry (descriptor-version bump)."""
     from ..kv.table import KVTable, write_descriptor
 
-    _remap_dict_span(db, tbl, new_schema)
+    _remap_dict_span(db, tbl, new_schema, reg=reg, job=job)
     # an added STRING column's dict id was allocated at plan time (the
     # backfill already wrote entries into that span)
     dict_id = payload.get("dict_table_id", tbl.dict_table_id)

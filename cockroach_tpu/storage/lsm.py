@@ -523,16 +523,27 @@ class Engine:
         for i, v in enumerate(self.mem.value):
             vals[i, : len(v)] = np.frombuffer(v, dtype=np.uint8)
             vlen[i] = len(v)
-        blk = mvcc.sort_block(mvcc.block_from_host(
-            keys,
-            np.asarray(self.mem.ts),
-            np.asarray(self.mem.txn),
-            np.asarray(self.mem.tomb),
-            vals,
-            vlen,
+        # sort on the HOST (canonical MVCC order: key asc, ts desc, seq
+        # desc — _mvcc_sort_operands' ordering): a memtable is <=
+        # memtable_size rows, so np.lexsort costs microseconds while the
+        # device sort_block this replaces charged a ~10-20ms XLA sort to
+        # EVERY scan batch that followed an insert (write-then-read
+        # workloads pay one rebuild per batch)
+        ts_arr = np.asarray(self.mem.ts, np.int64)
+        seq_arr = np.asarray(self.mem.seq, np.int64)
+        void_keys = np.ascontiguousarray(keys).view(
+            f"V{self.key_width}").reshape(-1)
+        order = np.lexsort((-seq_arr, -ts_arr, void_keys))
+        blk = mvcc.block_from_host(
+            keys[order],
+            ts_arr[order],
+            np.asarray(self.mem.txn)[order],
+            np.asarray(self.mem.tomb)[order],
+            vals[order],
+            vlen[order],
             cap=_pad(n),
-            seq=np.asarray(self.mem.seq),
-        ))
+            seq=seq_arr[order],
+        )
         self._mem_cache = (n, blk)
         return blk
 
@@ -914,11 +925,14 @@ class Engine:
         B = len(enc)
         max_cap = max(s.capacity for s in sources)
         # sticky converged window (keyed by max_keys): version-dense key
-        # ranges force window growth past 4*max_keys, and re-learning the
-        # growth by retrying EVERY batch would pay the whole ladder of
-        # extra device passes per call
+        # ranges force window growth past the initial 2*max_keys, and
+        # re-learning the growth by retrying EVERY batch would pay the
+        # whole ladder of extra device passes per call. 2x (not 4x): the
+        # common case is ~1 visible version per key, and halving the
+        # window halves every per-batch gather/merge/filter pass; dense
+        # histories converge via the sticky growth after one retry
         window = self._scan_windows.get(
-            max_keys, _pad(max(16, 4 * max_keys), _CAND_ALIGN)
+            max_keys, _pad(max(16, 2 * max_keys), _CAND_ALIGN)
         )
         while True:
             win, sel, conflict, complete, truncated = (

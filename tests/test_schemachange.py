@@ -138,3 +138,69 @@ def test_add_string_column_with_default():
     sess.execute("alter table st add column note string")
     got = sess.execute("select count(note) as n from st")
     assert int(got["n"][0]) == 0
+
+
+def test_drop_resume_does_not_corrupt_surviving_string_dict():
+    """Crash between the dict-span remap and the descriptor swap: the
+    resume must NOT re-run the remap (it would delete the already-moved
+    entries of the surviving string column)."""
+    from cockroach_tpu.sql import schemachange as sc_mod
+    from cockroach_tpu.sql.parser import parse_statement
+    from cockroach_tpu.sql.schemachange import register_schema_change_job
+
+    sess = Session()
+    sess.execute(
+        "create table dm (id int primary key, a string, b string)")
+    sess.execute("insert into dm values (1, 'x', 'p'), (2, 'y', 'q')")
+    reg = sess._jobs_registry()
+    register_schema_change_job(reg, sess.catalog)
+    payload = sc_mod.plan_alter(
+        sess.catalog, sess.db,
+        parse_statement("alter table dm drop column a"))
+    job = reg.create("schema_change", payload)
+    claimed = reg._claim(job.job_id, reg.load(job.job_id))
+
+    # crash INSIDE _swap_descriptor right after the remap txn committed
+    class Boom(Exception):
+        pass
+
+    import cockroach_tpu.kv.table as table_mod
+
+    real_wd = table_mod.write_descriptor
+    try:
+        def crashing_wd(db, t):
+            raise Boom
+
+        table_mod.write_descriptor = crashing_wd
+        try:
+            sc_mod.backfill(reg, claimed, sess.catalog)
+            raise AssertionError("expected injected crash")
+        except Boom:
+            pass
+    finally:
+        table_mod.write_descriptor = real_wd
+    # remap committed + flagged; resume completes without re-remapping
+    assert reg.load(job.job_id).progress.get("dict_remapped") is True
+    done = reg.adopt_and_resume(job.job_id)
+    assert done.state == "succeeded"
+    got = sess.execute("select b from dm order by id")
+    assert list(got["b"]) == ["p", "q"]  # survivor's dictionary intact
+
+
+def test_string_agg_downstream_guards():
+    """Consumers whose plan depends on a string_agg output's dictionary
+    refuse loudly instead of silently sorting/grouping garbage."""
+    sess = Session()
+    sess.execute("create table gg (id int primary key, g int, s string)")
+    sess.execute("insert into gg values (1, 1, 'a'), (2, 1, 'b'), (3, 2, 'c')")
+    # plain string_agg works
+    got = sess.execute(
+        "select g, string_agg(s, ',') as x from gg group by g")
+    assert sorted(got["x"]) == ["a,b", "c"]
+    try:
+        sess.execute(
+            "select g, string_agg(s, ',') as x from gg group by g "
+            "order by x")
+        raise AssertionError("expected ORDER BY string_agg to be refused")
+    except Exception as e:  # noqa: BLE001
+        assert "string_agg" in str(e)
