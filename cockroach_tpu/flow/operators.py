@@ -939,6 +939,14 @@ class HashJoinOp(OneInputOperator):
             if pt.family is Family.STRING:
                 pd = probe.dictionaries[pk]
                 bd = build.dictionaries[bk]
+                if (getattr(pd, "_runtime", False)
+                        or getattr(bd, "_runtime", False)):
+                    # its hashes/values fill at the child's finalize —
+                    # captured here they are empty and every probe misses
+                    raise ValueError(
+                        "joining on a string_agg result is not supported "
+                        "(its dictionary fills at runtime)"
+                    )
                 self.probe_hash_tables[pk] = pd.hashes
                 self.build_hash_tables[bk] = bd.hashes
                 self.build_code_remaps[pos] = np.array(
@@ -1461,6 +1469,12 @@ class WindowOp(OneInputOperator):
             sp.col for sp in self.specs
             if sp.col is not None and sp.func in ("min", "max")
         )
+        for c in need:
+            if getattr(self.child.dictionaries.get(c), "_runtime", False):
+                raise ValueError(
+                    "window functions over a string_agg result are not "
+                    "supported (its dictionary fills at runtime)"
+                )
         rank_tables = {
             c: self.child.dictionaries[c].ranks
             for c in need

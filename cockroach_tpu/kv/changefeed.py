@@ -18,6 +18,7 @@ the same loop over the engine's retained MVCC versions:
 
 from __future__ import annotations
 
+import base64
 import json
 
 import numpy as np
@@ -29,7 +30,8 @@ from .txn import DB
 
 def changes_between(db: DB, lo_ts: int, hi_ts: int,
                     start: bytes | None = None,
-                    end: bytes | None = None) -> tuple[list[dict], int]:
+                    end: bytes | None = None,
+                    raw: bool = False) -> tuple[list[dict], int]:
     """Committed versions with lo_ts < ts <= RESOLVED in [start, end),
     ordered by (ts, key), plus the RESOLVED frontier itself — the catch-up
     scan with the closed-timestamp discipline (kvserver/closedts): the
@@ -89,14 +91,27 @@ def changes_between(db: DB, lo_ts: int, hi_ts: int,
     tombs = np.asarray(view.tomb)[idx]
     out = []
     for k, v, n, tomb, t in zip(keys, vals, vlens, tombs, ts[idx]):
-        out.append({
-            "key": k.decode("utf-8", "replace"),
-            "value": None if tomb else bytes(v[:n]).decode("utf-8",
-                                                           "replace"),
-            "ts": int(t),
-        })
-    out.sort(key=lambda e: (e["ts"], e["key"]))
-    return out, resolved
+        if raw:
+            # byte-exact encoding (base64): physical replication must
+            # reproduce keys/values verbatim, not a lossy utf-8 view
+            ev = {
+                "k64": base64.b64encode(k).decode("ascii"),
+                "v64": (None if tomb
+                        else base64.b64encode(bytes(v[:n])).decode("ascii")),
+                "ts": int(t),
+            }
+        else:
+            ev = {
+                "key": k.decode("utf-8", "replace"),
+                "value": None if tomb else bytes(v[:n]).decode("utf-8",
+                                                               "replace"),
+                "ts": int(t),
+            }
+        # sort on the ORIGINAL key bytes (base64's ascii order does not
+        # preserve byte order, and a b"" key is falsy)
+        out.append((int(t), bytes(k), ev))
+    out.sort(key=lambda e: e[:2])
+    return [ev for _, _, ev in out], resolved
 
 
 class FileSink:
@@ -199,11 +214,12 @@ class RangefeedServer:
         s = start.encode() if isinstance(start, str) else start
         e = end.encode() if isinstance(end, str) else end
         resolved = int(req.get("since", 0))
+        raw = bool(req.get("raw", False))
         try:
             while not self._stop.is_set():
                 now = self.db.clock.now()
                 events, new_resolved = changes_between(
-                    self.db, resolved, now, s, e)
+                    self.db, resolved, now, s, e, raw=raw)
                 for ev in events:
                     _send_msg(conn, json.dumps(ev).encode("utf-8"))
                 resolved = max(resolved, new_resolved)  # never regress
@@ -220,9 +236,11 @@ class RangefeedServer:
         self._srv.close()
 
 
-def subscribe_rangefeed(addr, start=None, end=None, since: int = 0):
+def subscribe_rangefeed(addr, start=None, end=None, since: int = 0,
+                        raw: bool = False):
     """Dial a RangefeedServer; returns (socket, iterator of frames).
-    Frames are events ({key, value, ts}) or checkpoints ({resolved})."""
+    Frames are events ({key, value, ts} — or byte-exact {k64, v64, ts}
+    with raw=True) or checkpoints ({resolved})."""
     import socket
 
     from ..flow.dcn import _recv_msg, _send_msg
@@ -232,6 +250,7 @@ def subscribe_rangefeed(addr, start=None, end=None, since: int = 0):
         "start": start.decode() if isinstance(start, bytes) else start,
         "end": end.decode() if isinstance(end, bytes) else end,
         "since": since,
+        "raw": raw,
     }).encode("utf-8"))
 
     def frames():

@@ -312,7 +312,11 @@ def register_builtin_jobs(registry: Registry) -> None:
     durable record -> run -> terminal state, resumable by re-adoption."""
 
     def backup_resume(reg: Registry, job: Job):
-        path = job.payload["path"]
+        from ..utils.external_storage import resolve_dir_uri
+
+        # URI destinations (nodelocal://, file://; cloud schemes fail
+        # with configuration guidance) — pkg/cloud ExternalStorage role
+        path = resolve_dir_uri(job.payload["path"])
         reg.db.engine.checkpoint(path)
         return {"path": path}
 

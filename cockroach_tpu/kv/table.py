@@ -435,11 +435,13 @@ def _descriptor_key(table_id: int, chunk: int) -> bytes:
     return _DESC_PREFIX + b"%03d|%03d" % (table_id, chunk)
 
 
-def write_descriptor(db: DB, t: KVTable) -> None:
+def write_descriptor(db: DB, t: KVTable, writer=None) -> None:
     """Persist the table descriptor in the system keyspace (the
     system.descriptor discipline: schemas are data, so a fresh process over
     the same engine rediscovers every table). The JSON chunks across rows
-    so descriptors fit any engine value width."""
+    so descriptors fit any engine value width. `writer`: an open Txn so a
+    caller can make the swap atomic with other writes (schema changes
+    commit the descriptor and their completion marker together)."""
     import json
 
     desc = {
@@ -460,8 +462,9 @@ def write_descriptor(db: DB, t: KVTable) -> None:
     step = max(16, db.engine.val_width - 1)
     # length-headered chunks: a SHORTER rewrite (DROP COLUMN) leaves the
     # old tail chunks in place and readers truncate past them
+    w = writer if writer is not None else db
     for ci, piece in enumerate(chunk_blob(blob, step)):
-        db.put(_descriptor_key(t.table_id, ci), piece)
+        w.put(_descriptor_key(t.table_id, ci), piece)
 
 
 def load_catalog_from_engine(catalog, db: DB) -> list[str]:

@@ -137,14 +137,17 @@ def backfill(reg, job, catalog) -> None:
 
     Crash-idempotence: a resume AFTER the descriptor already swapped must
     not derive schemas from the post-swap descriptor (it would re-apply
-    the change on top of itself) — the catalog's current column set tells
-    us the swap completed, so the resume just finishes."""
+    the change on top of itself). Completion is a DURABLE progress flag
+    committed in the same txn as the descriptor swap — never inferred
+    from the catalog's column set, which a later user ALTER could have
+    changed back."""
     payload = job.payload
-    cur_names = catalog.tables[payload["table"]].schema.names
-    done = (payload["col"] in cur_names if payload["action"] == "add"
-            else payload["col"] not in cur_names)
-    if done:
+    durable = reg.load(job.job_id)
+    if durable is not None and durable.progress.get("swapped"):
+        job.progress.update(durable.progress)
         return
+    if durable is not None:
+        job.progress.update(durable.progress)  # fresh resume state
     old, new, tbl = _schemas_for(catalog, payload)
     old_w = rowcodec.value_width(old)
     db = reg.db
@@ -199,11 +202,12 @@ def _remap_dict_span(db, tbl, new_schema, reg=None, job=None) -> None:
 
     NOT re-runnable (a second pass would treat already-moved entries as
     the dropped column's and delete them), so the job's remapped flag
-    commits IN THE SAME TXN as the moves: a crash either left everything
-    unmoved (flag clear, safe to run) or moved+flagged (skipped)."""
+    commits IN THE SAME TXN as the moves — and that txn re-reads the
+    DURABLE job record (not the caller's in-memory copy) plus the
+    claimant's liveness epoch, so a fenced-out stale node that wakes
+    after its replacement finished cannot run the moves again (the
+    Registry.checkpoint fencing discipline)."""
     if tbl.dict_table_id is None:
-        return
-    if job is not None and job.progress.get("dict_remapped"):
         return
     old_pos = {n: i for i, n in enumerate(tbl.schema.names)}
     new_pos = {n: i for i, n in enumerate(new_schema.names)}
@@ -218,6 +222,10 @@ def _remap_dict_span(db, tbl, new_schema, reg=None, job=None) -> None:
     rows = db.scan(start, end)
 
     def rewrite(t):
+        if job is not None:
+            cur = _fenced_job_read(reg, job, t)
+            if cur.progress.get("dict_remapped"):
+                return
         for k, v in rows:
             pk = rowcodec.decode_pk(k)
             col, code = pk >> 40, pk & ((1 << 40) - 1)
@@ -235,6 +243,33 @@ def _remap_dict_span(db, tbl, new_schema, reg=None, job=None) -> None:
     db.txn(rewrite)
 
 
+def _fenced_job_read(reg, job, t):
+    """Read the DURABLE job record inside txn `t`, verifying this node
+    still owns the claim at its believed epoch (Registry.checkpoint's
+    fence, shared by every non-re-runnable schema-change txn)."""
+    from ..kv.jobs import _PREFIX
+
+    rows = t.scan(reg._chunk_key(job.job_id, 0),
+                  _PREFIX + b"%08d.\xff" % job.job_id)
+    cur = (reg._from_chunks(job.job_id, rows) if rows else job)
+    if (cur.claim_node, cur.claim_epoch) != (job.claim_node,
+                                             job.claim_epoch):
+        raise RuntimeError(
+            f"job {job.job_id} was re-adopted by node {cur.claim_node} "
+            f"(epoch {cur.claim_epoch}); this claimant is stale"
+        )
+    if reg.liveness is not None and job.claim_node == reg.node_id:
+        rec = reg.liveness._read(reg.node_id, t)
+        if rec is not None and rec.epoch != job.claim_epoch:
+            from ..kv.liveness import EpochFencedError
+
+            raise EpochFencedError(
+                f"node {reg.node_id} epoch {rec.epoch} != claim epoch "
+                f"{job.claim_epoch}"
+            )
+    return cur
+
+
 def _swap_descriptor(catalog, db, tbl, new_schema, payload,
                      reg=None, job=None) -> None:
     """Install the new schema: fresh KVTable over the same spans, persist
@@ -247,7 +282,20 @@ def _swap_descriptor(catalog, db, tbl, new_schema, payload,
     dict_id = payload.get("dict_table_id", tbl.dict_table_id)
     nt = KVTable(db, tbl.name, new_schema, pk=tbl.pk,
                  table_id=tbl.table_id, dict_table_id=dict_id)
-    write_descriptor(db, nt)
+
+    def swap(t):
+        if job is not None:
+            _fenced_job_read(reg, job, t)
+        # descriptor chunks + durable completion marker in ONE txn: a
+        # crash leaves either the old schema with no marker (resume
+        # re-runs safely) or the new schema with the marker (resume
+        # finishes immediately) — never the corrupting in-between
+        write_descriptor(db, nt, writer=t)
+        if job is not None:
+            job.progress["swapped"] = True
+            reg._write(t, job)
+
+    db.txn(swap)
     catalog.tables[tbl.name] = nt
 
 

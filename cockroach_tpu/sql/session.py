@@ -346,8 +346,9 @@ class Session:
         m = _re.match(r"(?is)^restore\s+from\s+'([^']+)'$", t)
         if m:
             from ..storage.lsm import Engine as _Engine
+            from ..utils.external_storage import resolve_dir_uri
 
-            eng = _Engine.open_checkpoint(m.group(1))
+            eng = _Engine.open_checkpoint(resolve_dir_uri(m.group(1)))
             self.db.engine = eng
             # schemas are data: rebuild the catalog from the restored
             # descriptors (tables created after the backup disappear;

@@ -168,7 +168,7 @@ def test_drop_resume_does_not_corrupt_surviving_string_dict():
 
     real_wd = table_mod.write_descriptor
     try:
-        def crashing_wd(db, t):
+        def crashing_wd(db, t, writer=None):
             raise Boom
 
         table_mod.write_descriptor = crashing_wd
