@@ -240,8 +240,10 @@ def run_distributed_hosts(plan: S.PlanNode, catalog, host_addrs: list):
         attach_stream(addr, flow_id, i, state_schema)
         for i, addr in enumerate(host_addrs)
     ]
-    union = ops.UnionOp(tuple(inboxes))
-    final = ops.AggregateOp(union, group_cols, aggs, mode="final",
+    # unordered fan-in with one puller thread per host: remote hosts
+    # stream concurrently instead of draining one at a time
+    sync = ops.ParallelUnorderedSyncOp(tuple(inboxes))
+    final = ops.AggregateOp(sync, group_cols, aggs, mode="final",
                             input_schema=base_schema)
     return run_operator(final)
 

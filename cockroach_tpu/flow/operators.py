@@ -1503,6 +1503,104 @@ class WindowOp(OneInputOperator):
         return self._fn(tuple(tiles), cap=_spool_cap(tiles))
 
 
+class ParallelUnorderedSyncOp(Operator):
+    """Unordered fan-in with one PULLER THREAD per input — the
+    ParallelUnorderedSynchronizer analog (colexec/parallel_unordered_
+    synchronizer.go:66): batches surface in arrival order through a
+    bounded queue, so inputs overlap their waits. Essential for remote
+    FlowInboxes (serial draining would serialize the hosts' compute and
+    network time); for local inputs it adds pipeline overlap at the cost
+    of thread handoff."""
+
+    _QUEUE_DEPTH = 4  # per-flow backpressure (bounded buffering)
+    _DONE = object()
+
+    def __init__(self, children_ops: tuple[Operator, ...]):
+        super().__init__()
+        assert children_ops, "fan-in needs at least one input"
+        self._children = list(children_ops)
+        self.output_schema = children_ops[0].output_schema
+        for c in children_ops[1:]:
+            assert len(c.output_schema) == len(self.output_schema), \
+                "fan-in inputs must have equal arity"
+        self.dictionaries = dict(children_ops[0].dictionaries)
+        self.col_stats = {}
+
+    def children(self):
+        return list(self._children)
+
+    def init(self):
+        import queue
+        import threading
+
+        # a re-init (run_operator's capacity-retry loop) must not leave
+        # the previous run's pullers racing the new ones on the children
+        self._shutdown_pullers()
+        for c in self._children:
+            c.init()
+        self._q = queue.Queue(
+            maxsize=self._QUEUE_DEPTH * len(self._children))
+        self._stop = threading.Event()
+        self._live = len(self._children)
+        self._threads = []
+        for c in self._children:
+            t = threading.Thread(target=self._pull, args=(c,),
+                                 name="unordered-sync", daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._initialized = True
+
+    def _pull(self, child: Operator) -> None:
+        try:
+            while not self._stop.is_set():
+                b = child.next_batch()
+                if b is None:
+                    break
+                self._q.put(b)
+        except BaseException as e:  # surface in the consumer, not a log
+            self._q.put(e)
+            return
+        self._q.put(self._DONE)
+
+    def _next(self):
+        while self._live > 0:
+            item = self._q.get()
+            if item is self._DONE:
+                self._live -= 1
+                continue
+            if isinstance(item, BaseException):
+                self._stop.set()
+                raise item
+            return item
+        return None
+
+    def _shutdown_pullers(self) -> None:
+        """Stop + join puller threads, draining the queue while joining so
+        a producer blocked in put() always gets space to observe stop."""
+        if not getattr(self, "_threads", None):
+            return
+        self._stop.set()
+        for t in self._threads:
+            while t.is_alive():
+                try:
+                    while True:
+                        self._q.get_nowait()
+                except Exception:
+                    pass
+                t.join(timeout=0.05)
+        self._threads = []
+
+    def close(self):
+        # children first: closing a remote FlowInbox closes its socket,
+        # which is the ONLY thing that unblocks a puller stuck in a
+        # timeout-less recv (the drain-while-join below only unblocks
+        # pullers stuck in q.put)
+        self._stop.set()
+        for c in self._children:
+            c.close()
+        self._shutdown_pullers()
+
+
 class UnionOp(Operator):
     """UNION ALL: pull each input to exhaustion in order (the plan-level
     unordered fan-in; inputs share one output schema)."""

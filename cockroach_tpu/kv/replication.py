@@ -37,6 +37,9 @@ class ReplicationStream:
         self._sock, self._frames = subscribe_rangefeed(
             src_addr, start=start, end=end, since=since, raw=True)
         self._thread: threading.Thread | None = None
+        # a failed apply must not vanish with the daemon thread: it parks
+        # here and re-raises at the next consumer interaction
+        self.error: BaseException | None = None
 
     # -- apply loop ----------------------------------------------------------
 
@@ -55,13 +58,18 @@ class ReplicationStream:
 
     def run(self) -> None:
         """Consume frames until stopped (or the source closes)."""
-        for frame in self._frames:
-            if self._stop.is_set():
-                return
-            if "resolved" in frame:
-                self.frontier = max(self.frontier, int(frame["resolved"]))
-            else:
-                self._apply(frame)
+        try:
+            for frame in self._frames:
+                if self._stop.is_set():
+                    return
+                if "resolved" in frame:
+                    self.frontier = max(self.frontier,
+                                        int(frame["resolved"]))
+                else:
+                    self._apply(frame)
+        except BaseException as e:
+            self.error = e
+            raise
 
     def run_background(self) -> "ReplicationStream":
         self._thread = threading.Thread(target=self.run, daemon=True,
@@ -74,6 +82,9 @@ class ReplicationStream:
 
         deadline = time.time() + timeout_s
         while time.time() < deadline:
+            if self.error is not None:
+                raise RuntimeError("replication stream failed") \
+                    from self.error
             if self.frontier >= ts:
                 return True
             time.sleep(0.01)
@@ -81,7 +92,9 @@ class ReplicationStream:
 
     def cutover(self) -> int:
         """Stop replicating; the standby is consistent as of the returned
-        frontier (writes the source commits after this never arrive)."""
+        frontier (writes the source commits after this never arrive).
+        Raises if the stream died on an apply error — a silent dead
+        stream must not masquerade as a successful cutover."""
         self._stop.set()
         try:
             self._sock.close()  # unblocks the frame reader
@@ -89,4 +102,8 @@ class ReplicationStream:
             pass
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self.error is not None:
+            raise RuntimeError(
+                "replication stream failed before cutover"
+            ) from self.error
         return self.frontier

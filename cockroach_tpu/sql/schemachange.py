@@ -209,6 +209,14 @@ def _remap_dict_span(db, tbl, new_schema, reg=None, job=None) -> None:
     Registry.checkpoint fencing discipline)."""
     if tbl.dict_table_id is None:
         return
+    if job is not None:
+        # durable fast path: a resume after the remap committed skips the
+        # full dict-span scan (the in-txn fenced re-check below stays the
+        # correctness gate)
+        durable = reg.load(job.job_id)
+        if durable is not None and durable.progress.get("dict_remapped"):
+            job.progress.setdefault("dict_remapped", True)
+            return
     old_pos = {n: i for i, n in enumerate(tbl.schema.names)}
     new_pos = {n: i for i, n in enumerate(new_schema.names)}
     moves: dict[int, int | None] = {}

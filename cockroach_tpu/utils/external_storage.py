@@ -30,6 +30,16 @@ def set_nodelocal_base(path: str) -> None:
     _NODELOCAL_BASE = path
 
 
+def _check_under(base: str, path: str, shown) -> None:
+    """Reject paths outside the storage root. commonpath, NOT a string
+    prefix: '/d/.extern-evil' shares the prefix of '/d/.extern' but is a
+    sibling, not a child."""
+    b = os.path.abspath(base)
+    p = os.path.abspath(path)
+    if os.path.commonpath([b, p]) != b:
+        raise ValueError(f"path escapes storage root: {shown!r}")
+
+
 class ExternalStorage:
     """Common surface (pkg/cloud/external_storage.go reduction)."""
 
@@ -58,8 +68,7 @@ class LocalStorage(ExternalStorage):
 
     def _path(self, name: str) -> str:
         p = os.path.normpath(os.path.join(self.base, name))
-        if not os.path.abspath(p).startswith(os.path.abspath(self.base)):
-            raise ValueError(f"path escapes storage root: {name!r}")
+        _check_under(self.base, p, name)
         return p
 
     def write_file(self, name: str, data: bytes) -> None:
@@ -133,7 +142,6 @@ def resolve_dir_uri(uri: str) -> str:
     storage, path = from_uri(uri)
     base = storage.as_local_dir()
     full = os.path.normpath(os.path.join(base, path))
-    if not os.path.abspath(full).startswith(os.path.abspath(base)):
-        raise ValueError(f"path escapes storage root: {uri!r}")
+    _check_under(base, full, uri)
     os.makedirs(os.path.dirname(full) or ".", exist_ok=True)
     return full
