@@ -78,7 +78,8 @@ class Node:
 
     def start(self, gossip_port: int = 0,
               pg_port: int | None = None,
-              http_port: int | None = None) -> "Node":
+              http_port: int | None = None,
+              kv_port: int | None = None) -> "Node":
         self._stop.clear()
         self.liveness.heartbeat()  # own record exists before anything reads
 
@@ -113,6 +114,13 @@ class Node:
             from .http import AdminServer
 
             self.admin = AdminServer(self, port=http_port).serve_background()
+
+        self.kv_rpc = None
+        if kv_port is not None:
+            from ..kv.rpc import BatchServer
+
+            # the Internal.Batch endpoint (server/node.go Node.Batch role)
+            self.kv_rpc = BatchServer(self.db, port=kv_port)
 
         self.pg = None
         if pg_port is not None:
@@ -161,6 +169,9 @@ class Node:
         if getattr(self, "disk", None) is not None:
             self.disk.stop()
             self.disk = None
+        if getattr(self, "kv_rpc", None) is not None:
+            self.kv_rpc.close()
+            self.kv_rpc = None
         log.info(log.OPS, "node stopped", node=self.node_id)
 
     def _spawn(self, fn, name: str) -> None:

@@ -1,0 +1,150 @@
+"""KV Batch RPC service (Internal.Batch reduction)."""
+
+import threading
+
+from cockroach_tpu.kv import DB, Clock
+from cockroach_tpu.kv.rpc import BatchClient, BatchServer
+from cockroach_tpu.storage.lsm import Engine, WriteIntentError
+
+
+def _srv():
+    db = DB(Engine(key_width=16, val_width=32, memtable_size=64), Clock())
+    return db, BatchServer(db)
+
+
+def test_batch_roundtrip_and_ordering():
+    db, srv = _srv()
+    try:
+        c = BatchClient(srv.addr)
+        # one batch, ordered evaluation: put then read-your-write
+        resp = c.batch([
+            {"op": "put", "key": _e(b"a"), "value": _e(b"1")},
+            {"op": "put", "key": _e(b"b"), "value": _e(b"\x00\xff")},
+            {"op": "get", "key": _e(b"a")},
+        ])
+        assert _d(resp[2]["value"]) == b"1"
+        assert c.get(b"b") == b"\x00\xff"  # byte-exact
+        # server-side data is the same DB
+        assert db.get(b"a") == b"1"
+        # scans with limits
+        c.put(b"c", b"3")
+        assert c.scan(b"a", b"z", max_keys=2) == [(b"a", b"1"),
+                                                  (b"b", b"\x00\xff")]
+        # historical read at the put's timestamp
+        ts1 = c.put(b"h", b"old")
+        c.put(b"h", b"new")
+        assert c.get(b"h", ts=ts1) == b"old"
+        assert c.get(b"h") == b"new"
+        # delete
+        c.delete(b"a")
+        assert c.get(b"a") is None
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_typed_errors_and_concurrent_clients():
+    db, srv = _srv()
+    try:
+        # a live intent surfaces as WriteIntentError (retryable), not a
+        # generic failure, and does not kill the connection
+        t = db.new_txn()
+        t.put(b"locked", b"x")
+        c = BatchClient(srv.addr)
+        try:
+            c.get(b"locked")
+            raise AssertionError("expected WriteIntentError")
+        except WriteIntentError:
+            pass
+        t.commit()
+        assert c.get(b"locked") == b"x"  # same connection still works
+
+        # unknown op: typed Internal error, connection survives
+        try:
+            c.batch([{"op": "nope"}])
+            raise AssertionError("expected error")
+        except RuntimeError as e:
+            assert "unknown batch op" in str(e)
+        assert c.get(b"locked") == b"x"
+
+        # concurrent clients hammer the same server
+        errs = []
+
+        def worker(i):
+            try:
+                cc = BatchClient(srv.addr)
+                for j in range(20):
+                    cc.put(b"w%d-%02d" % (i, j), b"v%d" % j)
+                got = cc.scan(b"w%d-" % i, b"w%d~" % i)
+                assert len(got) == 20, got
+                cc.close()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ths = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        assert not errs, errs[:2]
+        c.close()
+    finally:
+        srv.close()
+
+
+def _e(b: bytes) -> str:
+    import base64
+
+    return base64.b64encode(b).decode()
+
+
+def _d(s: str) -> bytes:
+    import base64
+
+    return base64.b64decode(s)
+
+
+def test_node_serves_kv_rpc():
+    from cockroach_tpu.server.node import Node
+
+    node = Node(node_id=9, heartbeat_interval_s=0.1, ttl_ms=30000)
+    node.start(gossip_port=None, kv_port=0)
+    try:
+        c = BatchClient(node.kv_rpc.addr)
+        c.put(b"nk", b"nv")
+        assert c.get(b"nk") == b"nv"
+        assert node.db.get(b"nk") == b"nv"
+        c.close()
+    finally:
+        node.stop()
+
+
+def test_close_severs_established_connections():
+    db, srv = _srv()
+    c = BatchClient(srv.addr)
+    c.put(b"x", b"1")
+    srv.close()
+    try:
+        c.put(b"y", b"2")
+        raise AssertionError("expected the severed connection to fail")
+    except (ConnectionError, OSError, RuntimeError):
+        pass
+    assert db.get(b"y") is None  # nothing landed after close
+
+
+def test_intent_error_carries_real_keys():
+    db, srv = _srv()
+    try:
+        t = db.new_txn()
+        t.put(b"contended", b"x")
+        c = BatchClient(srv.addr)
+        try:
+            c.get(b"contended")
+            raise AssertionError("expected WriteIntentError")
+        except WriteIntentError as e:
+            assert e.keys == [b"contended"]
+            assert e.txns and e.txns[0] != 0
+        t.rollback()
+        c.close()
+    finally:
+        srv.close()
