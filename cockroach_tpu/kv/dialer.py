@@ -1,0 +1,76 @@
+"""Node dialer — the rpc/nodedialer reduction.
+
+Reference: nodedialer resolves a NodeID to an address (via gossip's
+node-descriptor entries) and hands out cached gRPC connections; callers
+never manage addresses themselves (pkg/rpc/nodedialer).
+
+Reduction: nodes advertise their KV Batch RPC address into gossip under
+``node/<id>/kv`` (Node.start does this when both gossip and the kv
+endpoint are up); ``NodeDialer.dial(node_id)`` resolves through the
+LOCAL infostore and returns a cached BatchClient, re-dialing after a
+connection failure or an address change (a restarted node re-advertises
+a new port)."""
+
+from __future__ import annotations
+
+import threading
+
+from .rpc import BatchClient
+
+_KEY = "node/%d/kv"
+
+
+def advertise(gossip, node_id: int, addr) -> None:
+    """Publish this node's KV endpoint (host, port) into gossip."""
+    gossip.add_info(_KEY % node_id, list(addr))
+
+
+class NodeDialer:
+    def __init__(self, gossip):
+        self.gossip = gossip
+        self._conns: dict[int, tuple[tuple, BatchClient]] = {}
+        self._lock = threading.Lock()
+
+    def resolve(self, node_id: int) -> tuple:
+        addr = self.gossip.get_info(_KEY % node_id)
+        if addr is None:
+            raise KeyError(f"no gossiped address for node {node_id}")
+        return tuple(addr)
+
+    def dial(self, node_id: int) -> BatchClient:
+        """Cached connection to node_id; re-dials when the advertised
+        address changed (node restart) or the cached conn is gone."""
+        addr = self.resolve(node_id)
+        with self._lock:
+            cached = self._conns.get(node_id)
+            if cached is not None and cached[0] == addr:
+                return cached[1]
+            if cached is not None:
+                try:
+                    cached[1].close()
+                except OSError:
+                    pass
+            client = BatchClient(addr)
+            self._conns[node_id] = (addr, client)
+            return client
+
+    def forget(self, node_id: int) -> None:
+        """Drop a cached conn (callers do this on a connection error so
+        the next dial reconnects)."""
+        with self._lock:
+            cached = self._conns.pop(node_id, None)
+        if cached is not None:
+            try:
+                cached[1].close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for _, c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass

@@ -122,6 +122,8 @@ class Node:
             # the Internal.Batch endpoint (server/node.go Node.Batch role)
             self.kv_rpc = BatchServer(self.db, port=kv_port)
 
+        self.dialer = None
+
         self.pg = None
         if pg_port is not None:
             from .pgwire import PgServer
@@ -146,6 +148,13 @@ class Node:
             self._settings_cb = self._publish_setting
             settings.on_change(self._settings_cb)
             self._spawn(self._settings_apply_loop, "gossip-settings")
+            # advertise the KV endpoint + hand out a dialer (nodedialer
+            # role: peers resolve node ids through gossip, never addresses)
+            from ..kv.dialer import NodeDialer, advertise
+
+            if self.kv_rpc is not None:
+                advertise(self.gossip, self.node_id, self.kv_rpc.addr)
+            self.dialer = NodeDialer(self.gossip)
         log.info(log.OPS, "node started", node=self.node_id)
         return self
 
@@ -172,6 +181,9 @@ class Node:
         if getattr(self, "kv_rpc", None) is not None:
             self.kv_rpc.close()
             self.kv_rpc = None
+        if getattr(self, "dialer", None) is not None:
+            self.dialer.close()
+            self.dialer = None
         log.info(log.OPS, "node stopped", node=self.node_id)
 
     def _spawn(self, fn, name: str) -> None:

@@ -148,3 +148,59 @@ def test_intent_error_carries_real_keys():
         c.close()
     finally:
         srv.close()
+
+
+def test_node_dialer_resolves_through_gossip():
+    """nodedialer role: two nodes gossip their KV endpoints; each dials
+    the other BY NODE ID and reads/writes its store; a restart with a new
+    port re-advertises and the dialer reconnects."""
+    import time
+
+    from cockroach_tpu.server.node import Node
+
+    n1 = Node(node_id=1, heartbeat_interval_s=0.1, ttl_ms=30000)
+    n1.start(gossip_port=0, kv_port=0)
+    n2 = Node(node_id=2, heartbeat_interval_s=0.1, ttl_ms=30000,
+              gossip_peers=[n1.gossip_addr()])
+    n2.start(gossip_port=0, kv_port=0)
+    try:
+        # wait for address propagation both ways
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                n1.dialer.resolve(2)
+                n2.dialer.resolve(1)
+                break
+            except KeyError:
+                time.sleep(0.05)
+        c12 = n1.dialer.dial(2)
+        c12.put(b"from1", b"hello2")
+        assert n2.db.get(b"from1") == b"hello2"
+        c21 = n2.dialer.dial(1)
+        c21.put(b"from2", b"hello1")
+        assert n1.db.get(b"from2") == b"hello1"
+        # cached: same client object on re-dial
+        assert n1.dialer.dial(2) is c12
+
+        # node 2's endpoint "restarts" on a new port and re-advertises
+        from cockroach_tpu.kv.dialer import advertise
+        from cockroach_tpu.kv.rpc import BatchServer
+
+        old = n2.kv_rpc
+        n2.kv_rpc = BatchServer(n2.db, port=0)
+        old.close()
+        advertise(n2.gossip, 2, n2.kv_rpc.addr)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if tuple(n1.gossip.get_info("node/2/kv") or ()) == tuple(
+                n2.kv_rpc.addr
+            ):
+                break
+            time.sleep(0.05)
+        c12b = n1.dialer.dial(2)  # address changed: fresh connection
+        assert c12b is not c12
+        c12b.put(b"after", b"restart")
+        assert n2.db.get(b"after") == b"restart"
+    finally:
+        n1.stop()
+        n2.stop()

@@ -627,3 +627,90 @@ def test_wal_torn_ingest_side_file(tmp_path):
     eng2 = Engine(key_width=16, val_width=8, wal_path=wal)
     assert eng2.get(b"keep", ts=10) == b"x"  # store opens; put survives
     assert eng2.get(b"ing000", ts=10) is None  # torn run dropped
+
+
+def test_metamorphic_op_sequence_across_configs():
+    """The pkg/storage/metamorphic discipline: ONE random op sequence
+    (puts, deletes, ingests, scans, gets, flushes, compactions,
+    intent lay/resolve) runs against engines with DIFFERENT tuning
+    (memtable size, L0 trigger, compaction width) — every read result
+    must be identical across configs; tuning may change performance,
+    never answers."""
+    import numpy as np
+
+    from cockroach_tpu.storage.lsm import Engine, WriteIntentError
+
+    configs = [
+        dict(memtable_size=4, l0_trigger=2, compact_width=2),
+        dict(memtable_size=64, l0_trigger=8, compact_width=4),
+        dict(memtable_size=1024, l0_trigger=64, compact_width=8),
+    ]
+    engines = [Engine(key_width=16, val_width=16, **c) for c in configs]
+    rng = np.random.default_rng(77)
+
+    def key(i: int) -> bytes:
+        return b"m%05d" % i
+
+    ts = 0
+    for step in range(140):
+        kind = rng.random()
+        ts += 1
+        if kind < 0.3:
+            k, v = key(int(rng.integers(0, 60))), b"v%04d" % step
+            for e in engines:
+                e.put(k, v, ts=ts)
+        elif kind < 0.4:
+            k = key(int(rng.integers(0, 60)))
+            for e in engines:
+                e.delete(k, ts=ts)
+        elif kind < 0.5:
+            lo = int(rng.integers(0, 50))
+            width = int(rng.integers(1, 12))
+            keys = np.zeros((width, 16), np.uint8)
+            for j in range(width):
+                kb = key(lo + j)
+                keys[j, :len(kb)] = np.frombuffer(kb, np.uint8)
+            vals = np.zeros((width, 16), np.uint8)
+            pay = b"g%04d" % step
+            vals[:, :len(pay)] = np.frombuffer(pay, np.uint8)
+            for e in engines:
+                e.ingest(keys.copy(), vals.copy(), ts=ts)
+        elif kind < 0.56:
+            txn = 1000 + step
+            k = key(int(rng.integers(0, 60)))
+            commit = rng.random() < 0.5
+            for e in engines:
+                e.put(k, b"i%04d" % step, ts=ts, txn=txn)
+                e.resolve_intents(txn, ts, commit=commit)
+        elif kind < 0.62:
+            for e in engines:
+                e.flush()
+        elif kind < 0.66:
+            for e in engines:
+                e.compact(bottom=bool(rng.random() < 0.3))
+        elif kind < 0.85:
+            lo = int(rng.integers(0, 55))
+            hi = lo + int(rng.integers(1, 20))
+            mk = (int(rng.integers(1, 8))
+                  if rng.random() < 0.5 else None)
+            results = [
+                e.scan(key(lo), key(hi), ts=ts, max_keys=mk)
+                for e in engines
+            ]
+            assert results[0] == results[1] == results[2], (
+                step, lo, hi, mk,
+                [r[:3] for r in results],
+            )
+        else:
+            k = key(int(rng.integers(0, 60)))
+            # historical read at a random past timestamp
+            at = int(rng.integers(1, ts + 1))
+            got = [e.get(k, ts=at) for e in engines]
+            assert got[0] == got[1] == got[2], (step, k, at, got)
+
+    # final: full sweeps and stats-visible state agree
+    sweeps = [dict(e.scan(key(0), key(99999), ts=ts + 1)) for e in engines]
+    assert sweeps[0] == sweeps[1] == sweeps[2]
+    # run counts legitimately DIFFER (that's the point of the tuning);
+    # the data cannot
+    assert len({e.stats.runs for e in engines}) >= 1
