@@ -1503,6 +1503,184 @@ class WindowOp(OneInputOperator):
         return self._fn(tuple(tiles), cap=_spool_cap(tiles))
 
 
+class OrderedSyncOp(Operator):
+    """Merge-ordered fan-in — the OrderedSynchronizer analog (colexec/
+    ordered_synchronizer.eg.go): K inputs whose streams are each sorted
+    on `keys` merge into one sorted stream, INCREMENTALLY: per round,
+    one tile is pulled from each input that needs one, the buffered rows
+    merge (concat + packed-key sort, the TPU merge idiom), and rows at or
+    below the BARRIER — the smallest of the inputs' maximum buffered
+    keys — are safe to emit (no later row can sort before them). Rows
+    past the barrier carry to the next round in a fixed-capacity tile
+    (bounded: each input contributes at most one tile beyond the
+    barrier).
+
+    Streams whenever the key list packs into uint64 words (ops/keys.py
+    bit-packing; true for int/date/string/bool keys — barrier compares
+    compose lexicographically across words). Float keys ride native f64
+    operands and fall back to a full spool + one sort — same results, no
+    streaming."""
+
+    def __init__(self, children_ops: tuple[Operator, ...], keys):
+        super().__init__()
+        assert children_ops, "ordered fan-in needs at least one input"
+        self._children = list(children_ops)
+        self.keys = tuple(keys)
+        self.output_schema = children_ops[0].output_schema
+        self.dictionaries = dict(children_ops[0].dictionaries)
+        self.col_stats = {}
+        self._rank_tables = {
+            k.col: children_ops[0].dictionaries[k.col].ranks
+            for k in self.keys
+            if k.col in children_ops[0].dictionaries
+        }
+
+    def children(self):
+        return list(self._children)
+
+    def _packed_words(self, b: Batch):
+        """Packed sort-key words per row ([w0, w1, ...], lexicographic),
+        or None when any operand is not a uint64 word (float keys ride
+        native f64 — fallback path)."""
+        ops = sort_ops.pack_sort_operands(
+            b, self.output_schema, self.keys, self._rank_tables,
+            include_mask=False,
+        )
+        if any(o.dtype != jnp.uint64 for o in ops):
+            return None
+        return ops
+
+    @staticmethod
+    def _lex_max(words, live):
+        """Lexicographic max of multi-word keys over live rows (no host
+        sync): fix each word greedily, narrowing the candidate set."""
+        sel = live
+        out = []
+        for w in words:
+            m = jnp.max(jnp.where(sel, w, jnp.uint64(0)))
+            out.append(m)
+            sel = sel & (w == m)
+        return out
+
+    @staticmethod
+    def _lex_le(words, barrier):
+        """rowwise (w0, w1, ...) <= (b0, b1, ...)."""
+        lt = jnp.zeros(words[0].shape, jnp.bool_)
+        eq = jnp.ones(words[0].shape, jnp.bool_)
+        for w, b in zip(words, barrier):
+            lt = lt | (eq & (w < b))
+            eq = eq & (w == b)
+        return lt | eq
+
+    def init(self):
+        for c in self._children:
+            c.init()
+        self._bufs: list[Batch | None] = [None] * len(self._children)
+        self._done = [False] * len(self._children)
+        self._carry: Batch | None = None
+        self._flushed = False
+        from ..coldata.batch import empty_batch
+
+        probe = empty_batch(self.output_schema, 16)
+        self._streaming = self._packed_words(probe) is not None
+        self._spooled = None
+        self._initialized = True
+
+    # -- fallback: full spool + one sort (correct, not streaming) ----------
+
+    def _fallback_next(self):
+        if self._spooled is None:
+            tiles = []
+            for c in self._children:
+                while True:
+                    b = c.next_batch()
+                    if b is None:
+                        break
+                    tiles.append(b)
+            if not tiles:
+                self._spooled = ()
+                return None
+            big = concat(tiles, capacity=_spool_cap(tiles))
+            self._spooled = (sort_ops.sort_batch(
+                big, self.output_schema, self.keys, self._rank_tables),)
+        if self._spooled:
+            out, self._spooled = self._spooled[0], ()
+            return out
+        return None
+
+    # -- streaming rounds --------------------------------------------------
+
+    def _round(self):
+        """(emit_batch | None). Pull-missing, merge, split at barrier."""
+        for i, c in enumerate(self._children):
+            if not self._done[i] and self._bufs[i] is None:
+                b = c.next_batch()
+                if b is None:
+                    self._done[i] = True
+                else:
+                    self._bufs[i] = b
+        tiles = [b for b in self._bufs if b is not None]
+        live_inputs = [
+            i for i in range(len(self._children))
+            if not self._done[i] or self._bufs[i] is not None
+        ]
+        parts = ([self._carry] if self._carry is not None else []) + tiles
+        if not parts:
+            return None
+        cap = _spool_cap(parts)
+        big = concat(parts, capacity=cap)
+        merged = sort_ops.sort_batch(
+            big, self.output_schema, self.keys, self._rank_tables)
+        if all(self._done) :
+            # final flush: everything is safe
+            self._carry = None
+            self._bufs = [None] * len(self._children)
+            self._flushed = True
+            return merged
+        words = self._packed_words(merged)
+        # barrier: lexicographic MIN over NON-EXHAUSTED inputs of their
+        # buffered max key (no later row of any input can sort below it)
+        bars = []
+        for i in range(len(self._children)):
+            if self._done[i] or self._bufs[i] is None:
+                continue
+            bw = self._packed_words(self._bufs[i])
+            bars.append(self._lex_max(bw, self._bufs[i].mask))
+        barrier = bars[0]
+        for b in bars[1:]:
+            # lex min of two multi-word values via the compare helper
+            b_le = self._lex_le([jnp.asarray(x)[None] for x in b],
+                                [jnp.asarray(x)[None] for x in barrier])[0]
+            barrier = [jnp.where(b_le, x, y) for x, y in zip(b, barrier)]
+        safe = self._lex_le(words, barrier)
+        emit_mask = merged.mask & safe
+        hold_mask = merged.mask & ~safe
+        out = merged.with_mask(emit_mask)
+        # carry holds the tail in ORDER (compact preserves row order);
+        # bounded by sum of per-input tile caps, so a static capacity of
+        # the current spool cap always fits
+        from ..coldata.batch import compact as compact_batch
+
+        self._carry = compact_batch(merged.with_mask(hold_mask),
+                                    capacity=cap)
+        self._bufs = [None] * len(self._children)
+        return out
+
+    def _next(self):
+        if not self._streaming:
+            return self._fallback_next()
+        while not self._flushed:
+            out = self._round()
+            if out is None:
+                return None
+            return out
+        return None
+
+    def close(self):
+        for c in self._children:
+            c.close()
+
+
 class ParallelUnorderedSyncOp(Operator):
     """Unordered fan-in with one PULLER THREAD per input — the
     ParallelUnorderedSynchronizer analog (colexec/parallel_unordered_
