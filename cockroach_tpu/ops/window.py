@@ -39,13 +39,20 @@ WINDOW_FUNCS = (
 class WindowSpec:
     """One window function: func over column `col` (None for rank family),
     `offset` for lead/lag, `running` selects the cumulative frame
-    (rows unbounded preceding..current row) vs whole-partition."""
+    (rows unbounded preceding..current row) vs whole-partition.
+
+    `frame` is the general ROWS BETWEEN spec as (preceding, following)
+    row counts, None in either slot meaning UNBOUNDED — e.g. (2, 0) is
+    ROWS BETWEEN 2 PRECEDING AND CURRENT ROW, (None, 0) equals
+    running=True, (1, 1) a centered 3-row window. Applies to
+    sum/count/avg/min/max/first_value/last_value."""
 
     func: str
     col: int | None = None
     name: str | None = None
     offset: int = 1
     running: bool = False
+    frame: tuple | None = None
 
 
 def window_output_type(spec: WindowSpec, schema: Schema) -> SQLType:
@@ -119,9 +126,21 @@ def compute_windows(
     start_of = seg_start[seg]  # per-row segment start position
     peer_boundary = _order_peers(b, schema, order_keys, rank_tables, seg)
 
+    seg_end = jax.ops.segment_max(
+        jnp.where(b.mask, pos, -1), seg, num_segments=cap
+    )[seg]  # per-row last live position of the segment
+
     new_cols = list(b.cols)
     for spec in specs:
         out_t = window_output_type(spec, schema)
+        if spec.frame is not None and spec.func in (
+            "sum", "count", "avg", "min", "max", "first_value",
+            "last_value",
+        ):
+            d, v = _framed_window(b, schema, spec, seg, start_of, seg_end,
+                                  pos, rank_tables)
+            new_cols.append(Column(data=d, valid=v & b.mask))
+            continue
         if spec.func == "row_number":
             d = (pos - start_of + 1).astype(jnp.int64)
             v = b.mask
@@ -291,6 +310,110 @@ def compute_windows(
             raise ValueError(f"unknown window function {spec.func}")
         new_cols.append(Column(data=d, valid=v & b.mask))
     return Batch(cols=tuple(new_cols), mask=b.mask)
+
+
+def _rmq_levels(vals: jax.Array, op) -> jax.Array:
+    """Sparse table for range min/max queries: T[k, i] = reduce over
+    [i, i + 2^k) (out-of-range tail padded by repetition). log2(cap)
+    levels, each one fused elementwise pass — the TPU-shaped answer to
+    sliding-window min/max, where prefix sums don't apply."""
+    cap = vals.shape[0]
+    levels = [vals]
+    k = 1
+    while k < cap:
+        prev = levels[-1]
+        shifted = jnp.concatenate([prev[k:], prev[-1:].repeat(min(k, cap))])
+        shifted = shifted[:cap]
+        levels.append(op(prev, shifted))
+        k *= 2
+    return jnp.stack(levels)  # [K, cap]
+
+
+def _rmq_query(table: jax.Array, op, lo: jax.Array, hi: jax.Array):
+    """Per-row reduce over [lo, hi] (inclusive), widths data-dependent:
+    pick level j = floor(log2(w)) via comparisons, then combine the two
+    overlapping 2^j blocks."""
+    K, cap = table.shape
+    w = jnp.maximum(hi - lo + 1, 1)
+    j = jnp.zeros(w.shape, jnp.int32)
+    for k in range(1, K):
+        j = jnp.where(w >= (1 << k), k, j)
+    blk = (jnp.int32(1) << j)
+    flat = table.reshape(-1)
+    a = flat[j * cap + jnp.clip(lo, 0, cap - 1)]
+    c = flat[j * cap + jnp.clip(hi - blk + 1, 0, cap - 1)]
+    return op(a, c)
+
+
+def _framed_window(b: Batch, schema: Schema, spec: WindowSpec, seg,
+                   start_of, seg_end, pos, rank_tables):
+    """General ROWS BETWEEN frame for the aggregate window functions:
+    per-row frame bounds clamp to the partition; sums/counts/avgs answer
+    by prefix-sum difference, min/max by RMQ sparse table, first/last by
+    a gather at the frame edge."""
+    p, f = spec.frame
+    lo = start_of if p is None else jnp.maximum(start_of, pos - int(p))
+    hi = seg_end if f is None else jnp.minimum(seg_end, pos + int(f))
+    loc = jnp.clip(lo, 0, b.capacity - 1)
+    hic = jnp.clip(hi, 0, b.capacity - 1)
+    empty = hi < lo  # e.g. 2 FOLLOWING AND 3 FOLLOWING past the edge
+
+    if spec.func in ("first_value", "last_value"):
+        col = b.cols[spec.col]
+        edge = loc if spec.func == "first_value" else hic
+        return col.data[edge], col.valid[edge] & ~empty
+
+    if spec.func == "count" and spec.col is None:
+        c = jnp.cumsum(b.mask.astype(jnp.int64))
+        d = c[hic] - jnp.where(loc > 0, c[loc - 1], 0)
+        return jnp.where(empty, 0, d), jnp.ones_like(b.mask)
+
+    col = b.cols[spec.col]
+    t = schema.types[spec.col]
+    m = b.mask & col.valid
+    cnt = jnp.cumsum(m.astype(jnp.int64))
+    wcnt = jnp.where(
+        empty, 0, cnt[hic] - jnp.where(loc > 0, cnt[loc - 1], 0)
+    )
+    if spec.func in ("sum", "count", "avg"):
+        if spec.func == "count":
+            return wcnt, jnp.ones_like(b.mask)
+        if spec.func == "avg" or t.family is Family.FLOAT:
+            vals = jnp.where(m, col.data.astype(jnp.float64), 0.0)
+        else:
+            vals = jnp.where(m, col.data.astype(jnp.int64), 0)
+        c = jnp.cumsum(vals)
+        wsum = jnp.where(
+            empty, 0, c[hic] - jnp.where(loc > 0, c[loc - 1], 0)
+        )
+        if spec.func == "avg":
+            d = wsum.astype(jnp.float64) / jnp.where(wcnt > 0, wcnt, 1)
+            if t.family is Family.DECIMAL:
+                d = d / (10.0**t.scale)
+            return d, wcnt > 0
+        out_t = window_output_type(spec, schema)
+        return wsum.astype(out_t.dtype), wcnt > 0
+
+    # min / max via RMQ
+    from .aggregation import _minmax_sentinel
+
+    is_min = spec.func == "min"
+    data = col.data
+    inv_rank = None
+    if t.family is Family.STRING:
+        table = jnp.asarray(rank_tables[spec.col])
+        data = table[jnp.clip(col.data, 0, table.shape[0] - 1)]
+        inv = np.empty(len(rank_tables[spec.col]), dtype=np.int32)
+        inv[np.asarray(rank_tables[spec.col])] = np.arange(
+            len(inv), dtype=np.int32)
+        inv_rank = jnp.asarray(inv)
+    sent = _minmax_sentinel(data.dtype, is_min)
+    vv = jnp.where(m, data, sent)
+    op = jnp.minimum if is_min else jnp.maximum
+    red = _rmq_query(_rmq_levels(vv, op), op, loc, hic)
+    if inv_rank is not None:
+        red = inv_rank[jnp.clip(red, 0, inv_rank.shape[0] - 1)]
+    return red.astype(col.data.dtype), (wcnt > 0) & ~empty
 
 
 def window_output_schema(

@@ -238,9 +238,12 @@ class Rel:
 
     def window(self, partition_by: list[str], order_by: list[tuple[str, bool]],
                funcs: list[tuple[str, str, str | None]],
-               running: bool = False) -> "Rel":
+               running: bool = False, frame: tuple | None = None) -> "Rel":
         """funcs: (output name, window func, input col name or None).
-        running=True selects the cumulative frame for aggregates."""
+        running=True selects the cumulative frame for aggregates; `frame`
+        is the general ROWS BETWEEN spec as (preceding, following) row
+        counts with None meaning UNBOUNDED — e.g. frame=(2, 0) is ROWS
+        BETWEEN 2 PRECEDING AND CURRENT ROW."""
         from ..ops import sort as sort_ops
         from ..ops import window as win_ops
 
@@ -250,7 +253,7 @@ class Rel:
         specs = tuple(
             win_ops.WindowSpec(
                 f, None if cn is None else self.idx(cn), name,
-                running=running,
+                running=running, frame=frame,
             )
             for name, f, cn in funcs
         )
