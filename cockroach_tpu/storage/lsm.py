@@ -304,6 +304,7 @@ class Engine:
         # per-run host key bytes for iterator seeks (block-index analog);
         # keyed by id with a strong run ref so ids can't be reused
         self._run_key_cache: dict[int, tuple] = {}
+        self._run_bloom_cache: dict[int, tuple] = {}
         self._runs_view_cache: tuple[int, mvcc.KVBlock] | None = None
         self._scan_windows: dict[int, int] = {}  # max_keys -> learned window
         self._mem_cache: tuple[int, mvcc.KVBlock] | None = None
@@ -731,7 +732,8 @@ class Engine:
         self._overlay_cache = (key, view)
         return view
 
-    def _bounded_view(self, sw, ew, limit_rows: int | None = None):
+    def _bounded_view(self, sw, ew, limit_rows: int | None = None,
+                      point: bytes | None = None):
         """Candidate view for a bounded read: gather only in-range rows of
         each source into small tiles and merge those — point/short-scan
         cost scales with matching rows, not total history.
@@ -753,6 +755,15 @@ class Engine:
         parts = []
         boundary: bytes | None = None
         for src, sorted_run in sources:
+            if (point is not None and sorted_run
+                    and not self._bloom_might_contain(src, point)):
+                # per-run bloom filter: the key is definitely absent —
+                # skip the run's range-mask/gather entirely (pebble's
+                # table-filter point-read pruning)
+                from ..utils import metric
+
+                metric.BLOOM_SKIPS.inc()
+                continue
             if limit_rows is not None and sorted_run and sw is not None:
                 # iterator seek: host binary search over the run's cached
                 # key bytes finds the start position, one device
@@ -796,6 +807,65 @@ class Engine:
         total = sum(p.capacity for p in parts)
         view = mvcc.merge_blocks(tuple(parts), cap=_pad(total, _CAND_ALIGN))
         return view, boundary
+
+    # -- bloom filters (pebble table-filter role) ---------------------------
+
+    _BLOOM_BITS_PER_KEY = 10
+    _BLOOM_K = 3
+
+    @staticmethod
+    def _bloom_hashes(void_keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Two vectorized 64-bit FNV-style hashes per key (double hashing
+        composes the k probe positions). uint64 wraparound is the hash
+        function working as designed — suppress numpy's overflow warning."""
+        kb = void_keys.view(np.uint8).reshape(len(void_keys), -1)
+        with np.errstate(over="ignore"):
+            h1 = np.full(len(kb), 0xCBF29CE484222325, np.uint64)
+            h2 = np.full(len(kb), 0x9E3779B97F4A7C15, np.uint64)
+            p1 = np.uint64(0x100000001B3)
+            p2 = np.uint64(0xC2B2AE3D27D4EB4F)
+            for j in range(kb.shape[1]):
+                col = kb[:, j].astype(np.uint64)
+                h1 = (h1 ^ col) * p1
+                h2 = (h2 + col) * p2 ^ (h2 >> np.uint64(29))
+            return h1, h2 | np.uint64(1)
+
+    def _run_bloom(self, run: mvcc.KVBlock) -> tuple[np.ndarray, int]:
+        """(bitset, nbits) over the run's LIVE keys — point reads skip
+        runs whose filter misses (pebble's per-table bloom filter). Host
+        numpy; cached alongside the seek index and pruned with it."""
+        c = self._run_bloom_cache.get(id(run))
+        if c is None or c[0] is not run:
+            vkeys, n_live = self._run_keys(run)
+            nbits = max(64, _pad(max(1, n_live) * self._BLOOM_BITS_PER_KEY,
+                                 64))
+            bits = np.zeros(nbits, dtype=bool)
+            if n_live:
+                h1, h2 = self._bloom_hashes(vkeys[:n_live])
+                for i in range(self._BLOOM_K):
+                    bits[(h1 + np.uint64(i) * h2) % np.uint64(nbits)] = True
+            if len(self._run_bloom_cache) > 4 * max(1, len(self.runs)):
+                live_ids = {id(r) for r in self.runs}
+                self._run_bloom_cache = {
+                    k: v for k, v in self._run_bloom_cache.items()
+                    if k in live_ids
+                }
+            c = self._run_bloom_cache[id(run)] = (run, bits, nbits)
+        return c[1], c[2]
+
+    def _bloom_might_contain(self, run: mvcc.KVBlock, key: bytes) -> bool:
+        bits, nbits = self._run_bloom(run)
+        kb = np.zeros((1, self.key_width), np.uint8)
+        raw = np.frombuffer(key, np.uint8)
+        kb[0, :len(raw)] = raw
+        h1, h2 = self._bloom_hashes(
+            np.ascontiguousarray(kb).view(f"V{self.key_width}").reshape(-1)
+        )
+        a, d = int(h1[0]), int(h2[0])
+        for i in range(self._BLOOM_K):
+            if not bits[((a + i * d) & 0xFFFFFFFFFFFFFFFF) % nbits]:
+                return False
+        return True
 
     def _run_keys(self, run: mvcc.KVBlock):
         """Host copy of a sorted run's key bytes as a void array (memcmp
@@ -981,7 +1051,7 @@ class Engine:
         b = key.encode() if isinstance(key, str) else bytes(key)
         sw = K.encode_bound(b, self.key_width)
         ew = K.bound_next(sw)
-        view, _ = self._bounded_view(sw, ew)
+        view, _ = self._bounded_view(sw, ew, point=b)
         if view is None:
             return None
         sel, conflict = mvcc.mvcc_scan_filter(

@@ -141,5 +141,7 @@ QUERY_SECONDS = DEFAULT.histogram(
 TXN_COMMITS = DEFAULT.counter("txn_commits", "committed transactions")
 TXN_RETRIES = DEFAULT.counter("txn_retries", "transaction retries")
 RANGE_SPLITS = DEFAULT.counter("range_splits", "admin range splits")
+BLOOM_SKIPS = DEFAULT.counter(
+    "storage_bloom_skips", "runs skipped by bloom filters on point reads")
 RANGE_MOVES = DEFAULT.counter(
     "range_moves", "range relocations between stores")

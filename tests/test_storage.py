@@ -714,3 +714,29 @@ def test_metamorphic_op_sequence_across_configs():
     # run counts legitimately DIFFER (that's the point of the tuning);
     # the data cannot
     assert len({e.stats.runs for e in engines}) >= 1
+
+
+def test_bloom_filters_prune_point_reads():
+    """Per-run bloom filters (pebble table-filter role): point gets skip
+    runs that definitely lack the key; answers never change."""
+    from cockroach_tpu.storage.lsm import Engine
+    from cockroach_tpu.utils import metric
+
+    eng = Engine(key_width=16, val_width=16, memtable_size=4,
+                 l0_trigger=64)
+    # several disjoint runs (tiny memtable flushes constantly)
+    for i in range(40):
+        eng.put(b"b%05d" % i, b"v%05d" % i, ts=i + 1)
+    eng.flush()
+    assert len(eng.runs) >= 4
+    # present keys: correct values
+    for i in (0, 17, 39):
+        assert eng.get(b"b%05d" % i, ts=100) == b"v%05d" % i
+    # absent keys: bloom pruning engages (counter moves) and stays correct
+    before = metric.BLOOM_SKIPS.value
+    for i in range(200, 240):
+        assert eng.get(b"b%05d" % i, ts=100) is None
+    assert metric.BLOOM_SKIPS.value > before
+    # a present key still found after more churn + compaction
+    eng.compact(bottom=True)
+    assert eng.get(b"b%05d" % 17, ts=100) == b"v%05d" % 17
