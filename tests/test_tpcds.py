@@ -1,0 +1,109 @@
+"""TPC-DS (reduced) — star-schema reporting queries vs pandas oracles,
+local AND distributed."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cockroach_tpu.bench import tpcds
+
+
+@pytest.fixture(scope="module")
+def cat():
+    return tpcds.gen_tpcds(sf=0.01)
+
+
+def _pd(cat, name):
+    t = cat.get(name)
+    out = {}
+    for cname, typ in zip(t.schema.names, t.schema.types):
+        col = t.columns[cname]
+        if cname in t.dictionaries:
+            out[cname] = t.dictionaries[cname].values[col]
+        elif typ.family.name == "DECIMAL":
+            out[cname] = col / 10.0**typ.scale
+        else:
+            out[cname] = col
+    return pd.DataFrame(out)
+
+
+def _oracle(cat, qname):
+    ss = _pd(cat, "store_sales")
+    dd = _pd(cat, "date_dim")
+    it = _pd(cat, "item")
+    if qname == "q3":
+        j = (ss.merge(dd[dd.d_moy == 12], left_on="ss_sold_date_sk",
+                      right_on="d_date_sk")
+             .merge(it[it.i_manufact_id == 5], left_on="ss_item_sk",
+                    right_on="i_item_sk"))
+        g = (j.groupby(["d_year", "i_brand_id", "i_brand"])
+             .ss_ext_sales_price.sum().reset_index(name="sum_agg"))
+        return g.sort_values(["d_year", "sum_agg", "i_brand_id"],
+                             ascending=[True, False, True]).head(100)
+    if qname == "q42":
+        j = (ss.merge(dd[(dd.d_moy == 11) & (dd.d_year == 2000)],
+                      left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(it, left_on="ss_item_sk", right_on="i_item_sk"))
+        g = (j.groupby(["d_year", "i_category"])
+             .ss_ext_sales_price.sum().reset_index(name="rev"))
+        return g.sort_values(["rev", "d_year", "i_category"],
+                             ascending=[False, True, True]).head(100)
+    if qname == "q52":
+        j = (ss.merge(dd[(dd.d_moy == 12) & (dd.d_year == 1999)],
+                      left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(it, left_on="ss_item_sk", right_on="i_item_sk"))
+        g = (j.groupby(["d_year", "i_brand_id", "i_brand"])
+             .ss_ext_sales_price.sum().reset_index(name="rev"))
+        return g.sort_values(["d_year", "rev", "i_brand_id"],
+                             ascending=[True, False, True]).head(100)
+    if qname == "q55":
+        j = (ss.merge(dd[(dd.d_moy == 11) & (dd.d_year == 2001)],
+                      left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(it[it.i_manager_id == 3], left_on="ss_item_sk",
+                    right_on="i_item_sk"))
+        g = (j.groupby(["i_brand_id", "i_brand"])
+             .ss_ext_sales_price.sum().reset_index(name="rev"))
+        return g.sort_values(["rev", "i_brand_id"],
+                             ascending=[False, True]).head(100)
+    if qname == "q59_lite":
+        st = _pd(cat, "store")
+        j = (ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .merge(st, left_on="ss_store_sk", right_on="s_store_sk"))
+        g = (j.groupby(["s_store_name", "d_year", "d_moy"])
+             .ss_ext_sales_price.sum().reset_index(name="rev"))
+        return g.sort_values(["s_store_name", "d_year", "d_moy"]).head(500)
+    raise KeyError(qname)
+
+
+@pytest.mark.parametrize("qname", sorted(tpcds.QUERIES))
+def test_query_matches_pandas(cat, qname):
+    got = tpcds.QUERIES[qname](cat).run()
+    want = _oracle(cat, qname)
+    assert len(next(iter(got.values()))) == len(want) > 0, qname
+    val = "sum_agg" if qname == "q3" else "rev"
+    np.testing.assert_allclose(
+        np.asarray(got[val], np.float64), want[val].to_numpy(),
+        rtol=1e-9, err_msg=qname,
+    )
+    for k in want.columns:
+        if k == val:
+            continue
+        a, b = got[k], want[k].to_numpy()
+        if a.dtype.kind in "OU":
+            assert list(a) == list(b), (qname, k)
+        else:
+            np.testing.assert_array_equal(a, b, err_msg=f"{qname}.{k}")
+
+
+@pytest.mark.parametrize("qname", ["q3", "q55"])
+def test_query_distributed_matches_local(cat, qname):
+    local = tpcds.QUERIES[qname](cat).run()
+    dist = tpcds.QUERIES[qname](cat).run_distributed()
+    for k in local:
+        a, b = local[k], dist[k]
+        if a.dtype.kind in "OU":
+            assert list(a) == list(b), (qname, k)
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a, np.float64), np.asarray(b, np.float64),
+                rtol=1e-9, err_msg=f"{qname}.{k}")
