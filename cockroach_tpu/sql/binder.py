@@ -136,9 +136,30 @@ def _walk(e: P.Node):
 
 
 def _has_agg(e: P.Node) -> bool:
-    return any(
-        isinstance(x, P.FuncCall) and x.name in AGG_FUNCS for x in _walk(e)
-    )
+    # a sum() INSIDE an OVER clause is a window aggregate, not grouping:
+    # WindowCall subtrees are pruned from the walk entirely
+    if isinstance(e, P.WindowCall):
+        return False
+    if isinstance(e, P.FuncCall) and e.name in AGG_FUNCS:
+        return True
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, P.Node) and not isinstance(v, P.Select):
+            if _has_agg(v):
+                return True
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, P.Node) and not isinstance(x, P.Select):
+                    if _has_agg(x):
+                        return True
+                elif isinstance(x, tuple):
+                    # nested pair tuples (CASE whens: (cond, result))
+                    for y in x:
+                        if (isinstance(y, P.Node)
+                                and not isinstance(y, P.Select)
+                                and _has_agg(y)):
+                            return True
+    return False
 
 
 # ---------------------------------------------------------------------------
@@ -1155,16 +1176,125 @@ class Binder:
             or any(_has_agg(it.expr) for it in sel.items)
             or (sel.having is not None and _has_agg(sel.having))
         )
+        window_names = None
+        if any(isinstance(it.expr, P.WindowCall) for it in sel.items):
+            if has_agg:
+                raise BindError(
+                    "window functions over aggregated results are not "
+                    "supported in this build"
+                )
+            rel, window_names = self._apply_windows(sel, rel, resolver)
         if has_agg:
             rel = self._aggregate(sel, rel, resolver)
         else:
-            rel = self._project(sel, rel, resolver)
+            rel = self._project(sel, rel, resolver,
+                                window_names=window_names)
         if sel.distinct:
             rel = rel.distinct()
         rel = self._order_limit(sel, rel)
         return rel
 
-    def _project(self, sel: P.Select, rel: Rel, resolver=None) -> Rel:
+    _WINDOW_ONLY = {"row_number", "rank", "dense_rank", "ntile",
+                    "percent_rank", "cume_dist", "lag", "lead",
+                    "first_value", "last_value"}
+    _WINDOW_AGGS = {"sum", "count", "min", "max", "avg"}
+
+    def _apply_windows(self, sel: P.Select, rel: Rel, resolver):
+        """Append one column per top-level OVER item (colexecwindow via
+        Rel.window); returns (rel, {id(WindowCall) -> appended name}).
+
+        Scope (documented reductions): window calls are top-level SELECT
+        items; PARTITION BY / ORDER BY / function arguments are plain
+        columns; the default frame with ORDER BY is ROWS UNBOUNDED
+        PRECEDING..CURRENT ROW (the reference's RANGE default differs on
+        ties)."""
+        lower = ExprLowerer(rel, resolver=resolver)
+
+        def colname(e: P.Node, what: str) -> str:
+            le = lower.lower(e)
+            if not isinstance(le, ex.ColRef):
+                raise BindError(
+                    f"window {what} must be a plain column in this build"
+                )
+            return rel.schema.names[le.idx]
+
+        # group calls by their window (partition, order, frame) so each
+        # distinct window sorts once
+        groups: dict[tuple, list] = {}
+        names: dict[int, str] = {}
+        used = set(rel.schema.names)
+        for it in sel.items:
+            wc = it.expr
+            if not isinstance(wc, P.WindowCall):
+                continue
+            func = wc.func.name.lower()
+            if func not in self._WINDOW_ONLY | self._WINDOW_AGGS:
+                raise BindError(f"unknown window function {func}()")
+            if wc.func.distinct:
+                raise BindError(
+                    f"{func}(DISTINCT ...) OVER is not supported"
+                )
+            parts = tuple(colname(e, "PARTITION BY") for e in wc.partition_by)
+            order = tuple(
+                (colname(e, "ORDER BY"), desc) for e, desc in wc.order_by
+            )
+            frame = wc.frame
+            if not wc.has_frame_clause and func in (
+                self._WINDOW_AGGS | {"first_value", "last_value"}
+            ):
+                # SQL default: cumulative with ORDER BY, whole partition
+                # without (RANGE->ROWS reduction documented above).
+                # first/last_value follow the same default frame — SQL's
+                # last_value with ORDER BY is the CURRENT row, not the
+                # partition's last
+                frame = (None, 0) if order else None
+            arg = None
+            offset = 1
+            if func in ("lag", "lead"):
+                if not wc.func.args:
+                    raise BindError(f"{func}() needs a column argument")
+                arg = colname(wc.func.args[0], "argument")
+                if len(wc.func.args) > 2:
+                    raise BindError(
+                        f"{func}() default-value argument is not "
+                        "supported (NULL is returned past the edge)"
+                    )
+                if len(wc.func.args) > 1:
+                    a = wc.func.args[1]
+                    if not isinstance(a, P.NumLit):
+                        raise BindError(
+                            f"{func}() offset must be a literal")
+                    offset = int(a.value)
+            elif func == "ntile":
+                if not (wc.func.args
+                        and isinstance(wc.func.args[0], P.NumLit)):
+                    raise BindError("ntile() needs a literal bucket count")
+                offset = int(wc.func.args[0].value)
+            elif func in self._WINDOW_AGGS or func in ("first_value",
+                                                       "last_value"):
+                if func == "count" and (
+                    not wc.func.args
+                    or isinstance(wc.func.args[0], P.Star)
+                ):
+                    arg = None
+                else:
+                    if not wc.func.args:
+                        raise BindError(f"{func}() needs an argument")
+                    arg = colname(wc.func.args[0], "argument")
+            out = it.alias or func
+            while out in used:
+                out = f"_{out}w"
+            used.add(out)
+            names[id(wc)] = out
+            groups.setdefault((parts, order, frame), []).append(
+                (out, func, arg, offset)
+            )
+        for (parts, order, frame), funcs in groups.items():
+            rel = rel.window(list(parts), list(order), funcs, frame=frame)
+        return rel, names
+
+    def _project(self, sel: P.Select, rel: Rel, resolver=None,
+                 window_names=None) -> Rel:
         items: list[tuple[str, ex.Expr]] = []
         expr_names: dict[P.Node, str] = {}
         used: set[str] = set()
@@ -1173,11 +1303,20 @@ class Binder:
         for it in sel.items:
             if isinstance(it.expr, P.Star):
                 for n in rel.schema.names:
+                    if window_names and n in set(window_names.values()):
+                        continue  # window outputs are not part of *
                     items.append((self._uniq(n, used), ex.ColRef(rel.idx(n))))
                 continue
             name = self._uniq(
                 it.alias or self._default_name(it.expr, len(items)), used
             )
+            if window_names is not None and id(it.expr) in window_names:
+                # the window column was appended by _apply_windows
+                items.append(
+                    (name, ex.ColRef(rel.idx(window_names[id(it.expr)])))
+                )
+                expr_names[it.expr] = name
+                continue
             st = self._string_transform(rel, it.expr, lower)
             if st is not None:
                 expr, d = st
@@ -1430,6 +1569,8 @@ class Binder:
             return e.name
         if isinstance(e, P.FuncCall):
             return e.name
+        if isinstance(e, P.WindowCall):
+            return e.func.name
         return f"col{i}"
 
     @staticmethod

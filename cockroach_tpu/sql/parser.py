@@ -40,6 +40,8 @@ KEYWORDS = {
     "substring", "for", "true", "false", "any", "some", "with",
     "create", "table", "primary", "key", "insert", "into", "values",
     "update", "set", "delete", "default", "alter", "add", "column", "drop",
+    "over", "partition", "rows", "unbounded", "preceding", "following",
+    "current", "row",
 }
 
 
@@ -126,6 +128,20 @@ class FuncCall(Node):
     name: str
     args: tuple[Node, ...]
     distinct: bool = False
+
+
+@dataclass(frozen=True)
+class WindowCall(Node):
+    """<func>(args) OVER (PARTITION BY ... ORDER BY ... [ROWS BETWEEN
+    <bound> AND <bound>]). frame: (preceding, following) row counts with
+    None meaning UNBOUNDED; frame is None when no ROWS clause was given
+    (the binder applies the SQL default)."""
+
+    func: FuncCall
+    partition_by: tuple[Node, ...] = ()
+    order_by: tuple[tuple[Node, bool], ...] = ()  # (expr, desc)
+    frame: tuple | None = None
+    has_frame_clause: bool = False
 
 
 @dataclass(frozen=True)
@@ -837,12 +853,75 @@ class Parser:
                     while self.eat_op(","):
                         args.append(self.parse_expr())
                 self.expect_op(")")
-                return FuncCall(name, tuple(args), distinct)
+                fc = FuncCall(name, tuple(args), distinct)
+                if self.at_kw("over"):
+                    return self.parse_over(fc)
+                return fc
             if self.eat_op("."):
                 col = self.next().value
                 return Ident(name, col)
             return Ident(None, name)
         raise SyntaxError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def parse_over(self, fc: FuncCall) -> WindowCall:
+        """OVER (PARTITION BY ... ORDER BY ... [ROWS BETWEEN a AND b])."""
+        self.expect_kw("over")
+        self.expect_op("(")
+        parts: list[Node] = []
+        order: list[tuple[Node, bool]] = []
+        frame = None
+        has_frame = False
+        if self.eat_kw("partition"):
+            self.expect_kw("by")
+            parts.append(self.parse_expr())
+            while self.eat_op(","):
+                parts.append(self.parse_expr())
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                desc = False
+                if self.eat_kw("desc"):
+                    desc = True
+                elif self.eat_kw("asc"):
+                    pass
+                order.append((e, desc))
+                if not self.eat_op(","):
+                    break
+        if self.eat_kw("rows"):
+            has_frame = True
+            self.expect_kw("between")
+            frame = (self._frame_bound(preceding=True),
+                     self._frame_bound(preceding=False))
+            # BETWEEN's middle AND
+        self.expect_op(")")
+        return WindowCall(fc, tuple(parts), tuple(order), frame, has_frame)
+
+    def _frame_bound(self, preceding: bool):
+        """One ROWS bound -> row count relative to the current row (None =
+        UNBOUNDED). The leading bound consumes the AND separator."""
+        if self.eat_kw("unbounded"):
+            # the start bound must say PRECEDING, the end bound FOLLOWING
+            self.expect_kw("preceding" if preceding else "following")
+            out = None
+        elif self.eat_kw("current"):
+            self.expect_kw("row")
+            out = 0
+        else:
+            t = self.next()
+            if t.kind != "num":
+                raise SyntaxError(
+                    f"expected a frame bound at {t.pos}: {t.value!r}"
+                )
+            n = int(t.value)
+            if self.eat_kw("preceding"):
+                out = n if preceding else -n
+            else:
+                self.expect_kw("following")
+                out = -n if preceding else n
+        if preceding:
+            self.expect_kw("and")
+        return out
 
     def parse_case(self) -> Case:
         self.expect_kw("case")

@@ -252,10 +252,11 @@ class Rel:
                       for n, d in order_by)
         specs = tuple(
             win_ops.WindowSpec(
-                f, None if cn is None else self.idx(cn), name,
+                a[1], None if a[2] is None else self.idx(a[2]), a[0],
                 running=running, frame=frame,
+                **({"offset": a[3]} if len(a) > 3 else {}),
             )
-            for name, f, cn in funcs
+            for a in funcs
         )
         node = S.Window(self.plan, pcols, okeys, specs)
         schema = win_ops.window_output_schema(self.schema, specs)
