@@ -309,6 +309,51 @@ AREAS.append(("matrix_cmp", NUMS, _cmp_matrix()))
 AREAS.append(("matrix_order_limit", NUMS, _order_limit_matrix()))
 AREAS.append(("matrix_join", PAIR, _join_matrix()))
 
+def _window_matrix() -> list[tuple[str, str, str]]:
+    """OVER-clause matrix: functions x partitions x frames, ordered by
+    the unique pk inside OVER so sqlite's RANGE default and this engine's
+    ROWS default agree (they differ only on ORDER BY ties)."""
+    out: list[tuple[str, str, str]] = []
+    for fn, types in [("row_number()", "II"), ("rank()", "II"),
+                      ("dense_rank()", "II")]:
+        out.append((types, "",
+                    f"select a, {fn} over (partition by b order by a) "
+                    "from nums order by a"))
+        out.append((types, "",
+                    f"select a, {fn} over (order by a) from nums "
+                    "order by a"))
+    for agg, types in [("sum(a)", "II"), ("count(a)", "II"),
+                       ("min(a)", "II"), ("max(a)", "II"),
+                       ("avg(a)", "IR"), ("sum(f)", "IR"),
+                       ("count(f)", "II")]:
+        out.append((types, "",
+                    f"select a, {agg} over (partition by b order by a) "
+                    "from nums order by a"))
+        out.append((types, "",
+                    f"select a, {agg} over (partition by b) from nums "
+                    "order by a"))
+        out.append((types, "",
+                    f"select a, {agg} over (order by a rows between 2 "
+                    "preceding and current row) from nums order by a"))
+        out.append((types, "",
+                    f"select a, {agg} over (order by a rows between 1 "
+                    "preceding and 1 following) from nums order by a"))
+    for fn in ["lag(a)", "lead(a)", "lag(a, 2)", "lead(a, 2)"]:
+        out.append(("II", "",
+                    f"select a, {fn} over (partition by b order by a) "
+                    "from nums order by a"))
+    out.append(("II", "",
+                "select a, first_value(a) over (partition by b order by a)"
+                " from nums order by a"))
+    out.append(("II", "",
+                "select a, last_value(a) over (partition by b order by a "
+                "rows between unbounded preceding and unbounded following)"
+                " from nums order by a"))
+    return out
+
+
+AREAS.append(("matrix_window", NUMS, _window_matrix()))
+
 AREAS.append(("case_cast_cte", NUMS, [
     ("I", "rowsort",
      "select case when b > 9 then 1 when b is null then -1 else 0 end "
