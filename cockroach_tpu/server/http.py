@@ -127,6 +127,11 @@ class AdminServer:
             })
         return {"jobs": out}
 
+    def statements(self) -> dict:
+        from ..sql import sqlstats
+
+        return {"statements": sqlstats.DEFAULT.rows_payload()}
+
     def settings_payload(self) -> dict:
         return {"settings": {
             name: s.get() for name, s in settings.all_settings().items()
@@ -175,6 +180,8 @@ class AdminServer:
                         self._json(admin.jobs())
                     elif u.path == "/_status/settings":
                         self._json(admin.settings_payload())
+                    elif u.path == "/_status/statements":
+                        self._json(admin.statements())
                     elif u.path == "/ts/query":
                         q = parse_qs(u.query)
                         name = (q.get("name") or [""])[0]

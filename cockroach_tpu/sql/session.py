@@ -113,14 +113,31 @@ class Session:
                 "current transaction is aborted, commands ignored until "
                 "end of transaction block (issue ROLLBACK)"
             )
+        import time as _time
+
+        from . import sqlstats
+
+        t0 = _time.perf_counter()
         try:
-            return self._dispatch(text)
+            out = self._dispatch(text)
         except BaseException:
             # ANY failure inside an explicit block aborts it (postgres /
             # CRDB: subsequent statements are rejected until ROLLBACK)
             if self._txn is not None:
                 self._txn_aborted = True
+            sqlstats.DEFAULT.record(text, _time.perf_counter() - t0, 0,
+                                    error=True)
             raise
+        nrows = 0
+        if isinstance(out, dict) and out:
+            if "rows_affected" in out:  # DML verbs report affected rows
+                nrows = int(out["rows_affected"])
+            else:
+                first = next(iter(out.values()))
+                if hasattr(first, "__len__") and not isinstance(first, str):
+                    nrows = len(first)
+        sqlstats.DEFAULT.record(text, _time.perf_counter() - t0, nrows)
+        return out
 
     def _dispatch(self, text: str):
         handled = self._maybe_settings_stmt(text)
@@ -411,6 +428,21 @@ class Session:
                     [st.cols[n].ndv for n in names]),
                 "null_count": _np.array(
                     [st.cols[n].null_count for n in names]),
+            }
+        if _re.match(r"(?is)^show\s+statements$", t):
+            import numpy as _np
+
+            from . import sqlstats
+
+            rows = sqlstats.DEFAULT.rows_payload()  # one consistent snapshot
+            return {
+                "fingerprint": _np.array(
+                    [r["fingerprint"] for r in rows], dtype=object),
+                "count": _np.array([r["count"] for r in rows]),
+                "mean_ms": _np.array([r["meanMs"] for r in rows]),
+                "max_ms": _np.array([r["maxMs"] for r in rows]),
+                "rows": _np.array([r["rows"] for r in rows]),
+                "errors": _np.array([r["errors"] for r in rows]),
             }
         if _re.match(r"(?is)^show\s+jobs$", t):
             import numpy as _np

@@ -1,0 +1,114 @@
+"""SQL statement statistics — the pkg/sql/sqlstats reduction.
+
+Reference: every executed statement is fingerprinted (literals stripped),
+and per-fingerprint execution counts, latency moments and row counts
+accumulate in an in-memory container surfaced through
+crdb_internal.statement_statistics and the console's SQL activity page.
+
+Reduction: a per-Session (or shared) registry keyed by statement
+fingerprint with count / total / min / max / mean latency and rows
+returned, surfaced through ``SHOW STATEMENTS`` in the session and the
+``/_status/statements`` admin endpoint. Fingerprinting lowercases
+whitespace-normalized SQL and replaces literals with placeholders — the
+reference's constants-removed shape."""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass, field
+
+_NUM = re.compile(r"\b\d+(?:\.\d+)?\b")
+_STR = re.compile(r"'(?:[^']|'')*'")
+_WS = re.compile(r"\s+")
+# collapse IN/VALUES lists so differing row counts share a fingerprint
+_TUPLES = re.compile(r"\(\s*_(?:\s*,\s*_)*\s*\)(?:\s*,\s*\(\s*_(?:\s*,\s*_)*\s*\))*")
+
+
+def fingerprint(sql: str) -> str:
+    """Literals -> '_', whitespace-normalized, lowercased (the
+    reference's statement fingerprint shape)."""
+    s = _STR.sub("_", sql.strip().rstrip(";"))
+    s = _NUM.sub("_", s)
+    s = _WS.sub(" ", s).lower()
+    s = _TUPLES.sub("(_)", s)
+    return s
+
+
+@dataclass
+class StmtStats:
+    fingerprint: str
+    count: int = 0
+    total_s: float = 0.0
+    min_s: float = field(default=float("inf"))
+    max_s: float = 0.0
+    rows: int = 0
+    errors: int = 0
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class StatsRegistry:
+    """Thread-safe per-fingerprint accumulation, capped like the
+    reference's fingerprint memory budget: past `max_fingerprints`
+    distinct entries, the cheapest half (by total time) is evicted —
+    unbounded junk SQL over pgwire must not leak memory forever."""
+
+    def __init__(self, max_fingerprints: int = 5000):
+        self._lock = threading.Lock()
+        self._stats: dict[str, StmtStats] = {}
+        self.max_fingerprints = max_fingerprints
+        self.evicted = 0
+
+    def record(self, sql: str, elapsed_s: float, rows: int,
+               error: bool = False) -> None:
+        fp = fingerprint(sql)
+        with self._lock:
+            st = self._stats.get(fp)
+            if st is None:
+                if len(self._stats) >= self.max_fingerprints:
+                    keep = sorted(self._stats.values(),
+                                  key=lambda s: -s.total_s)
+                    keep = keep[: self.max_fingerprints // 2]
+                    self.evicted += len(self._stats) - len(keep)
+                    self._stats = {s.fingerprint: s for s in keep}
+                st = self._stats[fp] = StmtStats(fp)
+            st.count += 1
+            st.total_s += elapsed_s
+            st.min_s = min(st.min_s, elapsed_s)
+            st.max_s = max(st.max_s, elapsed_s)
+            st.rows += rows
+            if error:
+                st.errors += 1
+
+    def all(self) -> list[StmtStats]:
+        """Snapshot COPIES (consistent under concurrent record())."""
+        import dataclasses
+
+        with self._lock:
+            return sorted(
+                (dataclasses.replace(s) for s in self._stats.values()),
+                key=lambda s: -s.total_s,
+            )
+
+    def rows_payload(self) -> list[dict]:
+        """The one serialization SHOW STATEMENTS and the admin endpoint
+        share (single source for the row shape)."""
+        return [
+            {"fingerprint": s.fingerprint, "count": s.count,
+             "meanMs": round(s.mean_s * 1e3, 3),
+             "maxMs": round(s.max_s * 1e3, 3),
+             "rows": s.rows, "errors": s.errors}
+            for s in self.all()
+        ]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+# process-default registry (Sessions feed it; the admin endpoint reads it —
+# the reference similarly aggregates node-wide)
+DEFAULT = StatsRegistry()

@@ -1,0 +1,100 @@
+"""SQL statement statistics (pkg/sql/sqlstats reduction)."""
+
+from cockroach_tpu.sql import sqlstats
+from cockroach_tpu.sql.session import Session
+from cockroach_tpu.sql.sqlstats import fingerprint
+
+
+def test_fingerprint_strips_literals():
+    assert fingerprint("SELECT * FROM t WHERE a = 5") == \
+        fingerprint("select *  from t where a = 99")
+    assert fingerprint("select 'x' || s from t") == \
+        fingerprint("select 'other''quoted' || s from t")
+    # differing VALUES row counts share one fingerprint
+    assert fingerprint("insert into t values (1, 2)") == \
+        fingerprint("insert into t values (3, 4), (5, 6)")
+    assert fingerprint("select a from t") != fingerprint("select b from t")
+
+
+def test_session_accumulates_statement_stats():
+    sqlstats.DEFAULT.clear()
+    try:
+        sess = Session()
+        sess.execute("create table st (id int primary key, v int)")
+        for i in range(5):
+            sess.execute(f"insert into st values ({i}, {i * 2})")
+        for _ in range(3):
+            sess.execute("select v from st where id = 2")
+        try:
+            sess.execute("select nope from st")
+        except Exception:  # noqa: BLE001
+            pass
+        by_fp = {s.fingerprint: s for s in sqlstats.DEFAULT.all()}
+        ins = by_fp[fingerprint("insert into st values (0, 0)")]
+        assert ins.count == 5 and ins.errors == 0
+        sel = by_fp[fingerprint("select v from st where id = 1")]
+        assert sel.count == 3 and sel.rows == 3  # one row x 3 runs
+        assert sel.mean_s > 0 and sel.max_s >= sel.min_s
+        bad = by_fp[fingerprint("select nope from st")]
+        assert bad.errors == 1
+
+        # SHOW STATEMENTS surfaces them through SQL
+        res = sess.execute("show statements")
+        fps = list(res["fingerprint"])
+        assert fingerprint("select v from st where id = 1") in fps
+    finally:
+        sqlstats.DEFAULT.clear()
+
+
+def test_statements_served_over_admin_http():
+    import json
+    import urllib.request
+
+    from cockroach_tpu.server.node import Node
+
+    sqlstats.DEFAULT.clear()
+    node = Node(node_id=4, heartbeat_interval_s=0.1, ttl_ms=30000)
+    node.start(gossip_port=None, http_port=0, pg_port=0)
+    try:
+        sess = Session(catalog=node._sql_catalog, db=node.db,
+                       bootstrap=False)
+        sess.execute("create table ht (id int primary key)")
+        sess.execute("insert into ht values (1)")
+        sess.execute("select * from ht")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{node.admin.port}/_status/statements",
+            timeout=5,
+        ) as r:
+            sts = json.loads(r.read())["statements"]
+        fps = [s["fingerprint"] for s in sts]
+        assert "select * from ht" in fps
+    finally:
+        node.stop()
+        sqlstats.DEFAULT.clear()
+
+
+def test_registry_caps_fingerprints():
+    r = sqlstats.StatsRegistry(max_fingerprints=10)
+    for i in range(25):
+        r.record(f"select col{i} from t", 0.001 * (i + 1), 1)
+    assert len(r.all()) <= 10
+    assert r.evicted > 0
+    # the most expensive fingerprints survived eviction
+    fps = [s.fingerprint for s in r.all()]
+    assert fingerprint("select col24 from t") in fps
+
+
+def test_dml_rows_counted():
+    sqlstats.DEFAULT.clear()
+    try:
+        sess = Session()
+        sess.execute("create table dr (id int primary key, v int)")
+        sess.execute("insert into dr values (1, 1), (2, 2), (3, 3)")
+        sess.execute("update dr set v = 9 where id < 3")
+        by_fp = {s.fingerprint: s for s in sqlstats.DEFAULT.all()}
+        ins = by_fp[fingerprint("insert into dr values (1, 1)")]
+        assert ins.rows == 3
+        upd = by_fp[fingerprint("update dr set v = 9 where id < 3")]
+        assert upd.rows == 2
+    finally:
+        sqlstats.DEFAULT.clear()
