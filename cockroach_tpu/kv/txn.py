@@ -84,6 +84,7 @@ class Txn:
         try:
             return self.db.engine.get(k, ts=self.read_ts, txn=self.txn_id)
         except WriteIntentError as e:
+            _record_contention(e, self.txn_id)
             raise TransactionRetryError(
                 f"conflicting intent on {e.keys}"
             ) from e
@@ -99,6 +100,7 @@ class Txn:
                 s, e, ts=self.read_ts, txn=self.txn_id, max_keys=max_keys
             )
         except WriteIntentError as err:
+            _record_contention(err, self.txn_id)
             raise TransactionRetryError(
                 f"conflicting intent on {err.keys}"
             ) from err
@@ -119,6 +121,9 @@ class Txn:
         with self.db.engine.mu:
             other = self.db.engine.other_intent(key, self.txn_id)
             if other is not None:
+                _record_contention(
+                    WriteIntentError([key], [other]), self.txn_id
+                )
                 raise TransactionRetryError(
                     f"key {key!r} locked by txn {other}"
                 )
@@ -174,6 +179,17 @@ class Txn:
 
 def _b(x: bytes | str) -> bytes:
     return x.encode() if isinstance(x, str) else bytes(x)
+
+
+def _record_contention(e: WriteIntentError, waiting_txn: int) -> None:
+    """Feed the contention registry (pkg/sql/contention role); never let
+    observability break the conflict path."""
+    try:
+        from .contention import DEFAULT
+
+        DEFAULT.record(e.keys, e.txns, waiting_txn)
+    except Exception:  # pragma: no cover - registry must not mask errors
+        pass
 
 
 class DB:

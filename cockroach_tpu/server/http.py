@@ -182,6 +182,10 @@ class AdminServer:
                         self._json(admin.settings_payload())
                     elif u.path == "/_status/statements":
                         self._json(admin.statements())
+                    elif u.path == "/_status/contention":
+                        from ..kv.contention import DEFAULT as _cont
+
+                        self._json({"events": _cont.rows_payload()})
                     elif u.path == "/ts/query":
                         q = parse_qs(u.query)
                         name = (q.get("name") or [""])[0]

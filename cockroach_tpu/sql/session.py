@@ -444,6 +444,19 @@ class Session:
                 "rows": _np.array([r["rows"] for r in rows]),
                 "errors": _np.array([r["errors"] for r in rows]),
             }
+        if _re.match(r"(?is)^show\s+contention$", t):
+            import numpy as _np
+
+            from ..kv.contention import DEFAULT as _cont
+
+            rows = _cont.rows_payload()
+            return {
+                "key": _np.array([r["key"] for r in rows], dtype=object),
+                "count": _np.array([r["count"] for r in rows]),
+                "last_holder_txn": _np.array(
+                    [r["lastHolderTxn"] for r in rows]),
+                "num_waiters": _np.array([r["numWaiters"] for r in rows]),
+            }
         if _re.match(r"(?is)^show\s+jobs$", t):
             import numpy as _np
 

@@ -98,3 +98,45 @@ def test_dml_rows_counted():
         assert upd.rows == 2
     finally:
         sqlstats.DEFAULT.clear()
+
+
+def test_contention_events_recorded_and_surfaced():
+    """pkg/sql/contention reduction: intent conflicts land in the
+    registry with the real key and holder txn, visible via SHOW
+    CONTENTION."""
+    from cockroach_tpu.kv import DB, Clock
+    from cockroach_tpu.kv.contention import DEFAULT as cont
+    from cockroach_tpu.kv.txn import TransactionRetryError
+    from cockroach_tpu.storage.lsm import Engine
+
+    cont.clear()
+    try:
+        db = DB(Engine(key_width=16, val_width=16), Clock())
+        holder = db.new_txn()
+        holder.put(b"hot", b"x")
+        waiter = db.new_txn()
+        try:
+            waiter.get(b"hot")
+            raise AssertionError("expected conflict")
+        except TransactionRetryError:
+            pass
+        waiter2 = db.new_txn()
+        try:
+            waiter2.put(b"hot", b"y")
+            raise AssertionError("expected conflict")
+        except TransactionRetryError:
+            pass
+        rows = cont.rows_payload()
+        assert rows and rows[0]["key"] == "hot"
+        assert rows[0]["count"] == 2
+        assert rows[0]["lastHolderTxn"] == holder.txn_id
+        assert rows[0]["numWaiters"] == 2
+        holder.rollback()
+        waiter.rollback()
+        waiter2.rollback()
+
+        sess = Session(db=db)
+        res = sess.execute("show contention")
+        assert "hot" in list(res["key"])
+    finally:
+        cont.clear()
