@@ -45,6 +45,8 @@ _CONSOLE_HTML = b"""<!doctype html><html><head>
 <div id="health"></div>
 <h2>nodes</h2><table id="nodes"></table>
 <h2>jobs</h2><table id="jobs"></table>
+<h2>statements</h2><table id="stmts"></table>
+<h2>contention</h2><table id="cont"></table>
 <h2>metrics (/_status/vars)</h2><pre id="vars"></pre>
 <script>
 async function j(p){return (await fetch(p)).json()}
@@ -65,6 +67,18 @@ async function refresh(){
   '<tr><th>id</th><th>type</th><th>state</th><th>node</th></tr>'+
   js.map(x=>`<tr><td>${x.id}</td><td>${x.type}</td>`+
   `<td>${x.state}</td><td>${x.claimNode}</td></tr>`).join('');
+ const ss=(await j('/_status/statements')).statements.slice(0,15);
+ document.getElementById('stmts').innerHTML=
+  '<tr><th>fingerprint</th><th>count</th><th>mean ms</th>'+
+  '<th>rows</th><th>errors</th></tr>'+ss.map(s=>
+  `<tr><td>${s.fingerprint.slice(0,70)}</td><td>${s.count}</td>`+
+  `<td>${s.meanMs}</td><td>${s.rows}</td><td>${s.errors}</td></tr>`
+  ).join('');
+ const ce=(await j('/_status/contention')).events.slice(0,10);
+ document.getElementById('cont').innerHTML=
+  '<tr><th>key</th><th>count</th><th>waiters</th></tr>'+ce.map(e=>
+  `<tr><td>${e.key}</td><td>${e.count}</td>`+
+  `<td>${e.numWaiters}</td></tr>`).join('');
  document.getElementById('vars').textContent=
   await (await fetch('/_status/vars')).text();
 }
