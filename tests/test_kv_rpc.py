@@ -204,3 +204,63 @@ def test_node_dialer_resolves_through_gossip():
     finally:
         n1.stop()
         n2.stop()
+
+
+def test_circuit_breaker_trips_fastfails_and_recovers():
+    """Per-peer breaker (rpc peer-tracking reduction): consecutive dial
+    failures trip it, an open breaker fast-fails without touching the
+    network, and the post-cooldown half-open probe closes it when the
+    peer returns."""
+    import time as _time
+
+    from cockroach_tpu.flow.gossip import Gossip
+    from cockroach_tpu.kv.dialer import (
+        BreakerOpenError,
+        NodeDialer,
+        advertise,
+    )
+
+    g = Gossip(99)
+    db, srv = _srv()
+    advertise(g, 7, srv.addr)
+    dialer = NodeDialer(g, trip_threshold=2, cooldown_s=0.4)
+    # healthy: dial works
+    c = dialer.dial(7)
+    c.put(b"cb", b"1")
+    dialer.report_ok(7)
+    # peer dies: REPORTED RPC failures trip the breaker (connect alone
+    # can neither trip nor reset it — a wedged peer may accept connects)
+    srv.close()
+    dialer.forget(7)
+    for _ in range(2):
+        failed = False
+        try:
+            cc = dialer.dial(7)
+            cc.put(b"x", b"y")  # conn to a closed server fails here
+        except BreakerOpenError:
+            raise AssertionError("breaker tripped too early")
+        except (ConnectionError, OSError, RuntimeError):
+            failed = True
+            dialer.report_failure(7)
+        assert failed, "expected failure against dead peer"
+    assert dialer.breaker_open(7)
+    # open: fast-fail, no network attempt
+    try:
+        dialer.dial(7)
+        raise AssertionError("expected BreakerOpenError")
+    except BreakerOpenError:
+        pass
+    # peer returns on a new port; after the cooldown the half-open probe
+    # succeeds and the breaker closes
+    from cockroach_tpu.kv.rpc import BatchServer
+
+    srv2 = BatchServer(db, port=0)
+    advertise(g, 7, srv2.addr)
+    _time.sleep(0.45)
+    c2 = dialer.dial(7)  # the probe
+    c2.put(b"cb2", b"2")
+    dialer.report_ok(7)
+    assert not dialer.breaker_open(7)
+    assert db.get(b"cb2") == b"2"
+    srv2.close()
+    g.close()
