@@ -43,6 +43,7 @@ class _Breaker:
         self.failures = 0
         self.opened_at: float | None = None
         self.probing = False
+        self.probe_started = 0.0
 
     def admit(self) -> None:
         if self.opened_at is None:
@@ -54,8 +55,12 @@ class _Breaker:
                 f"{self.cooldown_s - since:.1f}s)"
             )
         if self.probing:
-            raise BreakerOpenError("breaker half-open: probe in flight")
+            # a probe whose caller never reported back must not wedge the
+            # breaker forever: after 2x cooldown the slot re-opens
+            if time.monotonic() - self.probe_started < 2 * self.cooldown_s:
+                raise BreakerOpenError("breaker half-open: probe in flight")
         self.probing = True  # this caller IS the probe
+        self.probe_started = time.monotonic()
 
     def probe_aborted(self) -> None:
         """The admitted probe's dial itself failed: free the half-open

@@ -23,6 +23,7 @@ reference's conn-executor-per-session model.
 
 from __future__ import annotations
 
+import re
 import socket
 import struct
 import threading
@@ -72,6 +73,8 @@ class _Conn:
         self.sock = sock
         self.session = session
         self._ext_failed = False  # error sent; discarding until Sync
+        self._stmts: dict[bytes, str] = {}  # prepared statements
+        self._portals: dict[bytes, str] = {}  # bound portals (params inlined)
 
     # -- framing -------------------------------------------------------------
 
@@ -148,14 +151,15 @@ class _Conn:
                 out.append(struct.pack("!i", len(r)) + r)
         self._send(b"D", b"".join(out))
 
-    def _run_query(self, sql_text: str) -> None:
+    def _run_query(self, sql_text: str, send_row_desc: bool = True) -> None:
         res = self.session.execute(sql_text)
         if isinstance(res, dict) and res and all(
             isinstance(v, np.ndarray) for v in res.values()
         ):
             names = list(res.keys())
             nrows = len(res[names[0]]) if names else 0
-            self._row_description(names, [res[n].dtype for n in names])
+            if send_row_desc:  # extended Execute relies on Describe's
+                self._row_description(names, [res[n].dtype for n in names])
             for i in range(nrows):
                 self._data_row([res[n][i] for n in names])
             self._send(b"C", b"SELECT %d\x00" % nrows)
@@ -214,23 +218,172 @@ class _Conn:
                     self._error(f"{type(e).__name__}: {e}",
                                 code=_sqlstate_for(e))
                 self._ready()
-            elif tag in (b"P", b"B", b"D", b"E", b"C", b"F"):
-                # extended protocol not implemented: ONE ErrorResponse per
-                # failed batch, then discard messages until Sync (the
-                # protocol's error-recovery rule — a second error before
+            elif tag in (b"P", b"B", b"D", b"E", b"C"):
+                # extended protocol (Parse/Bind/Describe/Execute/Close):
+                # on ANY failure send ONE ErrorResponse then discard until
+                # Sync (the error-recovery rule — a second error before
                 # Sync would desync pipeline-mode clients' result queues)
+                try:
+                    self._extended(tag, body)
+                except Exception as e:
+                    self._ext_failed = True
+                    self._error(f"{type(e).__name__}: {e}",
+                                code=_sqlstate_for(e))
+            elif tag == b"F":
                 if not self._ext_failed:
                     self._ext_failed = True
-                    self._error("extended query protocol not supported; "
-                                "use simple query mode", code="0A000")
+                    self._error("FunctionCall is not supported",
+                                code="0A000")
             elif tag == b"H":  # Flush: nothing buffered, nothing to do
                 pass
-            elif tag == b"S":  # Sync ends the (failed) extended batch
+            elif tag == b"S":  # Sync ends the extended batch
                 self._ext_failed = False
                 self._ready()
             else:
                 self._error(f"unknown message {tag!r}")
                 self._ready()
+
+    # -- extended protocol ---------------------------------------------------
+
+    @staticmethod
+    def _cstr(body: bytes, off: int) -> tuple[str, int]:
+        end = body.index(b"\x00", off)
+        return body[off:end].decode("utf-8", "replace"), end + 1
+
+    def _extended(self, tag: bytes, body: bytes) -> None:
+        if tag == b"P":  # Parse: name, query, param-type oids
+            name, off = self._cstr(body, 0)
+            query, off = self._cstr(body, off)
+            self._stmts[name.encode()] = query
+            self._send(b"1", b"")  # ParseComplete
+        elif tag == b"B":  # Bind: portal, stmt, formats, params
+            portal, off = self._cstr(body, 0)
+            stmt, off = self._cstr(body, off)
+            nfmt = struct.unpack_from("!H", body, off)[0]
+            fmts = struct.unpack_from("!%dH" % nfmt, body, off + 2)
+            off += 2 + 2 * nfmt
+            nparams = struct.unpack_from("!H", body, off)[0]
+            off += 2
+            params: list[str | None] = []
+            for i in range(nparams):
+                plen = struct.unpack_from("!i", body, off)[0]
+                off += 4
+                if plen < 0:
+                    params.append(None)
+                    continue
+                fmt = fmts[i] if i < len(fmts) else (
+                    fmts[0] if len(fmts) == 1 else 0)
+                if fmt != 0:
+                    raise ValueError(
+                        "binary parameter format is not supported "
+                        "(send text format)"
+                    )
+                params.append(body[off:off + plen].decode("utf-8"))
+                off += plen
+            # trailing result-format codes: binary results are not
+            # implemented — reject loudly rather than sending text bytes
+            # a binary-mode client would decode as garbage
+            if off + 2 <= len(body):
+                nrf = struct.unpack_from("!H", body, off)[0]
+                rfmts = struct.unpack_from("!%dH" % nrf, body, off + 2)
+                if any(f != 0 for f in rfmts):
+                    raise ValueError(
+                        "binary result format is not supported "
+                        "(request text format)"
+                    )
+            sql = self._stmts.get(stmt.encode())
+            if sql is None:
+                raise ValueError(f"unknown prepared statement {stmt!r}")
+            self._portals[portal.encode()] = _inline_params(sql, params)
+            self._send(b"2", b"")  # BindComplete
+        elif tag == b"D":  # Describe 'S'|'P' + name
+            kind, name = body[:1], body[1:].rstrip(b"\x00")
+            sql = (self._stmts.get(name) if kind == b"S"
+                   else self._portals.get(name))
+            if sql is None:
+                raise ValueError(f"unknown {kind!r} to describe: {name!r}")
+            nparams = max(
+                (int(m.group(1)) for m in _PLACEHOLDER.finditer(sql)),
+                default=0,
+            )
+            if kind == b"S":
+                # ParameterDescription is mandatory for statement
+                # describes; oid 0 = unspecified (clients send text)
+                self._send(b"t", struct.pack("!H", nparams)
+                           + struct.pack("!I", 0) * nparams)
+                # plan the schema with placeholders as NULLs
+                sql = _inline_params(sql, [None] * nparams)
+            schema = self._plan_schema(sql)
+            if schema is None:
+                self._send(b"n", b"")  # NoData (DML/DDL)
+            else:
+                names, dtypes = schema
+                self._row_description(names, dtypes)
+        elif tag == b"E":  # Execute: portal, row limit (ignored: full)
+            portal, off = self._cstr(body, 0)
+            sql = self._portals.get(portal.encode())
+            if sql is None:
+                raise ValueError(f"unknown portal {portal!r}")
+            # extended-protocol Execute sends DataRows WITHOUT a
+            # RowDescription (clients got it from Describe)
+            self._run_query(sql, send_row_desc=False)
+        elif tag == b"C":  # Close 'S'|'P' + name
+            kind, name = body[:1], body[1:].rstrip(b"\x00")
+            (self._stmts if kind == b"S" else self._portals).pop(name, None)
+            self._send(b"3", b"")  # CloseComplete
+
+    def _plan_schema(self, sql: str):
+        """(names, dtypes) for a SELECT by BINDING (not running) it —
+        Describe must answer before Execute. Non-SELECTs: None (NoData)."""
+        from ..coldata.types import Family as F
+        from ..sql import parser as P
+        from ..sql.binder import Binder
+
+        try:
+            stmt = P.parse_statement(sql)
+        except Exception:
+            return None
+        if not isinstance(stmt, P.Select):
+            return None
+        rel = Binder(self.session.catalog).bind(stmt)
+        dtypes = []
+        for t in rel.schema.types:
+            if t.family is F.BOOL:
+                dtypes.append(np.dtype(np.bool_))
+            elif t.family in (F.INT, F.DATE):
+                dtypes.append(np.dtype(np.int64))
+            elif t.family in (F.FLOAT, F.DECIMAL):
+                dtypes.append(np.dtype(np.float64))
+            else:
+                dtypes.append(np.dtype(object))
+        return list(rel.schema.names), dtypes
+
+
+_NUMERIC_PARAM = re.compile(r"^-?\d+(\.\d+)?$")
+_PLACEHOLDER = re.compile(r"\$(\d+)")
+
+
+def _inline_params(sql: str, params: list) -> str:
+    """Substitute $1..$n with SQL literals (text-format params): numeric-
+    looking values inline bare (placeholder type inference by value
+    shape — the reference infers from context; divergence documented),
+    strings quote with '' escaping, None becomes NULL. ONE regex pass —
+    sequential replacement would re-substitute placeholders appearing
+    inside earlier parameter VALUES."""
+    def lit(m: re.Match) -> str:
+        i = int(m.group(1))
+        if not 1 <= i <= len(params):
+            raise ValueError(f"no parameter bound for ${i}")
+        v = params[i - 1]
+        if v is None:
+            return "null"
+        if _NUMERIC_PARAM.match(v):
+            return v
+        if v.lower() in ("true", "false"):
+            return v.lower()
+        return "'" + v.replace("'", "''") + "'"
+
+    return _PLACEHOLDER.sub(lit, sql)
 
 
 def _sqlstate_for(e: Exception) -> str:

@@ -165,3 +165,131 @@ def test_pgwire_through_node_lifecycle():
         c.close()
     finally:
         node.stop()
+
+
+class MiniPgExt(MiniPg):
+    """Extended-protocol messages (Parse/Bind/Describe/Execute/Sync)."""
+
+    def _send_msg(self, tag: bytes, body: bytes):
+        self.sock.sendall(tag + struct.pack("!I", len(body) + 4) + body)
+
+    def prepare(self, name: str, sql: str):
+        self._send_msg(b"P", name.encode() + b"\x00" + sql.encode()
+                       + b"\x00" + struct.pack("!H", 0))
+
+    def bind(self, portal: str, stmt: str, params: list):
+        body = portal.encode() + b"\x00" + stmt.encode() + b"\x00"
+        body += struct.pack("!H", 1) + struct.pack("!H", 0)  # all text
+        body += struct.pack("!H", len(params))
+        for p in params:
+            if p is None:
+                body += struct.pack("!i", -1)
+            else:
+                pb = str(p).encode()
+                body += struct.pack("!i", len(pb)) + pb
+        body += struct.pack("!H", 0)  # result formats: default text
+        self._send_msg(b"B", body)
+
+    def describe_portal(self, portal: str):
+        self._send_msg(b"D", b"P" + portal.encode() + b"\x00")
+
+    def execute(self, portal: str):
+        self._send_msg(b"E", portal.encode() + b"\x00"
+                       + struct.pack("!i", 0))
+
+    def sync(self):
+        self._send_msg(b"S", b"")
+        return self._drain_until_ready()
+
+
+def test_pgwire_extended_protocol(server):
+    c = MiniPgExt(server.addr)
+    try:
+        c.query("create table ep (id int primary key, v int, s string)")
+        c.query("insert into ep values (1, 10, 'a'), (2, 20, 'b'),"
+                " (3, 30, 'it''s')")
+        # Parse/Bind/Describe/Execute with int + string parameters
+        c.prepare("sel", "select id, v, s from ep where v > $1 and s <> $2"
+                         " order by id")
+        c.bind("", "sel", ["15", "zzz"])
+        c.describe_portal("")
+        c.execute("")
+        msgs = c.sync()
+        tags = [t for t, _ in msgs]
+        assert b"1" in tags and b"2" in tags  # Parse/BindComplete
+        assert b"T" in tags  # RowDescription from Describe
+        drows = [b for t, b in msgs if t == b"D"]
+        assert len(drows) == 2  # v in (20, 30)
+        assert b"E" not in tags
+        # RowDescription came ONLY from Describe, before the DataRows
+        assert tags.index(b"T") < tags.index(b"D")
+
+        # rebind same statement with different params (incl. quote escape)
+        c.bind("", "sel", ["0", "it's"])
+        c.execute("")
+        msgs = c.sync()
+        drows = [b for t, b in msgs if t == b"D"]
+        assert len(drows) == 2  # id 1 and 2 (id 3's s matches $2)
+
+        # NULL parameter: v > NULL matches nothing
+        c.bind("", "sel", [None, "zzz"])
+        c.execute("")
+        msgs = c.sync()
+        assert [b for t, b in msgs if t == b"D"] == []
+
+        # DML through the extended path + NoData describe
+        c.prepare("ins", "insert into ep values ($1, $2, $3)")
+        c.bind("", "ins", ["4", "40", "d"])
+        c.describe_portal("")
+        c.execute("")
+        msgs = c.sync()
+        tags = [t for t, _ in msgs]
+        assert b"n" in tags  # NoData
+        assert any(t == b"C" and b"INSERT" in b for t, b in msgs)
+        rows, _, _, _ = c.query("select count(*) as n from ep")
+        assert rows == [["4"]]
+
+        # error recovery: unknown portal fails ONCE, Sync recovers
+        c.execute("nope")
+        c.execute("nope")  # discarded (post-error, pre-Sync)
+        msgs = c.sync()
+        errs = [b for t, b in msgs if t == b"E"]
+        assert len(errs) == 1
+        rows, _, _, err = c.query(
+            "select count(*) as one from ep where id = 1")
+        assert err is None and rows == [["1"]]
+    finally:
+        c.close()
+
+
+def test_pgwire_describe_statement_and_param_edge_cases(server):
+    c = MiniPgExt(server.addr)
+    try:
+        c.query("create table dx (id int primary key, s string)")
+        c.query("insert into dx values (1, 'a')")
+        # Describe STATEMENT: ParameterDescription then RowDescription
+        c.prepare("ds", "select id, s from dx where id = $1")
+        c._send_msg(b"D", b"Sds\x00")
+        msgs = c.sync()
+        tags = [t for t, _ in msgs]
+        assert b"t" in tags and b"T" in tags
+        tbody = next(b for t, b in msgs if t == b"t")
+        assert struct.unpack("!H", tbody[:2])[0] == 1  # one placeholder
+        # a param VALUE containing '$1' must not be re-substituted
+        c.prepare("p2", "select id from dx where s <> $1 and s <> $2")
+        c.bind("", "p2", ["x", "$1"])
+        c.execute("")
+        msgs = c.sync()
+        assert len([b for t, b in msgs if t == b"D"]) == 1
+        assert not any(t == b"E" for t, _ in msgs)
+        # binary result format is rejected, not silently mis-encoded
+        body = (b"\x00" + b"p2\x00" + struct.pack("!H", 0)
+                + struct.pack("!H", 2)
+                + struct.pack("!i", 1) + b"x"
+                + struct.pack("!i", 1) + b"y"
+                + struct.pack("!HH", 1, 1))  # result format: binary
+        c._send_msg(b"B", body)
+        msgs = c.sync()
+        assert any(t == b"E" and b"binary result" in b for t, b in msgs)
+    finally:
+        c.close()
