@@ -135,6 +135,20 @@ class ChainOp(ReplayOp):
         return self.rest.next_batch() if b is None else b
 
 
+
+def make_bucket_fn(schema: Schema, keys, tables, nparts: int):
+    """Jitted per-row partition id from the key columns' 64-bit hash —
+    THE Grace partition function, shared by the external join and
+    aggregation so their partitioning can never diverge."""
+    def fn(b: Batch):
+        cols = [b.cols[i] for i in keys]
+        types = [schema.types[i] for i in keys]
+        h = hash_columns(cols, types, tables or None)
+        return (h % np.uint64(nparts)).astype(jnp.int32)
+
+    return jax.jit(fn)
+
+
 # ---------------------------------------------------------------------------
 # Grace hash join
 
@@ -186,22 +200,13 @@ class GraceHashJoinOp(OneInputOperator):
         self._pending = []
         if hasattr(self, "_bucket_probe"):
             return
-        P = self.nparts
-
-        def mk_bucket(schema, keys, tables):
-            def fn(b: Batch):
-                cols = [b.cols[i] for i in keys]
-                types = [schema.types[i] for i in keys]
-                h = hash_columns(cols, types, tables or None)
-                return (h % np.uint64(P)).astype(jnp.int32)
-
-            return jax.jit(fn)
-
-        self._bucket_probe = mk_bucket(
-            self.child.output_schema, self.probe_keys, self.probe_hash_tables
+        self._bucket_probe = make_bucket_fn(
+            self.child.output_schema, self.probe_keys,
+            self.probe_hash_tables, self.nparts,
         )
-        self._bucket_build = mk_bucket(
-            self.build.output_schema, self.build_keys, self.build_hash_tables
+        self._bucket_build = make_bucket_fn(
+            self.build.output_schema, self.build_keys,
+            self.build_hash_tables, self.nparts,
         )
 
     def _partition_all(self):
@@ -413,3 +418,94 @@ class ExternalSortOp(OneInputOperator):
             if b is not None:
                 return self._sort_fn(b)
         return None
+
+
+# ---------------------------------------------------------------------------
+# Grace external aggregation (external_hash_aggregator.go role) — also the
+# external DISTINCT, which is aggregation with no aggregate functions
+
+
+class GraceAggregateOp(Operator):
+    """External aggregation over partial-STATE tiles: rows partition by
+    group-key hash, so partitions are GROUP-DISJOINT — each merges and
+    finalizes independently and streams out one batch at a time, bounding
+    memory by the largest partition instead of the full group count
+    (hash_based_partitioner.go recursion is unnecessary here because the
+    merge stage re-aggregates: a skewed partition still shrinks to its
+    distinct groups).
+
+    Built by AggregateOp's spill handoff: `child` replays the spooled
+    state tiles then continues the live partial stream (ChainOp)."""
+
+    def __init__(self, child: Operator, agg_op, nparts: int = 8):
+        super().__init__()
+        # zero group keys never reach here (no-GROUP-BY plans use
+        # ScalarAggregateOp); partitioning without keys would duplicate
+        # every row into all partitions
+        assert agg_op.num_keys > 0, "Grace aggregation needs group keys"
+        self.child = child
+        self.agg = agg_op  # the spilling AggregateOp (owns merge/finalize)
+        self.nparts = nparts
+        self.output_schema = agg_op.output_schema
+        self.dictionaries = dict(agg_op.dictionaries)
+        self.col_stats = dict(agg_op.col_stats)
+
+    def children(self):
+        return [self.child]
+
+    def init(self):
+        self._parts = None
+        self._pid = 0
+        self._initialized = True
+        if hasattr(self, "_bucket"):
+            return
+        schema = self.agg.state_schema
+        keys = tuple(range(self.agg.num_keys))
+        tables = {
+            pos: d.hashes
+            for pos, d in self.agg.dictionaries.items()
+            if pos < self.agg.num_keys
+        }
+        self._bucket = make_bucket_fn(schema, keys, tables, self.nparts)
+
+    def _stage_all(self):
+        from ..utils import log, metric
+
+        parts = HostPartitions(self.agg.state_schema, self.nparts)
+        n_tiles = 0
+        while True:
+            b = self.child.next_batch()
+            if b is None:
+                break
+            n_tiles += 1
+            pids = np.asarray(self._bucket(b))
+            stage_batch(b, self.agg.state_schema, pids, parts)
+        metric.EXTERNAL_AGG_SPILLS.inc()
+        log.info(log.SQL_EXEC, "aggregation spilled to Grace partitions",
+                 tiles=n_tiles, partitions=self.nparts,
+                 rows=sum(parts.rows))
+        self._parts = parts
+
+    def _next(self):
+        if self._parts is None:
+            self._stage_all()
+        while self._pid < self.nparts:
+            pid = self._pid
+            self._pid += 1
+            batch = self._parts.reload(pid)
+            self._parts.parts[pid] = []  # free as we go
+            if batch is None:
+                continue
+            cap = batch.capacity
+            merged, ng = self.agg._merge_fn((batch,), cap=cap)
+            while int(ng) > cap:
+                cap = _pow2(int(ng) + 1)
+                merged, ng = self.agg._merge_fn((batch,), cap=cap)
+            if self.agg.mode == "partial":
+                return merged
+            return self.agg._finalize_fn(merged)
+        return None
+
+    def close(self):
+        self.child.close()
+        self._parts = None

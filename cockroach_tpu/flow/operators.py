@@ -557,6 +557,7 @@ class AggregateOp(OneInputOperator):
         super().init()
         self._tiles: list[Batch] = []
         self._emitted = False
+        self._external = None
         self._sagg_rows = {j: {} for j, _ in self._sagg}
         if hasattr(self, "_partial_fn"):
             return
@@ -632,7 +633,8 @@ class AggregateOp(OneInputOperator):
             source = gen()
         else:
             source = _consume(self, "partial", tile_raw, tile_jit)
-        for part in source:
+        source_it = iter(source)
+        for part in source_it:
             self._tiles.append(part)
             spooled += part.capacity
             spooled_bytes += batch_bytes(part)
@@ -640,6 +642,29 @@ class AggregateOp(OneInputOperator):
                 self._tiles = [self._merge_down()]
                 spooled = self._tiles[0].capacity
                 spooled_bytes = batch_bytes(self._tiles[0])
+                if ((spooled > budget or spooled_bytes > byte_budget)
+                        and not self._sagg):
+                    # merge-down didn't shrink below budget: the GROUP
+                    # COUNT itself exceeds memory. Hand the spooled state
+                    # tiles + the rest of the partial stream to the Grace
+                    # external aggregator (disk_spiller.go's swap;
+                    # external_hash_aggregator.go role)
+                    from .external import ChainOp, GraceAggregateOp
+
+                    class _Rest:
+                        def next_batch(_self):
+                            return next(source_it, None)
+
+                        def close(_self):
+                            pass
+
+                    chain = ChainOp(self._tiles, self.state_schema,
+                                    self.dictionaries, _Rest())
+                    chain.init()
+                    self._external = GraceAggregateOp(chain, self)
+                    self._external.init()
+                    self._tiles = []
+                    return
 
     # -- string_agg host path ------------------------------------------------
 
@@ -723,9 +748,13 @@ class AggregateOp(OneInputOperator):
         return merged
 
     def _next(self):
+        if self._external is not None:
+            return self._external.next_batch()  # spilled: stream partitions
         if self._emitted:
             return None
         self._spool()
+        if self._external is not None:
+            return self._external.next_batch()
         self._emitted = True
         if not self._tiles:
             return None

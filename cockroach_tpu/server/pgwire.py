@@ -302,10 +302,7 @@ class _Conn:
                    else self._portals.get(name))
             if sql is None:
                 raise ValueError(f"unknown {kind!r} to describe: {name!r}")
-            nparams = max(
-                (int(m.group(1)) for m in _PLACEHOLDER.finditer(sql)),
-                default=0,
-            )
+            nparams = _count_placeholders(sql)
             if kind == b"S":
                 # ParameterDescription is mandatory for statement
                 # describes; oid 0 = unspecified (clients send text)
@@ -361,15 +358,37 @@ class _Conn:
 
 _NUMERIC_PARAM = re.compile(r"^-?\d+(\.\d+)?$")
 _PLACEHOLDER = re.compile(r"\$(\d+)")
+_SQL_LITERAL = re.compile(r"'(?:[^']|'')*'")
+
+
+def _outside_literals(sql: str):
+    """Yield (is_literal, segment) pairs — $n inside a quoted SQL string
+    is literal text, never a placeholder."""
+    last = 0
+    for m in _SQL_LITERAL.finditer(sql):
+        yield False, sql[last:m.start()]
+        yield True, m.group(0)
+        last = m.end()
+    yield False, sql[last:]
+
+
+def _count_placeholders(sql: str) -> int:
+    return max(
+        (int(m.group(1))
+         for lit, seg in _outside_literals(sql) if not lit
+         for m in _PLACEHOLDER.finditer(seg)),
+        default=0,
+    )
 
 
 def _inline_params(sql: str, params: list) -> str:
     """Substitute $1..$n with SQL literals (text-format params): numeric-
     looking values inline bare (placeholder type inference by value
     shape — the reference infers from context; divergence documented),
-    strings quote with '' escaping, None becomes NULL. ONE regex pass —
-    sequential replacement would re-substitute placeholders appearing
-    inside earlier parameter VALUES."""
+    strings quote with '' escaping, None becomes NULL. ONE regex pass
+    over the NON-LITERAL segments only — sequential replacement would
+    re-substitute placeholders appearing inside parameter values, and a
+    '$n' inside a quoted literal is just text."""
     def lit(m: re.Match) -> str:
         i = int(m.group(1))
         if not 1 <= i <= len(params):
@@ -383,7 +402,10 @@ def _inline_params(sql: str, params: list) -> str:
             return v.lower()
         return "'" + v.replace("'", "''") + "'"
 
-    return _PLACEHOLDER.sub(lit, sql)
+    return "".join(
+        seg if is_lit else _PLACEHOLDER.sub(lit, seg)
+        for is_lit, seg in _outside_literals(sql)
+    )
 
 
 def _sqlstate_for(e: Exception) -> str:

@@ -143,5 +143,7 @@ TXN_RETRIES = DEFAULT.counter("txn_retries", "transaction retries")
 RANGE_SPLITS = DEFAULT.counter("range_splits", "admin range splits")
 BLOOM_SKIPS = DEFAULT.counter(
     "storage_bloom_skips", "runs skipped by bloom filters on point reads")
+EXTERNAL_AGG_SPILLS = DEFAULT.counter(
+    "sql_external_agg_spills", "aggregations spilled to Grace partitions")
 RANGE_MOVES = DEFAULT.counter(
     "range_moves", "range relocations between stores")

@@ -293,3 +293,19 @@ def test_pgwire_describe_statement_and_param_edge_cases(server):
         assert any(t == b"E" and b"binary result" in b for t, b in msgs)
     finally:
         c.close()
+
+
+def test_placeholder_inside_string_literal_is_text(server):
+    c = MiniPgExt(server.addr)
+    try:
+        c.query("create table lt (id int primary key, s string)")
+        c.query("insert into lt values (1, 'a$1b'), (2, 'x')")
+        # '$1' inside the prepared SQL's literal is TEXT, not a param
+        c.prepare("q", "select id from lt where s = 'a$1b' and id = $1")
+        c.bind("", "q", ["1"])
+        c.execute("")
+        msgs = c.sync()
+        assert len([b for t, b in msgs if t == b"D"]) == 1
+        assert not any(t == b"E" for t, _ in msgs)
+    finally:
+        c.close()
