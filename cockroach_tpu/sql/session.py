@@ -143,6 +143,8 @@ class Session:
         handled = self._maybe_settings_stmt(text)
         if handled is None:
             handled = self._maybe_admin_stmt(text)
+        if handled is None:
+            handled = self._maybe_session_var_stmt(text)
         if handled is not None:
             return handled
         stmt = P.parse_statement(text)
@@ -167,6 +169,50 @@ class Session:
         if isinstance(stmt, P.Delete):
             return self._delete(stmt)
         raise BindError(f"unsupported statement {type(stmt).__name__}")
+
+    # session variables (sessiondata vars.go role): drivers SET these at
+    # connect time (extra_float_digits, application_name, ...); SET stores
+    # any name tolerantly so every driver's startup script succeeds, SHOW
+    # answers known vars and stored ones
+    _SESSION_VAR_DEFAULTS = {
+        "application_name": "",
+        "client_encoding": "UTF8",
+        "extra_float_digits": "3",
+        "search_path": "public",
+        "statement_timeout": "0",
+        "timezone": "UTC",
+        "datestyle": "ISO",
+        "vectorize": "on",
+        "distsql": "auto",
+    }
+
+    def _maybe_session_var_stmt(self, text: str):
+        import re as _re
+
+        import numpy as _np
+
+        t = text.strip().rstrip(";")
+        m = _re.match(
+            r"(?is)^set\s+(?:session\s+)?([a-z_][a-z0-9_]*)\s*"
+            r"(?:=|\s+to\s+)\s*(.+)$", t)
+        if m and m.group(1).lower() not in ("cluster",):
+            name = m.group(1).lower()
+            raw = m.group(2).strip().strip("'\"")
+            if not hasattr(self, "_session_vars"):
+                self._session_vars = {}
+            self._session_vars[name] = raw
+            return {"set": name}
+        m = _re.match(r"(?is)^show\s+([a-z_][a-z0-9_]*)$", t)
+        if m:
+            name = m.group(1).lower()
+            vars_ = getattr(self, "_session_vars", {})
+            if (name not in vars_
+                    and name not in self._SESSION_VAR_DEFAULTS):
+                raise BindError(f"unrecognized configuration parameter "
+                                f"{name!r}")
+            val = vars_.get(name, self._SESSION_VAR_DEFAULTS.get(name, ""))
+            return {name: _np.array([val], dtype=object)}
+        return None
 
     # -- explicit transactions (the conn_executor txn state machine,
     # reference: pkg/sql/conn_executor.go:2323 + conn_fsm.go, reduced to

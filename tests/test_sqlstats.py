@@ -140,3 +140,28 @@ def test_contention_events_recorded_and_surfaced():
         assert "hot" in list(res["key"])
     finally:
         cont.clear()
+
+
+def test_session_variables_set_show():
+    """sessiondata vars (vars.go role): driver startup SETs succeed,
+    SHOW answers defaults and stored values, unknown SHOW errors."""
+    sess = Session()
+    assert sess.execute("set extra_float_digits = 3") == {
+        "set": "extra_float_digits"}
+    assert sess.execute("SET application_name TO 'myapp'") == {
+        "set": "application_name"}
+    assert list(sess.execute("show application_name")[
+        "application_name"]) == ["myapp"]
+    assert list(sess.execute("show timezone")["timezone"]) == ["UTC"]
+    # tolerant SET of an unknown var (drivers send dialect-specific ones)
+    sess.execute("set random_driver_knob = 'x'")
+    assert list(sess.execute("show random_driver_knob")[
+        "random_driver_knob"]) == ["x"]
+    try:
+        sess.execute("show never_set_unknown")
+        raise AssertionError("expected unknown-parameter error")
+    except Exception as e:  # noqa: BLE001
+        assert "unrecognized" in str(e)
+    # cluster settings still route to their own handler
+    out = sess.execute("show cluster setting sql.distsql.max_fused_joins")
+    assert list(out["variable"]) == ["sql.distsql.max_fused_joins"]
