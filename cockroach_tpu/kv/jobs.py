@@ -334,10 +334,16 @@ def register_import_job(registry: Registry, catalog) -> None:
     from ..coldata.types import Family
 
     def import_resume(reg: Registry, job: Job):
+        import io
+
+        from ..utils.external_storage import from_uri
+
         table = catalog.tables[job.payload["table"]]
-        path = job.payload["path"]
-        with open(path, newline="") as f:
-            rows = list(_csv.DictReader(f))
+        # URI destinations (nodelocal://, file://, plain paths) read
+        # through the ExternalStorage registry (pkg/cloud role)
+        storage, path = from_uri(job.payload["path"])
+        data = storage.read_file(path).decode("utf-8")
+        rows = list(_csv.DictReader(io.StringIO(data, newline="")))
         cols: dict[str, np.ndarray] = {}
         valids: dict[str, np.ndarray] = {}
         for name, t in zip(table.schema.names, table.schema.types):

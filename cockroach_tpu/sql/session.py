@@ -475,6 +475,32 @@ class Session:
                 "null_count": _np.array(
                     [st.cols[n].null_count for n in names]),
             }
+        if _re.match(r"(?is)^show\s+ranges$", t):
+            import numpy as _np
+
+            descs = []
+            meta = getattr(self.db.engine, "meta", None)
+            if meta is not None:  # DistSender-backed: real descriptors
+                descs = meta.snapshot()
+            if descs:
+                return {
+                    "range_id": _np.array([d.range_id for d in descs]),
+                    "start_key": _np.array(
+                        [d.start_key.decode("utf-8", "replace")
+                         for d in descs], dtype=object),
+                    "end_key": _np.array(
+                        [(d.end_key.decode("utf-8", "replace")
+                          if d.end_key is not None else "") for d in descs],
+                        dtype=object),
+                    "store_id": _np.array([d.store_id for d in descs]),
+                }
+            # single-store DB: one whole-keyspace range (store 1)
+            return {
+                "range_id": _np.array([1]),
+                "start_key": _np.array([""], dtype=object),
+                "end_key": _np.array([""], dtype=object),
+                "store_id": _np.array([1]),
+            }
         if _re.match(r"(?is)^show\s+statements$", t):
             import numpy as _np
 

@@ -312,3 +312,26 @@ def test_kvnemesis_with_splits_and_moves():
     assert len(meta.snapshot()) > 3  # splits actually happened
     moved = {d.store_id for d in meta.snapshot()}
     assert len(moved) > 1  # ranges actually live on multiple stores
+
+
+def test_show_ranges_through_sql():
+    """SHOW RANGES reflects the Meta descriptor table on a DistSender-
+    backed session (and a synthetic whole-keyspace range otherwise)."""
+    from cockroach_tpu.sql.session import Session
+
+    meta = Meta(first_store=1)
+    kw = dict(key_width=16, val_width=128, memtable_size=256)
+    stores = [Store(1, meta, **kw), Store(2, meta, **kw)]
+    ds = DistSender(stores, meta)
+    sess = Session(db=DB(ds, Clock()))
+    sess.execute("create table rr (id int primary key)")
+    sess.execute("insert into rr values (1), (2)")
+    ds.split_at(b"\x05")
+    ds.move_range(meta.lookup(b"\x05").range_id, 2)
+    res = sess.execute("show ranges")
+    assert list(res["range_id"]) == [1, 2]
+    assert list(res["store_id"]) == [1, 2]
+
+    plain = Session()
+    res = plain.execute("show ranges")
+    assert list(res["range_id"]) == [1]
