@@ -287,6 +287,10 @@ class Engine:
         from .. utils.admission import IOGovernor
 
         self.governor = IOGovernor(self)
+        # compaction merge kernel override: None = follow the
+        # storage.pallas_merge setting; True/False force it (tests)
+        self.pallas_merge: bool | None = None
+        self._pallas_merge_interpret = False
         self.mem = _Memtable()
         self.runs: list[mvcc.KVBlock] = []  # sorted device runs, newest first
         self.stats = MVCCStats()
@@ -670,7 +674,7 @@ class Engine:
             picked = sorted(by_size[: max(2, self.compact_width)])
         blocks = tuple(self.runs[i] for i in picked)
         total = sum(r.capacity for r in blocks)
-        merged = mvcc.merge_blocks(blocks, cap=_pad(total))
+        merged = self._merge_for_compaction(blocks, total)
         keep = mvcc.mvcc_gc_filter(merged, jnp.int64(self.gc_ts), bottom)
         merged = mvcc.KVBlock(
             key=merged.key, ts=merged.ts, seq=merged.seq, txn=merged.txn,
@@ -690,6 +694,29 @@ class Engine:
         log.debug(log.STORAGE, "compaction", runs=len(self.runs),
                   bottom=bottom)
         self.stats.runs = len(self.runs)
+
+    def _merge_for_compaction(self, blocks, total: int) -> mvcc.KVBlock:
+        """Pick the compaction merge: the bitonic-merge Pallas kernel
+        (pallas_merge.py — pebble mergingIter role, log2(N) stages over
+        pre-sorted runs) when enabled and VMEM-sized, else concat+sort.
+        Kernel output capacity is the padded power of two; the post-GC
+        sort+_shrink in compact() trims it either way."""
+        import jax
+
+        from ..utils import settings
+        from . import pallas_merge as pm
+
+        use = self.pallas_merge
+        if use is None:
+            mode = settings.get("storage.pallas_merge")
+            use = mode == "on" or (
+                mode == "auto" and jax.default_backend() == "tpu"
+            )
+        if use and self.key_width == 16 and pm.eligible(blocks):
+            interpret = (self._pallas_merge_interpret
+                         or jax.default_backend() == "cpu")
+            return pm.merge_runs(blocks, interpret=interpret)
+        return mvcc.merge_blocks(blocks, cap=_pad(total))
 
     # -- read views ---------------------------------------------------------
 

@@ -1,0 +1,237 @@
+"""Pallas bitonic-merge — the LSM compaction k-way merge as a TPU kernel.
+
+North-star kernel #2 (BASELINE.json): "Pebble's LSM compaction k-way merge
+… become Pallas kernels". The reference merges K sorted SST runs with a
+loser-tree of iterators advanced one KV at a time (pebble mergingIter;
+consumed by the compaction loop). The portable engine path instead re-sorts
+the concatenation (`mvcc.merge_blocks` -> `lax.sort`), paying the full
+O(log^2 N) sorting-network depth and ignoring that every input run is
+already sorted.
+
+This kernel exploits the pre-sortedness: two sorted runs, with the second
+reversed, form a BITONIC sequence, and a bitonic sequence sorts in log2(N)
+compare-exchange stages (Batcher's bitonic merge network) instead of a full
+sort's ~log2(N)^2/2. K runs merge as a pairwise tournament: log2(K) rounds
+of 2-way merges, each a single VMEM-resident kernel launch.
+
+Layout notes (mirrors pallas_scan.py):
+- the flat N-row merge view is shaped [N//128, 128] (lane-major); a
+  compare-exchange at stride s is a lane shift (s < 128) or a sublane-row
+  shift (s >= 128) — both pad+concat selects, no gathers;
+- the composite MVCC sort key (live-first, key words asc, ts desc, seq
+  desc — exactly `mvcc._mvcc_sort_operands`) rides as i32 hi/lo planes;
+  ordering composes from unsigned 32-bit compares;
+- only the row PERMUTATION exits the kernel; the caller gathers the full
+  KVBlock (values and all) once at the end.
+
+The jnp concat+sort path stays the portable fallback and correctness
+oracle (tests/test_pallas_merge.py runs both, interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import mvcc as mvcc_mod
+from .keys import key_words
+
+# whole-merge VMEM residency cap: 2^17 rows x ~10 i32 planes ~= 5.3MB of
+# ~16MB/core VMEM, leaving headroom for the stage temporaries
+MAX_MERGE_ROWS = 1 << 17
+_LANES = 128
+
+
+def _split_u64(a: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """u64/i64 [..]-array -> (hi, lo) i32 planes (bit pattern halves)."""
+    u = a.astype(jnp.uint64)
+    hi = (u >> jnp.uint64(32)).astype(jnp.uint32).astype(jnp.int32)
+    lo = u.astype(jnp.uint32).astype(jnp.int32)
+    return hi, lo
+
+
+def _operand_planes(block: mvcc_mod.KVBlock) -> list[jax.Array]:
+    """The canonical MVCC sort key (mvcc._mvcc_sort_operands) as [cap] i32
+    planes: [livemask, key-word hi/lo pairs, ts' hi/lo, seq' hi/lo], every
+    plane compared UNSIGNED in the kernel. Pad rows use livemask=2, past
+    any real row (live=0, dead=1)."""
+    words = key_words(block.key)
+    planes = [(~block.mask).astype(jnp.int32)]
+    enc_ts = ~(block.ts.astype(jnp.uint64) ^ np.uint64(1 << 63))
+    enc_seq = ~(block.seq.astype(jnp.uint64) ^ np.uint64(1 << 63))
+    cols = [words[:, i] for i in range(words.shape[1])] + [enc_ts, enc_seq]
+    for w in cols:
+        hi, lo = _split_u64(w)
+        planes += [hi, lo]
+    return planes
+
+
+def _ult(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Unsigned a < b on i32 bit patterns (flip sign bit, signed compare)."""
+    bias = jnp.int32(-0x80000000)
+    return (a ^ bias) < (b ^ bias)
+
+
+def _lex_lt(xs: list[jax.Array], ys: list[jax.Array]) -> jax.Array:
+    """Lexicographic unsigned xs < ys over parallel plane lists."""
+    lt = jnp.zeros(xs[0].shape, jnp.bool_)
+    eq = jnp.ones(xs[0].shape, jnp.bool_)
+    for x, y in zip(xs, ys):
+        lt = lt | (eq & _ult(x, y))
+        eq = eq & (x == y)
+    return lt
+
+
+def _shift_rows(x: jax.Array, k: int, fill) -> tuple[jax.Array, jax.Array]:
+    """(x shifted up by k rows, x shifted down by k rows) via pad+concat."""
+    pad = jnp.full((k,) + x.shape[1:], fill, x.dtype)
+    up = jnp.concatenate([x[k:], pad], axis=0)      # row r reads r+k
+    down = jnp.concatenate([pad, x[:-k]], axis=0)   # row r reads r-k
+    return up, down
+
+
+def _shift_lanes(x: jax.Array, k: int, fill) -> tuple[jax.Array, jax.Array]:
+    pad = jnp.full(x.shape[:-1] + (k,), fill, x.dtype)
+    left = jnp.concatenate([x[..., k:], pad], axis=-1)   # lane c reads c+k
+    right = jnp.concatenate([pad, x[..., :-k]], axis=-1)  # lane c reads c-k
+    return left, right
+
+
+def _merge_kernel(nplanes: int, *refs):
+    """One launch = the whole bitonic merge: log2(N) compare-exchange
+    stages over VMEM-resident planes; only the permutation is written."""
+    in_refs, perm_out = refs[:-1], refs[-1]
+    planes = [r[:] for r in in_refs[:nplanes]]
+    perm = in_refs[nplanes][:]
+    R, C = perm.shape
+    N = R * C
+    row = jax.lax.broadcasted_iota(jnp.int32, (R, C), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (R, C), 1)
+
+    s = N // 2
+    while s >= 1:
+        if s >= C:
+            rs = s // C
+            is_low = (row & rs) == 0
+            shifted = [_shift_rows(p, rs, 0) for p in planes]
+            pperm = _shift_rows(perm, rs, -1)
+        else:
+            is_low = (lane & s) == 0
+            shifted = [_shift_lanes(p, s, 0) for p in planes]
+            pperm = _shift_lanes(perm, s, -1)
+        partners = [jnp.where(is_low, fw, bw) for fw, bw in shifted]
+        partner_perm = jnp.where(is_low, pperm[0], pperm[1])
+        lt_xp = _lex_lt(planes, partners)
+        # low slot keeps the min of the pair, high slot the max; the sort
+        # key is total (seq is globally unique), so ties cannot occur
+        take_mine = lt_xp == is_low
+        planes = [jnp.where(take_mine, x, p)
+                  for x, p in zip(planes, partners)]
+        perm = jnp.where(take_mine, perm, partner_perm)
+        s //= 2
+    perm_out[:] = perm
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@functools.partial(jax.jit, static_argnames=("n_a", "n_b", "interpret"))
+def _merge_perm(a_planes, b_planes, n_a: int, n_b: int,
+                interpret: bool = False) -> jax.Array:
+    """Permutation that merges two sorted operand-plane sets. Returned
+    indices address the row-concatenation [A; B] (pad slots are -1) and
+    are themselves sorted by the composite key, pads last."""
+    from jax.experimental import pallas as pl
+
+    half = max(_next_pow2(max(n_a, n_b)), _LANES // 2)
+    N = 2 * half
+    R = N // _LANES
+
+    def pad_side(planes, perm0, n, reverse):
+        out_p, out_perm = [], None
+        fills = [2] + [0] * (len(planes) - 1)  # livemask=2 sorts pads last
+        for p, f in zip(planes, fills):
+            p = jnp.concatenate([p, jnp.full((half - n,), f, p.dtype)])
+            out_p.append(p[::-1] if reverse else p)
+        perm = jnp.concatenate(
+            [perm0, jnp.full((half - n,), -1, jnp.int32)])
+        out_perm = perm[::-1] if reverse else perm
+        return out_p, out_perm
+
+    a_pad, a_perm = pad_side(a_planes, jnp.arange(n_a, dtype=jnp.int32),
+                             n_a, reverse=False)
+    # reversing the second sorted run makes [A; pads; rev(B)] bitonic
+    b_pad, b_perm = pad_side(
+        b_planes, jnp.arange(n_a, n_a + n_b, dtype=jnp.int32),
+        n_b, reverse=True,
+    )
+    planes = [jnp.concatenate([x, y]).reshape(R, _LANES)
+              for x, y in zip(a_pad, b_pad)]
+    perm0 = jnp.concatenate([a_perm, b_perm]).reshape(R, _LANES)
+
+    nplanes = len(planes)
+    spec = pl.BlockSpec((R, _LANES), lambda: (0, 0))
+    perm = pl.pallas_call(
+        functools.partial(_merge_kernel, nplanes),
+        out_shape=jax.ShapeDtypeStruct((R, _LANES), jnp.int32),
+        in_specs=[spec] * (nplanes + 1),
+        out_specs=spec,
+        interpret=interpret,
+    )(*planes, perm0)
+    return perm.reshape(-1)
+
+
+def merge_pair(a: mvcc_mod.KVBlock, b: mvcc_mod.KVBlock,
+               interpret: bool = False) -> mvcc_mod.KVBlock:
+    """Merge two SORTED KVBlocks into one sorted KVBlock (capacity the
+    padded power of two; pad rows are dead). Device-resident end to end."""
+    perm = _merge_perm(
+        tuple(_operand_planes(a)), tuple(_operand_planes(b)),
+        a.capacity, b.capacity, interpret=interpret,
+    )
+    big = jax.tree_util.tree_map(
+        lambda x, y: jnp.concatenate([x, y], axis=0), a, b
+    )
+    safe = jnp.maximum(perm, 0)
+    out = jax.tree_util.tree_map(lambda x: x[safe], big)
+    return mvcc_mod.KVBlock(
+        key=out.key, ts=out.ts, seq=out.seq, txn=out.txn, tomb=out.tomb,
+        value=out.value, vlen=out.vlen, mask=out.mask & (perm >= 0),
+    )
+
+
+def eligible(blocks: tuple[mvcc_mod.KVBlock, ...]) -> bool:
+    """The kernel handles whole-merge-in-VMEM shapes. Tournament caps
+    inflate: merging two runs of capacity <= 2^k yields 2^(k+1), so the
+    final round's launch is bounded by next_pow2(K) * next_pow2(max cap);
+    anything past the VMEM budget takes the concat+sort fallback."""
+    if len(blocks) < 2:
+        return False
+    bound = (_next_pow2(len(blocks))
+             * 2 * _next_pow2(max(b.capacity for b in blocks)))
+    return bound <= MAX_MERGE_ROWS
+
+
+def merge_runs(blocks: tuple[mvcc_mod.KVBlock, ...],
+               interpret: bool = False) -> mvcc_mod.KVBlock:
+    """K-way merge as a pairwise tournament of bitonic merge kernels —
+    log2(K) rounds, each half the launches of the last. Inputs must be
+    sorted (LSM runs are); output is sorted with pads/dead rows last ONLY
+    after a final dead-row compaction by the caller (compact() re-sorts
+    post-GC anyway, and _shrink trims the pad tail)."""
+    runs = list(blocks)
+    while len(runs) > 1:
+        nxt = []
+        for i in range(0, len(runs) - 1, 2):
+            nxt.append(merge_pair(runs[i], runs[i + 1], interpret=interpret))
+        if len(runs) % 2:
+            nxt.append(runs[-1])
+        runs = nxt
+    return runs[0]

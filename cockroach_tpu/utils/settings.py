@@ -201,6 +201,15 @@ PALLAS_FILTER = register_enum(
     "testing, unsupported on GPU; 'off' forces jnp",
     choices=("auto", "on", "off"),
 )
+PALLAS_MERGE = register_enum(
+    "storage.pallas_merge", "auto",
+    "LSM compaction merge implementation: 'auto' uses the bitonic-merge "
+    "Pallas kernel on TPU for VMEM-sized merges (log2(N) compare-exchange "
+    "stages exploiting run pre-sortedness) and the concat+lax.sort "
+    "composition everywhere else; 'on' forces the kernel (interpret mode "
+    "on CPU, for parity testing); 'off' forces concat+sort",
+    choices=("auto", "on", "off"),
+)
 IO_PACING = register_bool(
     "admission.io_pacing.enabled", True,
     "write admission control: engine writes pay a delay proportional to "
