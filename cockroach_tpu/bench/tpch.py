@@ -305,6 +305,10 @@ def gen_tpch(sf: float = 0.01, seed: int = 19920101,
             ],
             "l_comment": comments(n_li),
         },
+        # np.repeat(orderkey, n_lines) clusters the fact table by order —
+        # the TPC-H physical layout; enables ordered aggregation for
+        # GROUP BY l_orderkey (q18's first stage)
+        ordering=("l_orderkey",),
     ))
 
     # orders status/totalprice from lineitems
@@ -338,14 +342,21 @@ def gen_tpch(sf: float = 0.01, seed: int = 19920101,
             "o_shippriority": np.zeros(n_order, dtype=np.int64),
             "o_comment": comments(n_order),
         },
+        ordering=("o_orderkey",),
     ))
     if via_arrow:
         from ..coldata import arrow as arrow_mod
 
         for name in list(cat.tables):
-            cat.tables[name] = arrow_mod.table_from_arrow(
-                name, arrow_mod.table_to_arrow(cat.tables[name])
+            old = cat.tables[name]
+            new = arrow_mod.table_from_arrow(
+                name, arrow_mod.table_to_arrow(old)
             )
+            # Arrow interchange carries data, not physical-layout
+            # metadata; the round-trip preserves row order, so the
+            # clustering declaration survives it
+            new.ordering = old.ordering
+            cat.tables[name] = new
     return cat
 
 
@@ -398,7 +409,8 @@ def load_catalog(path: str, sf: float) -> Catalog | None:
                 if dk in z:
                     dicts[cname] = Dictionary(z[dk].astype(object))
             cat.add(Table(name=name, schema=schema, columns=cols,
-                          valids=valids, dictionaries=dicts))
+                          valids=valids, dictionaries=dicts,
+                          ordering=ref.get(name).ordering))
         return cat
     except Exception:
         return None

@@ -42,6 +42,11 @@ class Table:
     dictionaries: dict[str, Dictionary] = field(default_factory=dict)
     _device: dict | None = None
     _stats: dict | None = None
+    # physical clustering: host rows are stored grouped (equal values
+    # adjacent) by this column prefix — e.g. TPC-H lineitem by l_orderkey,
+    # KV tables by primary key. Enables the sort-free ordered aggregation
+    # (colexec orderedAggregator role, ordered_aggregator.go)
+    ordering: tuple[str, ...] = ()
 
     @property
     def num_rows(self) -> int:
@@ -174,6 +179,7 @@ class Table:
         schema: Schema,
         raw: dict[str, np.ndarray],
         valids: dict[str, np.ndarray] | None = None,
+        ordering: tuple[str, ...] = (),
     ) -> "Table":
         """Build a table from raw host columns, dictionary-encoding STRING
         columns (object/str arrays -> int32 codes + Dictionary)."""
@@ -193,6 +199,7 @@ class Table:
             columns=cols,
             valids=valids or {},
             dictionaries=dicts,
+            ordering=ordering,
         )
 
 

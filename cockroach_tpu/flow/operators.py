@@ -454,11 +454,20 @@ class AggregateOp(OneInputOperator):
         aggs: tuple[agg_ops.AggSpec, ...],
         mode: str = "complete",
         input_schema: Schema | None = None,
+        ordered: bool = False,
+        prefix_live: bool = False,
     ):
         super().__init__(child)
         self.mode = mode
         self.group_cols = group_cols
         self.aggs = aggs
+        # ordered: equal group keys arrive adjacent (clustered scan —
+        # Table.ordering); the per-tile grouping skips its key sort
+        # (orderedAggregator role). prefix_live additionally asserts tiles
+        # are live-prefix (no filters in the fused chain below), dropping
+        # the dead-row compaction sort too.
+        self.ordered = ordered
+        self.prefix_live = prefix_live
         # string_agg runs OUTSIDE the device state pipeline: per-row
         # (group key, string code) pairs are collected host-side during
         # the spool and concatenated at finalize (the reference's concat
@@ -579,21 +588,29 @@ class AggregateOp(OneInputOperator):
             i: s for i, s in self.key_stats.items() if i < len(mcols)
         }
 
+        ordered = self.ordered
+        prefix_live = self.prefix_live
+
         def partial_fn(b):
             # out_capacity == input capacity: groups <= live rows, so this
             # CANNOT overflow — no device->host sync on the hot tile loop
             part, _ = agg_ops.sort_groupby(
                 b, schema, gcols, pspecs, out_capacity=b.capacity,
                 col_stats=in_stats,
+                presorted=ordered, compact=not prefix_live,
             )
             return part
 
         @functools.partial(jax.jit, static_argnames=("cap",))
         def merge_fn(tiles, cap):
             both = concat(list(tiles), capacity=cap)
+            # ordered partials stay in scan order per tile, so their
+            # concatenation is still clustered; only dead pad rows between
+            # tiles need compacting (the cheap single-operand sort)
             return agg_ops.sort_groupby(both, sschema, mcols, mspecs,
                                         out_capacity=cap,
-                                        col_stats=merge_stats)
+                                        col_stats=merge_stats,
+                                        presorted=ordered, compact=True)
 
         self._partial_raw = partial_fn
         self._partial_fn = jax.jit(partial_fn)

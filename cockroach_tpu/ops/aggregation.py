@@ -212,6 +212,8 @@ def sort_groupby(
     aggs: tuple[AggSpec, ...],
     out_capacity: int | None = None,
     col_stats: dict[int, tuple] | None = None,
+    presorted: bool = False,
+    compact: bool = True,
 ) -> tuple[Batch, jax.Array]:
     """General grouped aggregation over one tile. Output tile: one live row per
     group (group key columns first, then aggregates), padded to capacity.
@@ -223,7 +225,15 @@ def sort_groupby(
     The group keys bit-pack into as few uint64 sort operands as possible
     (ops/keys.py; catalog stats shrink integer keys) — on TPU lax.sort
     compile time scales with operand count, so a 3-column TPC-H group-by
-    sorts on ONE packed word instead of seven operands."""
+    sorts on ONE packed word instead of seven operands.
+
+    presorted=True asserts equal group keys are already ADJACENT in the
+    input (clustered storage, Table.ordering) and skips the key sort —
+    the colexec orderedAggregator specialization (ordered sort-free
+    grouping). compact=True still runs a single-operand stable sort that
+    pushes dead rows last (needed when filters interleave dead rows);
+    compact=False additionally asserts live rows form a prefix (pure
+    scan tiles), making the whole grouping sort-free."""
     from . import keys as key_ops
 
     cap = batch.capacity
@@ -243,21 +253,35 @@ def sort_groupby(
         ))
     operands = key_ops.pack_operands(segs)
     perm = jnp.arange(cap, dtype=jnp.int32)
-    sorted_ops = jax.lax.sort(
-        operands + [perm], num_keys=len(operands) + 1
-    )
-    perm = sorted_ops[-1]
+    if not presorted:
+        sorted_res = jax.lax.sort(
+            operands + [perm], num_keys=len(operands) + 1
+        )
+        perm = sorted_res[-1]
+        key_words = sorted_res[:-1]
+    elif compact:
+        # clustered keys: only push dead rows last (stable, so group
+        # adjacency survives) — one u8 operand instead of the packed keys
+        _, perm = jax.lax.sort(
+            [(~live).astype(jnp.uint8), perm], num_keys=2
+        )
+        key_words = [w[perm] for w in operands]
+    else:
+        key_words = operands  # identity permutation, zero sorts
 
-    live_s = live[perm]
+    live_s = live[perm] if (not presorted or compact) else live
     keys_s = [
-        (batch.cols[gi].data[perm], batch.cols[gi].valid[perm]) for gi in group_cols
+        (batch.cols[gi].data[perm], batch.cols[gi].valid[perm])
+        for gi in group_cols
+    ] if (not presorted or compact) else [
+        (batch.cols[gi].data, batch.cols[gi].valid) for gi in group_cols
     ]
 
     # Group boundaries: compare adjacent rows on the SORTED packed words
     # (word equality == full group-key equality, NULL==NULL included).
     idx = jnp.arange(cap)
     changed = jnp.zeros((cap,), jnp.bool_)
-    for w in sorted_ops[:-1]:
+    for w in key_words:
         changed = changed | (w != jnp.roll(w, 1, axis=0))
     prev_live = jnp.roll(live_s, 1)
     boundary = live_s & ((idx == 0) | changed | ~prev_live)

@@ -223,11 +223,20 @@ class _Lowering:
                 base, plan.group_cols, plan.aggs
             )
             gcols, cap, inner = plan.group_cols, ln.cap, ln.emit
+            # a contiguous device shard of a clustered table keeps equal
+            # keys adjacent: the per-shard grouping can skip its key sort
+            # (orderedAggregator role; plan/builder._clustered_input)
+            from ..plan.builder import _clustered_input
+
+            ordered, prefix_live = _clustered_input(
+                plan.input, plan.group_cols, self.catalog
+            )
 
             def emit(env):
                 b = inner(env)
                 part, _ = agg_ops.sort_groupby(
-                    b, base, gcols, pspecs, out_capacity=cap
+                    b, base, gcols, pspecs, out_capacity=cap,
+                    presorted=ordered, compact=not prefix_live,
                 )  # num_groups <= live rows <= cap: no overflow possible
                 return part
 

@@ -67,6 +67,48 @@ def _plan_dense_agg(child: Operator, group_cols, aggs):
     return tuple(sizes), tuple(lows)
 
 
+def _clustered_input(plan: S.PlanNode, group_cols, catalog: Catalog):
+    """(ordered, prefix_live) for an Aggregate's input chain: ordered when
+    the walk down Project/Filter reaches a TableScan whose Table.ordering
+    prefix IS the group key set — equal keys then arrive adjacent and the
+    grouping can skip its key sort (colexec orderedAggregator role).
+    prefix_live when no Filter interleaves dead rows (pure scan tiles are
+    live-prefix), dropping the compaction sort too."""
+    from ..ops import expr as ex
+
+    cols = list(group_cols)
+    prefix_live = True
+    node = plan
+    while True:
+        if isinstance(node, S.Project):
+            mapped = []
+            for c in cols:
+                e = node.exprs[c]
+                if not isinstance(e, ex.ColRef):
+                    return False, False
+                mapped.append(e.idx)
+            cols = mapped
+            node = node.input
+        elif isinstance(node, S.Filter):
+            prefix_live = False
+            node = node.input
+        elif isinstance(node, S.TableScan):
+            table = catalog.get(node.table)
+            ordering = tuple(getattr(table, "ordering", ()) or ())
+            if not ordering or len(cols) > len(ordering):
+                return False, False
+            names = tuple(node.columns or table.schema.names)
+            try:
+                keynames = {names[c] for c in cols}
+            except IndexError:
+                return False, False
+            if keynames == set(ordering[: len(cols)]):
+                return True, prefix_live
+            return False, False
+        else:
+            return False, False
+
+
 def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
     if isinstance(plan, S.TableScan):
         return ops.ScanOp(
@@ -92,7 +134,12 @@ def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
                 return ops.SmallGroupAggregateOp(
                     child, plan.group_cols, plan.aggs, sizes, key_lows=lows
                 )
-        return ops.AggregateOp(child, plan.group_cols, plan.aggs, plan.mode)
+        ordered, prefix_live = (
+            _clustered_input(plan.input, plan.group_cols, catalog)
+            if plan.mode in ("complete", "partial") else (False, False)
+        )
+        return ops.AggregateOp(child, plan.group_cols, plan.aggs, plan.mode,
+                               ordered=ordered, prefix_live=prefix_live)
     if isinstance(plan, S.ScalarAggregate):
         return ops.ScalarAggregateOp(build(plan.input, catalog), plan.aggs)
     if isinstance(plan, S.Sort):
