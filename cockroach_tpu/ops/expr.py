@@ -106,11 +106,68 @@ class ExtractYear(Expr):
 
 @dataclass(frozen=True)
 class Func1(Expr):
-    """Unary scalar builtin over a numeric expr (sem/builtins surface:
-    abs | ceil | floor | round | sign | sqrt | exp | ln)."""
+    """Unary scalar builtin over a numeric expr (sem/builtins surface,
+    pkg/sql/sem/builtins/math_builtins.go): abs | ceil | floor | round |
+    sign | sqrt | cbrt | exp | ln | log10 | trunc | degrees | radians |
+    sin | cos | tan | cot | asin | acos | atan | sinh | cosh | tanh."""
 
     func: str
     arg: Expr
+
+
+# the trig/analytic family: always FLOAT64-valued, with a domain mask
+_FUNC1_FLOAT = {
+    "sqrt": (jnp.sqrt, lambda x: x >= 0),
+    "cbrt": (jnp.cbrt, None),
+    "exp": (jnp.exp, None),
+    "ln": (jnp.log, lambda x: x > 0),
+    "log10": (jnp.log10, lambda x: x > 0),
+    "degrees": (jnp.degrees, None),
+    "radians": (jnp.radians, None),
+    "sin": (jnp.sin, None),
+    "cos": (jnp.cos, None),
+    "tan": (jnp.tan, None),
+    "cot": (lambda x: 1.0 / jnp.tan(x), lambda x: jnp.tan(x) != 0),
+    "asin": (jnp.arcsin, lambda x: jnp.abs(x) <= 1),
+    "acos": (jnp.arccos, lambda x: jnp.abs(x) <= 1),
+    "atan": (jnp.arctan, None),
+    "sinh": (jnp.sinh, None),
+    "cosh": (jnp.cosh, None),
+    "tanh": (jnp.tanh, None),
+}
+
+
+@dataclass(frozen=True)
+class Func2(Expr):
+    """Binary scalar builtin (pow | mod | div | atan2 | round2 — round2 is
+    round(x, n) with literal n; see builtins.go round/pow/mod/div)."""
+
+    func: str
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class ExtractPart(Expr):
+    """EXTRACT(part FROM date) over DATE (days since epoch): year | month |
+    day | quarter | dow | isodow | doy | epoch | decade | century |
+    millennium (sem/tree's extractTimeSpanFromDate)."""
+
+    part: str
+    arg: Expr
+
+
+EXTRACT_PARTS = ("year", "month", "day", "quarter", "dow", "isodow",
+                 "doy", "epoch", "decade", "century", "millennium")
+
+
+@dataclass(frozen=True)
+class Greatest(Expr):
+    """GREATEST/LEAST(a, b, ...): extreme of the NON-NULL arguments
+    (NULL only when every argument is NULL — Postgres semantics)."""
+
+    args: tuple[Expr, ...]
+    is_least: bool = False
 
 
 @dataclass(frozen=True)
@@ -164,13 +221,43 @@ def expr_type(e: Expr, schema: Schema) -> SQLType:
         return INT64
     if isinstance(e, Func1):
         at = expr_type(e.arg, schema)
-        if e.func in ("sqrt", "exp", "ln"):
+        if e.func in _FUNC1_FLOAT:
             return FLOAT64
-        if e.func in ("ceil", "floor", "round"):
+        if e.func in ("ceil", "floor", "round", "trunc"):
             return INT64 if at.family in (Family.INT,) else at
         if e.func == "sign":
             return INT64
         return at  # abs keeps the input type
+    if isinstance(e, Func2):
+        if e.func in ("pow", "atan2"):
+            return FLOAT64
+        if e.func in ("mod", "div"):
+            lt = expr_type(e.left, schema)
+            if lt.family is Family.FLOAT:
+                return FLOAT64
+            return INT64
+        if e.func == "round2":
+            return expr_type(e.left, schema)
+        raise TypeError(f"unknown builtin {e.func}")
+    if isinstance(e, ExtractPart):
+        return INT64
+    if isinstance(e, Greatest):
+        ts = [expr_type(a, schema) for a in e.args]
+        fams = {t.family for t in ts}
+        # single-family INT/BOOL/DATE compare on their raw representation;
+        # same-scale DECIMALs compare exactly as scaled ints
+        if fams in ({Family.INT}, {Family.BOOL}, {Family.DATE}):
+            return ts[0]
+        if fams == {Family.DECIMAL} and len({t.scale for t in ts}) == 1:
+            return ts[0]
+        if fams <= {Family.INT, Family.FLOAT, Family.DECIMAL}:
+            # mixed numeric representations: compare in float64 space
+            return FLOAT64
+        # BOOL/DATE mixed with numerics has no sane unification
+        # (Postgres rejects it too)
+        raise TypeError(
+            f"greatest/least cannot unify argument families {fams}"
+        )
     if isinstance(e, Coalesce):
         return expr_type(e.args[0], schema)
     if isinstance(e, Case):
@@ -299,6 +386,8 @@ def eval_expr(e: Expr, cols, schema: Schema):
 
     if isinstance(e, ExtractYear):
         d, v = eval_expr(e.arg, cols, schema)
+        if expr_type(e.arg, schema).family is Family.TIMESTAMP:
+            d = d.astype(jnp.int64) // (86400 * 1000000)
         return _year_from_days(d), v
 
     if isinstance(e, Func1):
@@ -322,17 +411,101 @@ def eval_expr(e: Expr, cols, schema: Schema):
                 elif e.func == "floor":
                     out = q * scale
                 else:  # round half away from zero (SQL numeric rounding)
-                    out = (q + (r * 2 >= scale)) * scale
+                    out = _div_half_away(d, scale) * scale
                 return out, v
             return d, v  # ints are already integral
+        if e.func == "trunc":
+            if at.family is Family.FLOAT:
+                return jnp.trunc(d), v
+            if at.family is Family.DECIMAL:
+                q = jnp.where(d >= 0, d // scale, -((-d) // scale))
+                return q * scale, v
+            return d, v
         f64 = d.astype(jnp.float64) / scale
-        if e.func == "sqrt":
-            return jnp.sqrt(f64), v & (f64 >= 0)
-        if e.func == "exp":
-            return jnp.exp(f64), v
-        if e.func == "ln":
-            return jnp.log(f64), v & (f64 > 0)
+        if e.func in _FUNC1_FLOAT:
+            fn, domain = _FUNC1_FLOAT[e.func]
+            ok = v if domain is None else v & domain(f64)
+            return fn(jnp.where(ok, f64, 1.0)), ok
         raise ValueError(f"unknown builtin {e.func}")
+
+    if isinstance(e, Func2):
+        lt, rt = expr_type(e.left, schema), expr_type(e.right, schema)
+        ld, lv = eval_expr(e.left, cols, schema)
+        rd, rv = eval_expr(e.right, cols, schema)
+        valid = lv & rv
+        if e.func in ("pow", "atan2"):
+            lf, rf = _to_float(ld, lt), _to_float(rd, rt)
+            if e.func == "atan2":
+                return jnp.arctan2(lf, rf), valid
+            out = jnp.power(lf, rf)
+            # pow(0, negative) and negative**fractional are SQL errors;
+            # surface them as NULL (the engine's error-as-NULL policy for
+            # value-dependent domain faults)
+            return jnp.where(jnp.isfinite(out), out, 0.0), \
+                valid & jnp.isfinite(out)
+        if e.func in ("mod", "div"):
+            if lt.family is Family.FLOAT or rt.family is Family.FLOAT:
+                lf, rf = _to_float(ld, lt), _to_float(rd, rt)
+                ok = valid & (rf != 0)
+                rf = jnp.where(rf == 0, 1.0, rf)
+                q = jnp.trunc(lf / rf)
+                return (lf - q * rf if e.func == "mod" else q), ok
+            li, ri = ld.astype(jnp.int64), rd.astype(jnp.int64)
+            ok = valid & (ri != 0)
+            ri = jnp.where(ri == 0, 1, ri)
+            # SQL mod/div truncate toward zero; the remainder takes the
+            # DIVIDEND's sign (Postgres mod(7,-3)=1, mod(-7,3)=-1).
+            # floor-div + sign fixup keeps everything exact in int64
+            qf = li // ri
+            r = li - qf * ri
+            q = qf + ((r != 0) & ((li < 0) != (ri < 0)))
+            return (li - q * ri if e.func == "mod" else q), ok
+        if e.func == "round2":
+            n = int(e.right.value)  # binder guarantees a literal
+            if lt.family is Family.FLOAT:
+                p = 10.0 ** n
+                return jnp.round(ld * p) / p, valid
+            if lt.family is Family.DECIMAL:
+                if n >= lt.scale:
+                    return ld, valid
+                p = 10 ** (lt.scale - n)
+                return _div_half_away(ld, p) * p, valid
+            if n >= 0:
+                return ld, valid
+            p = 10 ** (-n)
+            return _div_half_away(ld, p) * p, valid
+        raise ValueError(f"unknown builtin {e.func}")
+
+    if isinstance(e, ExtractPart):
+        d, v = eval_expr(e.arg, cols, schema)
+        d = d.astype(jnp.int64)
+        if expr_type(e.arg, schema).family is Family.TIMESTAMP:
+            if e.part == "epoch":
+                return d // 1000000, v
+            d = d // (86400 * 1000000)
+        return _extract_part(e.part, d), v
+
+    if isinstance(e, Greatest):
+        out_t = expr_type(e, schema)
+
+        def as_out(arg):
+            dd, vv = eval_expr(arg, cols, schema)
+            at = expr_type(arg, schema)
+            if out_t.family is Family.FLOAT:
+                dd = _to_float(dd, at)  # DECIMAL scales divide out here
+            elif dd.dtype != out_t.dtype:
+                dd = _cast(dd, at, out_t)
+            return dd, vv
+
+        d, v = as_out(e.args[0])
+        pick = jnp.minimum if e.is_least else jnp.maximum
+        for a in e.args[1:]:
+            d1, v1 = as_out(a)
+            both = v & v1
+            ext = pick(d, d1)
+            d = jnp.where(both, ext, jnp.where(v, d, d1))
+            v = v | v1
+        return d, v
 
     if isinstance(e, Coalesce):
         d, v = eval_expr(e.args[0], cols, schema)
@@ -511,6 +684,56 @@ def _year_from_days(days):
     mp = (5 * doy + 2) // 153
     m = jnp.where(mp < 10, mp + 3, mp - 9)
     return jnp.where(m <= 2, y + 1, y)
+
+
+def _civil_from_days(days):
+    """(year, month, day, day-of-year) from days-since-1970 — Hinnant's
+    civil_from_days, vectorized integer-only."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy_mar = doe - (365 * yoe + yoe // 4 - yoe // 100)  # 0 = March 1
+    mp = (5 * doy_mar + 2) // 153
+    d = doy_mar - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    # calendar day-of-year (Jan 1 = 1)
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    jan_feb = jnp.where(m <= 2, 0, jnp.where(leap, 60, 59))
+    doy = jnp.where(m <= 2,
+                    d + jnp.where(m == 2, 31, 0),
+                    doy_mar + 1 + jan_feb)
+    return y, m, d, doy
+
+
+def _extract_part(part: str, days):
+    """EXTRACT(part FROM date) over days-since-epoch int64."""
+    if part == "epoch":
+        return days * 86400
+    if part == "dow":  # 0 = Sunday (1970-01-01 was a Thursday)
+        return (days + 4) % 7
+    if part == "isodow":  # 1 = Monday .. 7 = Sunday
+        return (days + 3) % 7 + 1
+    y, m, d, doy = _civil_from_days(days)
+    if part == "year":
+        return y
+    if part == "month":
+        return m
+    if part == "day":
+        return d
+    if part == "doy":
+        return doy
+    if part == "quarter":
+        return (m - 1) // 3 + 1
+    if part == "decade":
+        return jnp.where(y >= 0, y, y - 9) // 10
+    if part == "century":
+        return jnp.where(y > 0, (y - 1) // 100 + 1, -((-y) // 100) - 1)
+    if part == "millennium":
+        return jnp.where(y > 0, (y - 1) // 1000 + 1, -((-y) // 1000) - 1)
+    raise ValueError(f"unknown extract part {part}")
 
 
 # ---------------------------------------------------------------------------

@@ -25,7 +25,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..catalog import Catalog
-from ..coldata.types import FLOAT64, INT64, Family, SQLType
+from ..coldata.types import BOOL, FLOAT64, INT64, Family, SQLType
 from ..ops import expr as ex
 from . import parser as P
 from .rel import Rel
@@ -371,20 +371,101 @@ class ExprLowerer:
                 raise BindError(f"unsupported cast target {e.to}")
             return ex.Cast(self.lower(e.arg), to)
         if isinstance(e, P.Extract):
-            if e.part != "year":
-                raise BindError(f"EXTRACT({e.part}) not supported")
-            return ex.ExtractYear(self.lower(e.arg))
+            if e.part == "year":
+                return ex.ExtractYear(self.lower(e.arg))
+            if e.part in ex.EXTRACT_PARTS:
+                return ex.ExtractPart(e.part, self.lower(e.arg))
+            raise BindError(f"EXTRACT({e.part}) not supported")
         if isinstance(e, P.FuncCall) and e.name in AGG_FUNCS:
             raise BindError(
                 f"aggregate {e.name} not allowed in this context"
             )
         if (isinstance(e, P.FuncCall) and len(e.args) == 1
                 and e.name in ("abs", "ceil", "ceiling", "floor", "round",
-                               "sign", "sqrt", "exp", "ln")):
-            name = "ceil" if e.name == "ceiling" else e.name
+                               "sign", "trunc", "log")
+                + tuple(ex._FUNC1_FLOAT)):
+            # CockroachDB's log(x) is base 10 (builtins.go); ln is natural
+            name = {"ceiling": "ceil", "log": "log10"}.get(e.name, e.name)
             return ex.Func1(name, self.lower(e.args[0]))
+        if (isinstance(e, P.FuncCall) and len(e.args) == 2
+                and e.name in ("pow", "power", "mod", "div", "atan2")):
+            name = "pow" if e.name == "power" else e.name
+            return ex.Func2(name, self.lower(e.args[0]),
+                            self.lower(e.args[1]))
+        if (isinstance(e, P.FuncCall) and len(e.args) == 2
+                and e.name == "round"):
+            n = self.lower(e.args[1])
+            if not isinstance(n, ex.Const) or n.value is None:
+                raise BindError("round(x, n) requires a literal n")
+            return ex.Func2("round2", self.lower(e.args[0]),
+                            ex.Const(int(n.value), INT64))
+        if (isinstance(e, P.FuncCall) and e.name in ("greatest", "least")
+                and e.args):
+            lowered = tuple(self.lower(a) for a in e.args)
+            for le in lowered:
+                if ex.expr_type(le, self.rel.schema).family in (
+                        Family.STRING, Family.BYTES, Family.JSON):
+                    # dict codes don't order by value; needs a rank-table
+                    # rewrite like string range predicates
+                    raise BindError(
+                        f"{e.name} over strings is not supported"
+                    )
+            out = ex.Greatest(lowered, is_least=e.name == "least")
+            try:  # surface family-unification failures at bind time
+                ex.expr_type(out, self.rel.schema)
+            except TypeError as err:
+                raise BindError(str(err)) from None
+            return out
+        if isinstance(e, P.FuncCall) and e.name == "nullif" \
+                and len(e.args) == 2:
+            a = self.lower(e.args[0])
+            b = self.lower(e.args[1])
+            t = ex.expr_type(a, self.rel.schema)
+            if t.family is Family.STRING:
+                # dict codes from different columns don't compare; the
+                # string path would need a shared-dictionary rewrite
+                raise BindError("NULLIF over strings is not supported")
+            return ex.Case(whens=((ex.Cmp("eq", a, b), ex.Const(None, t)),),
+                           otherwise=a)
         if isinstance(e, P.FuncCall) and e.name == "coalesce" and e.args:
             return ex.Coalesce(tuple(self.lower(a) for a in e.args))
+        if (isinstance(e, P.FuncCall)
+                and e.name in ("starts_with", "strpos")
+                and len(e.args) == 2):
+            i = self._is_string_col(e.args[0])
+            lit = e.args[1]
+            if i is None or not isinstance(lit, P.StrLit):
+                raise BindError(f"{e.name} requires (string column, "
+                                "string literal)")
+            d = self.rel.dicts[i]
+            if e.name == "starts_with":
+                table = np.array(
+                    [str(v).startswith(lit.value) for v in d.values],
+                    dtype=bool,
+                )
+                out_t = BOOL
+            else:  # strpos: 1-based position, 0 when absent
+                table = np.array(
+                    [str(v).find(lit.value) + 1 for v in d.values],
+                    dtype=np.int64,
+                )
+                out_t = INT64
+            if len(table) == 0:
+                table = np.zeros(1, table.dtype)
+            return ex.CodeLookup(col=i, table=table, out_type=out_t)
+        if (isinstance(e, P.FuncCall) and e.name == "ascii"
+                and len(e.args) == 1):
+            i = self._is_string_col(e.args[0])
+            if i is None:
+                raise BindError("ascii requires a string column")
+            d = self.rel.dicts[i]
+            table = np.array(
+                [ord(str(v)[0]) if len(str(v)) else 0 for v in d.values],
+                dtype=np.int64,
+            )
+            if len(table) == 0:
+                table = np.zeros(1, np.int64)
+            return ex.CodeLookup(col=i, table=table, out_type=INT64)
         if (isinstance(e, P.FuncCall)
                 and e.name in ("length", "char_length")
                 and len(e.args) == 1):
@@ -1358,12 +1439,83 @@ class Binder:
         if not (isinstance(e, P.FuncCall) and len(e.args) >= 1
                 and isinstance(e.args[0], P.Ident)):
             return None
+        def _lit(k):
+            a = _fold(e.args[k])  # folds unary minus / literal arithmetic
+            if isinstance(a, P.StrLit):
+                return a.value
+            if isinstance(a, P.NumLit):
+                return a.value
+            raise BindError(f"{e.name}: argument {k + 1} must be a literal")
+
+        def _initcap(s: str) -> str:
+            out, start = [], True
+            for ch in s:
+                out.append(ch.upper() if start else ch.lower())
+                start = not ch.isalnum()
+            return "".join(out)
+
         if e.name == "substring" and len(e.args) == 3:
             start = int(e.args[1].value) - 1
             n = int(e.args[2].value)
             fn = lambda s: s[start:start + n]  # noqa: E731
         elif e.name in ("upper", "lower") and len(e.args) == 1:
             fn = (str.upper if e.name == "upper" else str.lower)
+        elif e.name in ("trim", "btrim") and len(e.args) <= 2:
+            chars = str(_lit(1)) if len(e.args) == 2 else None
+            fn = lambda s: s.strip(chars)  # noqa: E731
+        elif e.name in ("ltrim", "rtrim") and len(e.args) <= 2:
+            chars = str(_lit(1)) if len(e.args) == 2 else None
+            strip = str.lstrip if e.name == "ltrim" else str.rstrip
+            fn = lambda s: strip(s, chars)  # noqa: E731
+        elif e.name == "replace" and len(e.args) == 3:
+            old, new = str(_lit(1)), str(_lit(2))
+            fn = lambda s: s.replace(old, new)  # noqa: E731
+        elif e.name == "initcap" and len(e.args) == 1:
+            fn = _initcap
+        elif e.name == "reverse" and len(e.args) == 1:
+            fn = lambda s: s[::-1]  # noqa: E731
+        elif e.name in ("lpad", "rpad") and len(e.args) in (2, 3):
+            width = int(_lit(1))
+            fill = str(_lit(2)) if len(e.args) == 3 else " "
+            left = e.name == "lpad"
+
+            def fn(s, width=width, fill=fill, left=left):
+                if width <= 0:
+                    return ""  # postgres: non-positive width pads to empty
+                if len(s) >= width:
+                    return s[:width]
+                pad = (fill * width)[: width - len(s)] if fill else ""
+                return pad + s if left else s + pad
+        elif e.name in ("left", "right") and len(e.args) == 2:
+            n = int(_lit(1))
+            # python slicing matches Postgres for negative n too:
+            # left(s,-2) drops the last 2, right(s,-2) drops the first 2
+            if e.name == "left":
+                fn = lambda s: s[:n]  # noqa: E731
+            else:
+                fn = lambda s: s[-n:] if n else ""  # noqa: E731
+        elif e.name == "repeat" and len(e.args) == 2:
+            n = int(_lit(1))
+            fn = lambda s: s * max(n, 0)  # noqa: E731
+        elif e.name == "split_part" and len(e.args) == 3:
+            delim, field_n = str(_lit(1)), int(_lit(2))
+
+            def fn(s, delim=delim, field_n=field_n):
+                parts = s.split(delim) if delim else [s]
+                return parts[field_n - 1] if 1 <= field_n <= len(parts) \
+                    else ""
+        elif e.name == "translate" and len(e.args) == 3:
+            src, dst = str(_lit(1)), str(_lit(2))
+            tbl = {ord(c): (dst[i] if i < len(dst) else None)
+                   for i, c in enumerate(src)}
+            fn = lambda s: s.translate(tbl)  # noqa: E731
+        elif e.name == "md5" and len(e.args) == 1:
+            import hashlib
+
+            fn = lambda s: hashlib.md5(s.encode()).hexdigest()  # noqa: E731
+        elif e.name == "concat" and len(e.args) >= 1:
+            suffix = "".join(str(_lit(k)) for k in range(1, len(e.args)))
+            fn = lambda s: s + suffix  # noqa: E731
         else:
             return None
         i = lower.idx(e.args[0])

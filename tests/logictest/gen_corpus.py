@@ -398,6 +398,40 @@ AREAS.append(("scalar_subqueries", NUMS, [
 ]))
 
 
+AREAS.append(("math_builtins", NUMS, [
+    ("II", "rowsort", "select a, mod(b, 3) from nums where b is not null"),
+    ("II", "rowsort", "select a, mod(b, -4) from nums where b is not null"),
+    ("IR", "rowsort", "select a, pow(f, 2) from nums where f is not null"),
+    # round ties excluded: this dialect rounds floats half-to-even
+    # (CockroachDB/IEEE), sqlite half-away — a documented divergence
+    ("IR", "rowsort",
+     "select a, round(f, 1) from nums where f is not null "
+     "and a <> 2 and a <> 6 and a <> 10"),
+    ("IR", "rowsort", "select a, trunc(f) from nums where f is not null"),
+    ("II", "rowsort", "select a, sign(f) from nums where f is not null"),
+    ("IR", "rowsort",
+     "select a, atan2(f, 2.0) from nums where f is not null"),
+    ("IR", "rowsort",
+     "select a, log(f) from nums where f > 0"),
+    ("IR", "rowsort", "select a, ln(f) from nums where f > 0"),
+    ("IR", "rowsort", "select a, sqrt(f) from nums where f > 0"),
+    ("IR", "rowsort",
+     "select a, degrees(f) from nums where f is not null"),
+    ("IR", "rowsort",
+     "select a, radians(f) from nums where f is not null"),
+    ("IR", "rowsort", "select a, sin(f) + cos(f) from nums "
+     "where f is not null"),
+    ("IR", "rowsort", "select a, atan(f) from nums where f is not null"),
+    ("IR", "rowsort", "select a, exp(b) from nums where b = 0"),
+    ("II", "rowsort",
+     "select a, greatest(b, 5) from nums where b is not null"),
+    ("II", "rowsort",
+     "select a, least(b, 5) from nums where b is not null"),
+    ("II", "rowsort", "select a, coalesce(nullif(b, 10), -99) from nums "
+     "where b is not null"),
+]))
+
+
 def _render(val, t: str) -> str:
     if val is None:
         return "NULL"
@@ -407,11 +441,16 @@ def _render(val, t: str) -> str:
         return f"{float(val):.6g}"
     if t == "B":
         return "true" if val else "false"
-    return str(val)
+    s = str(val)
+    return s if s else "·"  # runner's empty-string cell convention
 
 
 def _sqlite_dialect(sql: str) -> str:
-    return sql.replace("substring(", "substr(")
+    # sqlite's log() is also base-10, matching this dialect (builtins.go)
+    return (sql.replace("substring(", "substr(")
+            .replace("strpos(", "instr(")
+            .replace("greatest(", "max(")
+            .replace("least(", "min("))
 
 
 def generate() -> list[str]:

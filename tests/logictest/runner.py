@@ -102,7 +102,11 @@ def _render(val, t: str) -> str:
         return f"{f:.6g}"
     if t == "B":
         return "true" if bool(val) else "false"
-    return str(val)
+    s = str(val)
+    # the reference's logictest renders the empty string as "·"
+    # (logic.go) — expected-cell parsing strips lines, so a bare empty
+    # cell would otherwise terminate the block
+    return s if s else "·"
 
 
 def _cells(res: dict, types: str, sort: str) -> list[str]:

@@ -1,0 +1,144 @@
+"""sqlsmith (reduced) — random SELECT generation vs the sqlite oracle.
+
+Reference: pkg/internal/sqlsmith generates random SQL and cross-checks
+engines; pkg/sql/tests runs TLP mutations. This reduction generates
+random single- and two-table SELECTs over seeded fixtures within the
+dialect's supported grammar (projections with arithmetic/builtins,
+WHERE with 3VL predicates, GROUP BY + HAVING, ORDER BY + LIMIT, inner
+joins) and asserts cell-level equality against sqlite3 — an independent
+SQL implementation — under the logictest runner's rendering rules.
+
+Every query is deterministic per seed: a failure reproduces by seed."""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.sql.session import Session
+
+_SETUP = [
+    "create table nums (a int primary key, b int, f float, s string)",
+    "insert into nums values "
+    "(1, 10, 1.5, 'apple'), (2, null, -2.25, 'banana'), (3, 30, null, "
+    "'cherry'), (4, null, null, null), (5, 10, 0.5, 'apple'), "
+    "(6, -7, 3.25, 'date'), (7, 30, -0.5, 'banana'), (8, 0, 7.125, "
+    "'elder'), (9, 10, 2.5, null), (10, -7, 1.25, 'fig')",
+    "create table pr (id int primary key, k int, w int)",
+    "insert into pr values (10, 1, 7), (11, 1, 8), (12, 3, 9), "
+    "(13, null, 5), (14, 4, 6), (15, 10, 2), (16, 30, 3)",
+]
+
+# deliberately excluded (documented dialect divergences vs sqlite):
+# greatest/least (sqlite's scalar max/min propagate NULL; ours ignore it),
+# round ties (half-to-even vs half-away), int division (/ promotes here)
+_NUM_EXPRS = [
+    "a", "b", "a + b", "a - b", "a * 2", "abs(b)", "b + 1",
+    "coalesce(b, 0)", "case when b > 5 then 1 else 0 end", "mod(a, 3)",
+]
+_PREDS = [
+    "b > 5", "b is null", "b is not null", "f > 0", "a between 2 and 8",
+    "b in (10, 30)", "b not in (10, 30)", "s = 'apple'", "s is null",
+    "b > 5 and f > 0", "b > 5 or f < 0", "not (b > 5)",
+]
+
+
+def _gen_query(rng) -> str:
+    kind = rng.integers(0, 4)
+    if kind == 0:  # projection + filter + order
+        cols = ", ".join(
+            f"{e} as c{i}" for i, e in enumerate(
+                rng.choice(_NUM_EXPRS, size=rng.integers(1, 4),
+                           replace=False))
+        )
+        q = f"select a, {cols} from nums"
+        if rng.random() < 0.7:
+            q += f" where {rng.choice(_PREDS)}"
+        q += " order by a"
+        if rng.random() < 0.4:
+            q += f" limit {int(rng.integers(1, 8))}"
+        return q
+    if kind == 1:  # aggregation
+        aggs = ", ".join(
+            f"{f}({c}) as g{i}" for i, (f, c) in enumerate(
+                [(str(rng.choice(["sum", "count", "min", "max", "avg"])),
+                  str(rng.choice(["a", "b", "f"])))
+                 for _ in range(int(rng.integers(1, 4)))])
+        )
+        q = f"select b, {aggs} from nums"
+        if rng.random() < 0.5:
+            q += f" where {rng.choice(_PREDS)}"
+        q += " group by b"
+        if rng.random() < 0.4:
+            q += " having count(*) > 1"
+        q += " order by b"
+        return q
+    if kind == 2:  # scalar aggregate
+        f = str(rng.choice(["sum", "count", "min", "max", "avg"]))
+        c = str(rng.choice(["a", "b", "f"]))
+        q = f"select {f}({c}) as g from nums"
+        if rng.random() < 0.6:
+            q += f" where {rng.choice(_PREDS)}"
+        return q
+    # join
+    q = ("select nums.a, pr.id, pr.w from nums "
+         "join pr on nums.b = pr.k")
+    if rng.random() < 0.5:
+        q += f" where {rng.choice(_PREDS)}"
+    q += " order by nums.a, pr.id"
+    if rng.random() < 0.3:
+        q += f" limit {int(rng.integers(1, 10))}"
+    return q
+
+
+def _cell(v):
+    if v is None:
+        return "NULL"
+    if isinstance(v, float):
+        if v != v:
+            return "NULL"
+        return f"{v:.6g}"
+    return str(v)
+
+
+@pytest.fixture(scope="module")
+def engines():
+    s = Session()
+    lite = sqlite3.connect(":memory:")
+    for stmt in _SETUP:
+        s.execute(stmt)
+        lite.execute(stmt)
+    return s, lite
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_random_query_matches_sqlite(engines, seed):
+    s, lite = engines
+    rng = np.random.default_rng(seed)
+    q = _gen_query(rng)
+    want_rows = lite.execute(q).fetchall()
+    got = s.execute(q)
+    names = list(got.keys())
+    n = len(got[names[0]]) if names else 0
+    got_rows = []
+    for r in range(n):
+        got_rows.append(tuple(_cell(_py(got[c][r])) for c in names))
+    want_rendered = [tuple(_cell(v) for v in row) for row in want_rows]
+    # ORDER BY keys may admit ties: compare as multisets of rendered rows
+    assert sorted(got_rows) == sorted(want_rendered), (
+        f"seed {seed}: {q}\ngot:  {got_rows}\nwant: {want_rendered}"
+    )
+
+
+def _py(v):
+    """numpy scalar / masked None -> python value."""
+    if v is None:
+        return None
+    if isinstance(v, (np.floating,)):
+        f = float(v)
+        return None if f != f else f
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    return v
