@@ -151,13 +151,7 @@ class KVTable:
             return row
         out = dict(row)
         vw = self.db.engine.val_width
-        pending = getattr(t, "_dict_pending", None)
-        if pending is None:
-            pending = t._dict_pending = {}
-        slots = pending.get(id(self))
-        if slots is None:
-            slots = pending[id(self)] = {}  # col -> {str: pending code}
-            t.on_commit(lambda: self._commit_pending(slots))
+        slots = self._pending_slots(t)  # col -> {str: pending code}
         for i in self._string_cols:
             name = self.schema.names[i]
             v = out.get(name)
@@ -165,28 +159,86 @@ class KVTable:
                 continue
             if isinstance(v, (int, np.integer)):
                 continue  # already a code
-            v = str(v)
-            d = self._dicts.setdefault(i, _TableDict())
-            slot = slots.setdefault(i, {})
-            code = d.code_of(v)
-            if code is None:
-                code = slot.get(v)
-            if code is None:
-                enc = v.encode("utf-8")
-                if len(enc) + 2 > vw:
-                    raise ValueError(
-                        f"string of {len(enc)} bytes exceeds engine value "
-                        f"width {vw}"
-                    )
-                code = len(d.values) + len(slot)
-                slot[v] = code
-                t.put(
-                    rowcodec.encode_pk(self.dict_table_id,
-                                       self._dict_pk(i, code)),
-                    len(enc).to_bytes(2, "little") + enc,
-                )
-            out[name] = code
+            out[name] = self._txn_code(t, slots, i, str(v), vw)
         return out
+
+    def _txn_code(self, t: Txn, slots: dict, i: int, v: str,
+                  vw: int) -> int:
+        """Dictionary code for one string value, allocating a txn-pending
+        code (and its companion-span write) on first sight."""
+        d = self._dicts.setdefault(i, _TableDict())
+        slot = slots.setdefault(i, {})
+        code = d.code_of(v)
+        if code is None:
+            code = slot.get(v)
+        if code is None:
+            enc = v.encode("utf-8")
+            if len(enc) + 2 > vw:
+                raise ValueError(
+                    f"string of {len(enc)} bytes exceeds engine value "
+                    f"width {vw}"
+                )
+            code = len(d.values) + len(slot)
+            slot[v] = code
+            t.put(
+                rowcodec.encode_pk(self.dict_table_id,
+                                   self._dict_pk(i, code)),
+                len(enc).to_bytes(2, "little") + enc,
+            )
+        return code
+
+    def _pending_slots(self, t: Txn) -> dict:
+        pending = getattr(t, "_dict_pending", None)
+        if pending is None:
+            pending = t._dict_pending = {}
+        slots = pending.get(id(self))
+        if slots is None:
+            slots = pending[id(self)] = {}
+            t.on_commit(lambda: self._commit_pending(slots))
+        return slots
+
+    def insert_rows(self, t: Txn, columns: dict[str, np.ndarray],
+                    valids: dict[str, np.ndarray] | None = None) -> int:
+        """Vectorized transactional INSERT (the colenc role: the write
+        path encodes columns, not rows — sql/colenc in the reference).
+        Keys and values encode in batched numpy passes; string columns
+        dictionary-encode per UNIQUE value through the same txn-pending
+        discipline as insert(); the txn takes one prepared put per row."""
+        cols = dict(columns)
+        valids = dict(valids or {})
+        n = len(next(iter(cols.values())))
+        vw = self.db.engine.val_width
+        if self._string_cols:
+            slots = self._pending_slots(t)
+            for i in self._string_cols:
+                name = self.schema.names[i]
+                a = cols.get(name)
+                if a is None:
+                    continue
+                arr = np.asarray(a)
+                if arr.dtype.kind in ("i", "u"):
+                    continue  # already codes
+                vmask = valids.get(name)
+                strs = np.array(
+                    ["" if (vmask is not None and not vmask[j])
+                     else str(x) for j, x in enumerate(arr)], dtype=str,
+                )
+                uvals, inverse = np.unique(strs, return_inverse=True)
+                codes = np.empty(len(uvals), dtype=np.int64)
+                for j, v in enumerate(uvals):
+                    codes[j] = self._txn_code(t, slots, i, str(v), vw)
+                cols[name] = codes[inverse]
+        pks = np.asarray(cols[self.pk], dtype=np.int64)
+        keys = rowcodec.encode_pk_batch(self.table_id, pks)
+        values = rowcodec.encode_rows(self.schema, cols, valids)
+        kb = keys.tobytes()
+        vb = values.tobytes()
+        kw = keys.shape[1]
+        vw_row = values.shape[1]
+        for r in range(n):
+            t.put(kb[r * kw:(r + 1) * kw], vb[r * vw_row:(r + 1) * vw_row])
+        self._count_cache = None
+        return n
 
     def _commit_pending(self, slots: dict) -> None:
         for i, mapping in slots.items():
@@ -467,9 +519,13 @@ def write_descriptor(db: DB, t: KVTable, writer=None) -> None:
         w.put(_descriptor_key(t.table_id, ci), piece)
 
 
-def load_catalog_from_engine(catalog, db: DB) -> list[str]:
+def load_catalog_from_engine(catalog, db: DB,
+                             id_range: tuple[int, int] | None = None
+                             ) -> list[str]:
     """Rebuild KVTable entries from persisted descriptors (the catalog
-    bootstrap / lease-free resolution path). Returns the table names."""
+    bootstrap / lease-free resolution path). Returns the table names.
+    id_range scopes discovery to a tenant's table-id slice (kv/tenant.py):
+    a tenant session never even learns other tenants' schemas."""
     import json
 
     from ..coldata.types import Family as F
@@ -486,6 +542,10 @@ def load_catalog_from_engine(catalog, db: DB) -> list[str]:
     for tid in sorted(blobs):
         blob = unchunk([v for _, v in sorted(blobs[tid])])
         desc = json.loads(blob.decode("utf-8"))
+        if id_range is not None and not (
+            id_range[0] <= desc["table_id"] <= id_range[1]
+        ):
+            continue
         types = tuple(
             SQLType(F[d["family"]], width=d["width"],
                     precision=d["precision"], scale=d["scale"])
@@ -499,25 +559,45 @@ def load_catalog_from_engine(catalog, db: DB) -> list[str]:
 
 
 def create_kv_table(catalog, db: DB, name: str, schema: Schema, pk: str,
-                    table_id: int | None = None) -> KVTable:
+                    table_id: int | None = None,
+                    id_range: tuple[int, int] | None = None) -> KVTable:
     """Create + register a KV-backed table in the catalog so sql()/Rel
     scans resolve to it. table_id determines the key-space prefix; ids must
     be unique per engine or spans would overlap. Tables with STRING columns
-    get a second id for the persistent dictionary span."""
+    get a second id for the persistent dictionary span. id_range confines
+    allocation to a tenant's keyspace slice (kv/tenant.py) — the catalog
+    then cannot even address another tenant's spans. Unscoped callers
+    allocate within the SYSTEM tenant's range (1..127), so a legacy
+    session can never squat on a secondary tenant's reserved slice."""
+    from .tenant import _SYSTEM_RANGE
+
+    lo, hi = id_range if id_range is not None else _SYSTEM_RANGE
     used = set()
     for t in catalog.tables.values():
         if isinstance(t, KVTable):
             used.add(t.table_id)
             if t.dict_table_id is not None:
                 used.add(t.dict_table_id)
+
+    def alloc() -> int:
+        # only ids INSIDE the range matter: a foreign tenant's high id in
+        # a shared catalog must neither seed the allocator past `hi` nor
+        # fail an otherwise-empty range
+        nxt = max([i for i in used if lo <= i <= hi], default=lo - 1) + 1
+        if nxt > hi:
+            raise ValueError(
+                f"tenant keyspace [{lo},{hi}] exhausted"
+            )
+        return nxt
+
     if table_id is None:
-        table_id = max(used, default=0) + 1
+        table_id = alloc()
     elif table_id in used:
         raise ValueError(f"table_id {table_id} already in use")
     used.add(table_id)
     dict_table_id = None
     if any(tt.family is Family.STRING for tt in schema.types):
-        dict_table_id = max(used, default=0) + 1
+        dict_table_id = alloc()
     t = KVTable(db, name, schema, pk, table_id, dict_table_id)
     catalog.tables[name] = t
     write_descriptor(db, t)

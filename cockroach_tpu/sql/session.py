@@ -71,25 +71,42 @@ class Session:
 
     def __init__(self, catalog: Catalog | None = None, db: DB | None = None,
                  val_width: int = 128, key_width: int = 16,
-                 bootstrap: bool = True):
+                 bootstrap: bool = True, tenant: str | None = None):
         """bootstrap=False skips the catalog rediscovery scan — for servers
         (pgwire) that bootstrap the shared catalog ONCE and hand every
         connection's session the prebuilt one (re-running the descriptor
         scan per connection would replace live KVTable objects under
-        concurrently executing sessions)."""
+        concurrently executing sessions).
+
+        tenant: run this session AS the named tenant over the shared KV
+        store (kv/tenant.py) — catalog discovery and table creation are
+        confined to the tenant's table-id range, and capability checks
+        gate CREATE TABLE / BACKUP. None = the unscoped legacy session
+        (system-tenant powers, no restrictions)."""
         self.catalog = catalog if catalog is not None else Catalog()
         self.db = db if db is not None else DB(
             Engine(key_width=key_width, val_width=val_width,
                    memtable_size=4096),
             Clock(),
         )
+        self.tenant = None
+        if tenant is not None:
+            from ..kv.tenant import TenantRegistry
+
+            reg = TenantRegistry(self.db)
+            reg.bootstrap()
+            self.tenant = reg.get(tenant)
         if db is not None and bootstrap:
             # opening over an existing store: rediscover persisted tables
             # from their descriptors (the catalog bootstrap path), plus any
             # persisted ANALYZE statistics (system.table_statistics role)
             from ..kv.table import load_catalog_from_engine
 
-            load_catalog_from_engine(self.catalog, self.db)
+            load_catalog_from_engine(
+                self.catalog, self.db,
+                id_range=(None if self.tenant is None
+                          else (self.tenant.id_lo, self.tenant.id_hi)),
+            )
             from . import stats as stats_mod
 
             for tbl in self.catalog.tables.values():
@@ -389,6 +406,60 @@ class Session:
             }
         return None
 
+    def _maybe_tenant_stmt(self, t: str):
+        """CREATE/DROP/SHOW/ALTER TENANT — the system tenant's DDL surface
+        (reference: SQL tenant builtins + tenantcapabilities; reduced to
+        the capability grammar the capability set here supports)."""
+        import re as _re
+
+        import numpy as _np
+
+        from ..kv.tenant import TenantError, TenantRegistry
+
+        def require_system():
+            if self.tenant is not None and self.tenant.name != "system":
+                raise TenantError(
+                    "tenant DDL requires the system tenant"
+                )
+            reg = TenantRegistry(self.db)
+            reg.bootstrap()
+            return reg
+
+        m = _re.match(r"(?is)^create\s+tenant\s+'?([a-z0-9_]+)'?$", t)
+        if m:
+            rec = require_system().create(m.group(1))
+            return {"tenant_id": rec.tenant_id, "name": rec.name}
+        m = _re.match(r"(?is)^drop\s+tenant\s+'?([a-z0-9_]+)'?$", t)
+        if m:
+            require_system().drop(m.group(1))
+            return {"dropped": m.group(1)}
+        if _re.match(r"(?is)^show\s+tenants$", t):
+            recs = require_system().list()
+            return {
+                "id": _np.array([r.tenant_id for r in recs],
+                                dtype=_np.int64),
+                "name": _np.array([r.name for r in recs], dtype=object),
+                "capabilities": _np.array(
+                    [",".join(f"{k}={v}" for k, v in sorted(r.caps.items()))
+                     for r in recs], dtype=object),
+            }
+        m = _re.match(
+            r"(?is)^alter\s+tenant\s+'?([a-z0-9_]+)'?\s+"
+            r"(grant|revoke)\s+capability\s+([a-z0-9_]+)$", t)
+        if m:
+            cap = m.group(3).lower()
+            if cap not in ("can_create_table", "can_backup"):
+                # GRANT/REVOKE writes booleans: numeric caps (max_tables)
+                # would silently corrupt
+                raise TenantError(f"unknown boolean capability {cap!r}")
+            rec = require_system().set_capability(
+                m.group(1), cap,
+                m.group(2).lower() == "grant",
+            )
+            return {"tenant": rec.name,
+                    m.group(3).lower(): rec.caps[m.group(3).lower()]}
+        return None
+
     def _maybe_admin_stmt(self, text: str):
         """BACKUP TO '<path>' / RESTORE FROM '<path>' / SHOW JOBS — the
         jobs-backed admin surface (BACKUP runs as a job, exactly the
@@ -397,8 +468,15 @@ class Session:
         import re as _re
 
         t = text.strip().rstrip(";")
+        handled = self._maybe_tenant_stmt(t)
+        if handled is not None:
+            return handled
         m = _re.match(r"(?is)^backup\s+to\s+'([^']+)'$", t)
         if m:
+            if self.tenant is not None:
+                from ..kv.tenant import check_capability
+
+                check_capability(self.tenant, "can_backup")
             from ..kv.jobs import Registry, register_builtin_jobs
 
             reg = self._jobs_registry()
@@ -408,6 +486,14 @@ class Session:
             return {"job_id": done.job_id, "state": done.state}
         m = _re.match(r"(?is)^restore\s+from\s+'([^']+)'$", t)
         if m:
+            if self.tenant is not None and self.tenant.name != "system":
+                from ..kv.tenant import CapabilityError
+
+                # RESTORE swaps the SHARED engine state — system only
+                raise CapabilityError(
+                    "RESTORE requires the system tenant (it replaces the "
+                    "shared store)"
+                )
             from ..storage.lsm import Engine as _Engine
             from ..utils.external_storage import resolve_dir_uri
 
@@ -567,7 +653,23 @@ class Session:
                 f"{self.db.engine.val_width}; open the Session with "
                 f"val_width>={need}"
             )
-        create_kv_table(self.catalog, self.db, stmt.name, schema, pk=pks[0])
+        id_range = None
+        if self.tenant is not None:
+            from ..kv.tenant import check_capability
+
+            check_capability(self.tenant, "can_create_table")
+            n_tables = sum(1 for t in self.catalog.tables.values()
+                           if isinstance(t, KVTable))
+            if n_tables >= int(self.tenant.caps.get("max_tables", 1 << 30)):
+                from ..kv.tenant import CapabilityError
+
+                raise CapabilityError(
+                    f"tenant {self.tenant.name!r} reached its max_tables "
+                    f"({self.tenant.caps['max_tables']})"
+                )
+            id_range = (self.tenant.id_lo, self.tenant.id_hi)
+        create_kv_table(self.catalog, self.db, stmt.name, schema,
+                        pk=pks[0], id_range=id_range)
         return {"created": stmt.name}
 
     def _alter_table(self, stmt: P.AlterTable):
@@ -640,6 +742,19 @@ class Session:
         if isinstance(e, (P.Bin,)):
             raise NotALiteral("expression references columns")
         if isinstance(e, P.StrLit):
+            if t.family is T.Family.DATE:
+                # postgres coerces 'YYYY-MM-DD' literals to DATE in
+                # context. Explicit 'D' unit: an unqualified datetime64
+                # infers resolution from the string, so a timestamp-shaped
+                # literal would silently store MINUTES as a day count
+                try:
+                    return int((np.datetime64(e.value, "D") -
+                                np.datetime64("1970-01-01", "D")
+                                ).astype(int))
+                except ValueError as err:
+                    raise BindError(
+                        f"invalid DATE literal {e.value!r}: {err}"
+                    ) from None
             if t.family is not T.Family.STRING:
                 raise BindError("string literal for non-STRING column")
             return e.value  # KVTable dictionary-encodes on insert
@@ -669,17 +784,57 @@ class Session:
                     for j in range(len(names))
                 })
         else:
-            rows = []
+            # columnar VALUES path (colenc discipline: encode columns, not
+            # rows — the vectorized write path; sql/colenc in the
+            # reference). Literals land in per-column lists and batch-
+            # encode through KVTable.insert_rows.
+            per_name: dict[str, list] = {n: [] for n in names}
             for vals in stmt.rows:
                 if len(vals) != len(names):
                     raise BindError(
                         f"INSERT row has {len(vals)} values, expected "
                         f"{len(names)}"
                     )
-                rows.append({
-                    n: self._literal(v, t.schema.type_of(n))
-                    for n, v in zip(names, vals)
-                })
+                for n, v in zip(names, vals):
+                    per_name[n].append(
+                        self._literal(v, t.schema.type_of(n))
+                    )
+            missing = set(t.schema.names) - set(names)
+            if missing:
+                raise BindError(f"columns {sorted(missing)} need values "
+                                "(defaults not supported)")
+            nrows = len(stmt.rows)
+            cols: dict[str, np.ndarray] = {}
+            valids: dict[str, np.ndarray] = {}
+            for n in names:
+                vals = per_name[n]
+                typ = t.schema.type_of(n)
+                valid = np.array([v is not None for v in vals], dtype=bool)
+                if not valid.all():
+                    valids[n] = valid
+                if typ.family is T.Family.STRING:
+                    cols[n] = np.array(
+                        ["" if v is None else v for v in vals],
+                        dtype=object,
+                    )
+                elif typ.family is T.Family.FLOAT:
+                    cols[n] = np.array(
+                        [0.0 if v is None else float(v) for v in vals],
+                        dtype=np.float64,
+                    )
+                else:
+                    cols[n] = np.array(
+                        [0 if v is None else int(v) for v in vals],
+                        dtype=np.int64,
+                    )
+            if t.pk in valids:
+                raise BindError("NULL primary key")
+
+            def vop(txn):
+                t.insert_rows(txn, cols, valids)
+
+            self._run_write(vop)
+            return {"rows_affected": nrows}
         missing = set(t.schema.names) - set(names)
         if missing:
             raise BindError(f"columns {sorted(missing)} need values "

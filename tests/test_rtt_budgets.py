@@ -1,0 +1,90 @@
+"""Per-statement KV-operation budgets — the pkg/bench/rttanalysis analog.
+
+The reference asserts each SQL statement shape performs a bounded number
+of KV round-trips (rttanalysis.RoundTripBenchTestCase); regressions that
+add a lookup per row or an extra scan per statement fail CI. Here the
+"round trips" are engine-level ops (storage_writes / storage_scans
+counters): the same regression class — a DML path quietly degrading to
+per-row scans — trips these budgets."""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.sql.session import Session
+from cockroach_tpu.utils import metric
+
+
+class OpCounts:
+    def __enter__(self):
+        self.w0 = metric.ENGINE_WRITES.value
+        self.s0 = metric.ENGINE_SCANS.value
+        return self
+
+    def __exit__(self, *exc):
+        self.writes = metric.ENGINE_WRITES.value - self.w0
+        self.scans = metric.ENGINE_SCANS.value - self.s0
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("CREATE TABLE kvt (k INT PRIMARY KEY, v INT, s STRING)")
+    s.execute("INSERT INTO kvt VALUES " + ", ".join(
+        f"({i}, {i * 10}, 'tag{i % 7}')" for i in range(200)
+    ))
+    return s
+
+
+def test_multirow_insert_write_budget(sess):
+    """One INSERT of 100 rows must not degrade to per-row engine writes
+    beyond row count + constant overhead (txn record, dictionary)."""
+    with OpCounts() as c:
+        sess.execute("INSERT INTO kvt VALUES " + ", ".join(
+            f"({i}, 1, 'x')" for i in range(1000, 1100)
+        ))
+    assert c.writes <= 100 + 20, c.writes
+    assert c.scans <= 6, c.scans
+
+
+def test_point_select_scan_budget(sess):
+    with OpCounts() as c:
+        out = sess.execute("SELECT v FROM kvt WHERE k = 42")
+    assert list(np.asarray(out["v"])) == [420]
+    assert c.scans <= 2, c.scans
+    assert c.writes == 0, c.writes
+
+
+def test_full_scan_budget(sess):
+    """A full-table SELECT is one columnar scan, not per-row gets."""
+    with OpCounts() as c:
+        out = sess.execute("SELECT count(v) AS n FROM kvt")
+    assert int(np.asarray(out["n"])[0]) == 200
+    assert c.scans <= 2, c.scans
+
+
+def test_update_budget(sess):
+    """UPDATE of ~30 rows: bounded by one scan + one write per row +
+    constant overhead."""
+    with OpCounts() as c:
+        sess.execute("UPDATE kvt SET v = v + 1 WHERE k < 30")
+    assert c.scans <= 4, c.scans
+    assert c.writes <= 30 + 10, c.writes
+
+
+def test_delete_budget(sess):
+    with OpCounts() as c:
+        sess.execute("DELETE FROM kvt WHERE k >= 190")
+    assert c.scans <= 4, c.scans
+    assert c.writes <= 10 + 10, c.writes
+
+
+def test_txn_block_budget(sess):
+    """BEGIN; two point writes; COMMIT — constant op count (no hidden
+    re-scans at commit)."""
+    with OpCounts() as c:
+        sess.execute("BEGIN")
+        sess.execute("INSERT INTO kvt VALUES (5001, 1, 'a')")
+        sess.execute("INSERT INTO kvt VALUES (5002, 2, 'b')")
+        sess.execute("COMMIT")
+    assert c.writes <= 2 + 12, c.writes
+    assert c.scans <= 6, c.scans
