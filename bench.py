@@ -433,6 +433,16 @@ def main() -> None:
     jobs = list(qnames)
     if os.environ.get("BENCH_YCSB", "1") != "0":
         jobs.append("ycsb")
+
+    def record(res) -> None:
+        _partial["platform"] = res.pop("platform", platform)
+        job_name = res.pop("job")
+        if job_name == "ycsb":
+            _partial["detail"]["ycsb_e_1m"] = res
+        else:
+            _partial["detail"][job_name] = res
+
+    failed: list[str] = []
     for i, job in enumerate(jobs):
         remaining = deadline_s - (time.time() - start) - 30.0
         if remaining < 60.0:
@@ -446,13 +456,21 @@ def main() -> None:
         budget = min(budget, remaining)
         res = _run_worker(job, budget, env)
         if res is None:
+            failed.append(job)
             continue
-        _partial["platform"] = res.pop("platform", platform)
-        job_name = res.pop("job")
-        if job_name == "ycsb":
-            _partial["detail"]["ycsb_e_1m"] = res
-        else:
-            _partial["detail"][job_name] = res
+        record(res)
+    # second pass: a worker that died mid-cold-compile left its finished
+    # kernels in the persistent cache (.jax_cache), so a retry skips them
+    # and usually fits easily in whatever deadline remains
+    for job in failed:
+        remaining = deadline_s - (time.time() - start) - 30.0
+        if remaining < 120.0:
+            break
+        print(f"# retrying {job} (cache warmed by first attempt)",
+              file=sys.stderr, flush=True)
+        res = _run_worker(job, remaining, env)
+        if res is not None:
+            record(res)
     _emit(final=True)
 
 
