@@ -461,14 +461,20 @@ def main() -> None:
         record(res)
     # second pass: a worker that died mid-cold-compile left its finished
     # kernels in the persistent cache (.jax_cache), so a retry skips them
-    # and usually fits easily in whatever deadline remains
-    for job in failed:
+    # and usually fits easily in whatever deadline remains. Budget splits
+    # across the remaining retries — one wedged retry must forfeit only
+    # its own share, same as the first pass
+    for i, job in enumerate(failed):
         remaining = deadline_s - (time.time() - start) - 30.0
         if remaining < 120.0:
-            break
-        print(f"# retrying {job} (cache warmed by first attempt)",
-              file=sys.stderr, flush=True)
-        res = _run_worker(job, remaining, env)
+            _partial["errors"].append(
+                f"{job}: retry skipped (deadline: {remaining:.0f}s left)"
+            )
+            continue
+        budget = max(120.0, remaining / (len(failed) - i))
+        print(f"# retrying {job} (cache warmed by first attempt, "
+              f"{budget:.0f}s)", file=sys.stderr, flush=True)
+        res = _run_worker(job, budget, env)
         if res is not None:
             record(res)
     _emit(final=True)

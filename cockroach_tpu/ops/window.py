@@ -45,7 +45,13 @@ class WindowSpec:
     row counts, None in either slot meaning UNBOUNDED — e.g. (2, 0) is
     ROWS BETWEEN 2 PRECEDING AND CURRENT ROW, (None, 0) equals
     running=True, (1, 1) a centered 3-row window. Applies to
-    sum/count/avg/min/max/first_value/last_value."""
+    sum/count/avg/min/max/first_value/last_value.
+
+    frame_kind='range' reads the same (preceding, following) tuple as
+    ORDER-BY-VALUE offsets (RANGE BETWEEN x PRECEDING AND y FOLLOWING):
+    the frame holds every row whose single numeric order key lies within
+    the offset window of the current row's value — offset 0 is exactly
+    CURRENT ROW's peer-inclusive semantics."""
 
     func: str
     col: int | None = None
@@ -53,6 +59,7 @@ class WindowSpec:
     offset: int = 1
     running: bool = False
     frame: tuple | None = None
+    frame_kind: str = "rows"
 
 
 def window_output_type(spec: WindowSpec, schema: Schema) -> SQLType:
@@ -137,8 +144,26 @@ def compute_windows(
             "sum", "count", "avg", "min", "max", "first_value",
             "last_value",
         ):
+            if (spec.frame_kind == "range"
+                    and any(x not in (None, 0) for x in spec.frame)):
+                # offset RANGE frames need one numeric key (Postgres
+                # rule); peer-only frames (UNBOUNDED/CURRENT ROW) work
+                # positionally for any order-key shape
+                if len(order_keys) != 1:
+                    raise ValueError(
+                        "RANGE frames with offsets require exactly one "
+                        "ORDER BY key (Postgres rule)"
+                    )
+                fam = schema.types[order_keys[0].col].family
+                if fam not in (Family.INT, Family.FLOAT, Family.DECIMAL,
+                               Family.DATE):
+                    raise ValueError(
+                        f"RANGE frame offsets need a numeric order key, "
+                        f"got {fam}"
+                    )
             d, v = _framed_window(b, schema, spec, seg, start_of, seg_end,
-                                  pos, rank_tables)
+                                  pos, rank_tables, order_keys=order_keys,
+                                  peer_boundary=peer_boundary)
             new_cols.append(Column(data=d, valid=v & b.mask))
             continue
         if spec.func == "row_number":
@@ -345,15 +370,146 @@ def _rmq_query(table: jax.Array, op, lo: jax.Array, hi: jax.Array):
     return op(a, c)
 
 
-def _framed_window(b: Batch, schema: Schema, spec: WindowSpec, seg,
-                   start_of, seg_end, pos, rank_tables):
-    """General ROWS BETWEEN frame for the aggregate window functions:
-    per-row frame bounds clamp to the partition; sums/counts/avgs answer
-    by prefix-sum difference, min/max by RMQ sparse table, first/last by
-    a gather at the frame edge."""
+def _lower_bound(u, q, lo0, hi0, strict: bool = False):
+    """Per-row binary search: smallest idx in [lo0, hi0] with u[idx] >= q
+    (u[idx] > q when strict; hi0+1 when none) — vectorized, log2(cap)
+    gather steps. The strict flag exists because the nextafter(q) trick
+    dies on XLA:CPU's denormal flush (nextafter(0.0) -> 5e-324 -> 0.0)."""
+    n = u.shape[0]
+    lo = lo0.astype(jnp.int64)
+    hi = hi0.astype(jnp.int64) + 1
+    for _ in range(max(1, int(np.ceil(np.log2(max(n, 2)))) + 1)):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        um = u[jnp.clip(mid, 0, n - 1)]
+        go_left = (um > q) if strict else (um >= q)
+        hi = jnp.where(active & go_left, mid, hi)
+        lo = jnp.where(active & ~go_left, mid + 1, lo)
+    return lo
+
+
+def _seg_run(pos, seg, member, cap, start_fallback, end_fallback):
+    """Per-row [first, last] position of the rows where `member` holds,
+    within the row's segment (fallbacks when the segment has none)."""
+    first = jax.ops.segment_min(
+        jnp.where(member, pos, cap), seg, num_segments=cap
+    )[seg]
+    last = jax.ops.segment_max(
+        jnp.where(member, pos, -1), seg, num_segments=cap
+    )[seg]
+    return (jnp.where(first == cap, start_fallback, first),
+            jnp.where(last == -1, end_fallback, last))
+
+
+def _range_bounds(b: Batch, schema: Schema, spec: WindowSpec, order_keys,
+                  seg, pos, start_of, seg_end):
+    """Per-row RANGE frame bounds over the single numeric order key.
+
+    Finite keys binary-search the SORT-transformed space u = sign*value
+    (one monotone window [u_i - pre, u_i + fol] expresses ASC and DESC
+    alike), searching only the segment's finite run. NULL rows — and,
+    for floats, non-finite peer groups (NaN, +/-inf) — take their frames
+    POSITIONALLY from their contiguous peer run instead, which keeps
+    them exact with no sentinel arithmetic (a valid -inf key can never
+    collide with a NULL encoding, and NaN frames are their peers, not
+    empty). INT/DECIMAL/DATE keys search in exact int64; only FLOAT keys
+    use float64 (documented: int keys are exact at any magnitude)."""
+    cap = b.capacity
+    k = order_keys[0]
+    oc = b.cols[k.col]
+    t = schema.types[k.col]
+    valid = oc.valid & b.mask
     p, f = spec.frame
-    lo = start_of if p is None else jnp.maximum(start_of, pos - int(p))
-    hi = seg_end if f is None else jnp.minimum(seg_end, pos + int(f))
+
+    if t.family is Family.FLOAT:
+        data = oc.data.astype(jnp.float64)
+        finite = valid & jnp.isfinite(data)
+        sign = -1.0 if k.desc else 1.0
+        u = sign * data
+        pre = None if p is None else float(p)
+        fol = None if f is None else float(f)
+    else:
+        scale = 10 ** t.scale if t.family is Family.DECIMAL else 1
+        data = oc.data.astype(jnp.int64)
+        finite = valid
+        sign = -1 if k.desc else 1
+        u = sign * data
+        pre = None if p is None else int(round(float(p) * scale))
+        fol = None if f is None else int(round(float(f) * scale))
+
+    # masked-out positions must never satisfy a comparison: park them at
+    # the far end of the search space (searches are bounded to the finite
+    # run anyway; this only guards the clipped gathers)
+    fin_start, fin_end = _seg_run(pos, seg, finite, cap, start_of, seg_end)
+    u = jnp.where(finite, u, jnp.asarray(np.inf if u.dtype == jnp.float64
+                                         else np.iinfo(np.int64).max,
+                                         u.dtype))
+
+    lo = start_of if pre is None else _lower_bound(
+        u, u - pre, fin_start, fin_end
+    )
+    if fol is None:
+        hi = seg_end
+    else:
+        # last idx with u <= q == (first idx with u > q) - 1
+        first_gt = _lower_bound(u, u + fol, fin_start, fin_end,
+                                strict=True)
+        hi = first_gt - 1
+
+    # non-finite peer groups (NULLs always; NaN/±inf for floats) frame to
+    # their own contiguous run — unbounded ends still reach the partition
+    # edge (Postgres: such rows are peers; offsets don't move their frame)
+    def run_frame(member):
+        r_start, r_end = _seg_run(pos, seg, member, cap, start_of, seg_end)
+        rlo = start_of if p is None else r_start
+        rhi = seg_end if f is None else r_end
+        return rlo, rhi
+
+    is_null = b.mask & ~oc.valid
+    nlo, nhi = run_frame(is_null)
+    lo = jnp.where(is_null, nlo, lo)
+    hi = jnp.where(is_null, nhi, hi)
+    if t.family is Family.FLOAT:
+        fd = oc.data.astype(jnp.float64)
+        for member in (valid & jnp.isnan(fd),
+                       valid & jnp.isposinf(fd),
+                       valid & jnp.isneginf(fd)):
+            mlo, mhi = run_frame(member)
+            lo = jnp.where(member, mlo, lo)
+            hi = jnp.where(member, mhi, hi)
+    return lo.astype(start_of.dtype), hi.astype(seg_end.dtype)
+
+
+def _framed_window(b: Batch, schema: Schema, spec: WindowSpec, seg,
+                   start_of, seg_end, pos, rank_tables, order_keys=(),
+                   peer_boundary=None):
+    """General ROWS/RANGE BETWEEN frame for the aggregate window
+    functions: per-row frame bounds clamp to the partition; sums/counts/
+    avgs answer by prefix-sum difference, min/max by RMQ sparse table,
+    first/last by a gather at the frame edge."""
+    p, f = spec.frame
+    if spec.frame_kind == "range":
+        if all(x in (None, 0) for x in spec.frame):
+            # peer-only frame (the SQL default shape): bounds are the
+            # current row's peer run — positional, any order-key type
+            peer_id = jnp.cumsum(
+                jnp.asarray(peer_boundary).astype(jnp.int32)
+            ) - 1
+            cap = b.capacity
+            ps = jax.ops.segment_min(
+                jnp.where(b.mask, pos, cap), peer_id, num_segments=cap
+            )[peer_id]
+            pe = jax.ops.segment_max(
+                jnp.where(b.mask, pos, -1), peer_id, num_segments=cap
+            )[peer_id]
+            lo = start_of if p is None else ps
+            hi = seg_end if f is None else pe
+        else:
+            lo, hi = _range_bounds(b, schema, spec, order_keys, seg, pos,
+                                   start_of, seg_end)
+    else:
+        lo = start_of if p is None else jnp.maximum(start_of, pos - int(p))
+        hi = seg_end if f is None else jnp.minimum(seg_end, pos + int(f))
     loc = jnp.clip(lo, 0, b.capacity - 1)
     hic = jnp.clip(hi, 0, b.capacity - 1)
     empty = hi < lo  # e.g. 2 FOLLOWING AND 3 FOLLOWING past the edge

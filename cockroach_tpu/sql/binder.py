@@ -1320,15 +1320,24 @@ class Binder:
                 (colname(e, "ORDER BY"), desc) for e, desc in wc.order_by
             )
             frame = wc.frame
+            default_kind = "rows"
             if not wc.has_frame_clause and func in (
                 self._WINDOW_AGGS | {"first_value", "last_value"}
             ):
                 # SQL default: cumulative with ORDER BY, whole partition
-                # without (RANGE->ROWS reduction documented above).
-                # first/last_value follow the same default frame — SQL's
-                # last_value with ORDER BY is the CURRENT row, not the
-                # partition's last
+                # without. The true default is RANGE UNBOUNDED PRECEDING
+                # .. CURRENT ROW (peer-INCLUSIVE); the range kernel needs
+                # a single numeric order key, so that shape gets the exact
+                # semantics and everything else keeps the ROWS reduction
+                # (divergence only for ties on string/multi-key orders)
                 frame = (None, 0) if order else None
+                if order and len(order) == 1:
+                    i = rel.idx(order[0][0])
+                    from ..coldata.types import Family as _F
+
+                    if rel.schema.types[i].family in (
+                            _F.INT, _F.FLOAT, _F.DECIMAL, _F.DATE):
+                        default_kind = "range"
             arg = None
             offset = 1
             if func in ("lag", "lead"):
@@ -1367,11 +1376,31 @@ class Binder:
                 out = f"_{out}w"
             used.add(out)
             names[id(wc)] = out
-            groups.setdefault((parts, order, frame), []).append(
+            fkind = wc.frame_kind if wc.has_frame_clause else default_kind
+            if fkind == "range" and wc.has_frame_clause:
+                # Postgres rule: RANGE with offsets needs exactly one
+                # NUMERIC ORDER BY key; peer-only frames (UNBOUNDED /
+                # CURRENT ROW bounds) work for any order-key shape
+                if any(b not in (None, 0) for b in (wc.frame or ())):
+                    if len(order) != 1:
+                        raise BindError(
+                            "RANGE frame with offsets requires exactly "
+                            "one ORDER BY key"
+                        )
+                    from ..coldata.types import Family as _F
+
+                    fam = rel.schema.types[rel.idx(order[0][0])].family
+                    if fam not in (_F.INT, _F.FLOAT, _F.DECIMAL, _F.DATE):
+                        raise BindError(
+                            "RANGE frame offsets require a numeric "
+                            f"ORDER BY key, got {fam.name}"
+                        )
+            groups.setdefault((parts, order, frame, fkind), []).append(
                 (out, func, arg, offset)
             )
-        for (parts, order, frame), funcs in groups.items():
-            rel = rel.window(list(parts), list(order), funcs, frame=frame)
+        for (parts, order, frame, fkind), funcs in groups.items():
+            rel = rel.window(list(parts), list(order), funcs, frame=frame,
+                             frame_kind=fkind)
         return rel, names
 
     def _project(self, sel: P.Select, rel: Rel, resolver=None,

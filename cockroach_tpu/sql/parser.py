@@ -40,8 +40,8 @@ KEYWORDS = {
     "substring", "for", "true", "false", "any", "some", "with",
     "create", "table", "primary", "key", "insert", "into", "values",
     "update", "set", "delete", "default", "alter", "add", "column", "drop",
-    "over", "partition", "rows", "unbounded", "preceding", "following",
-    "current", "row",
+    "over", "partition", "rows", "range", "unbounded", "preceding",
+    "following", "current", "row",
 }
 
 
@@ -142,6 +142,7 @@ class WindowCall(Node):
     order_by: tuple[tuple[Node, bool], ...] = ()  # (expr, desc)
     frame: tuple | None = None
     has_frame_clause: bool = False
+    frame_kind: str = "rows"  # "rows" | "range"
 
 
 @dataclass(frozen=True)
@@ -888,18 +889,24 @@ class Parser:
                 order.append((e, desc))
                 if not self.eat_op(","):
                     break
-        if self.eat_kw("rows"):
+        frame_kind = "rows"
+        if self.eat_kw("rows") or self.eat_kw("range"):
+            if self.toks[self.i - 1].value == "range":
+                frame_kind = "range"
             has_frame = True
             self.expect_kw("between")
-            frame = (self._frame_bound(preceding=True),
-                     self._frame_bound(preceding=False))
+            frame = (self._frame_bound(preceding=True, kind=frame_kind),
+                     self._frame_bound(preceding=False, kind=frame_kind))
             # BETWEEN's middle AND
         self.expect_op(")")
-        return WindowCall(fc, tuple(parts), tuple(order), frame, has_frame)
+        return WindowCall(fc, tuple(parts), tuple(order), frame, has_frame,
+                          frame_kind)
 
-    def _frame_bound(self, preceding: bool):
-        """One ROWS bound -> row count relative to the current row (None =
-        UNBOUNDED). The leading bound consumes the AND separator."""
+    def _frame_bound(self, preceding: bool, kind: str = "rows"):
+        """One ROWS/RANGE bound -> offset relative to the current row
+        (None = UNBOUNDED; ROWS counts rows, RANGE measures order-key
+        values and admits non-integer offsets). The leading bound consumes
+        the AND separator."""
         if self.eat_kw("unbounded"):
             # the start bound must say PRECEDING, the end bound FOLLOWING
             self.expect_kw("preceding" if preceding else "following")
@@ -913,7 +920,9 @@ class Parser:
                 raise SyntaxError(
                     f"expected a frame bound at {t.pos}: {t.value!r}"
                 )
-            n = int(t.value)
+            n = float(t.value) if kind == "range" else int(t.value)
+            if isinstance(n, float) and n.is_integer():
+                n = int(n)
             if self.eat_kw("preceding"):
                 out = n if preceding else -n
             else:

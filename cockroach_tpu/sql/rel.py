@@ -238,12 +238,14 @@ class Rel:
 
     def window(self, partition_by: list[str], order_by: list[tuple[str, bool]],
                funcs: list[tuple[str, str, str | None]],
-               running: bool = False, frame: tuple | None = None) -> "Rel":
+               running: bool = False, frame: tuple | None = None,
+               frame_kind: str = "rows") -> "Rel":
         """funcs: (output name, window func, input col name or None).
         running=True selects the cumulative frame for aggregates; `frame`
         is the general ROWS BETWEEN spec as (preceding, following) row
         counts with None meaning UNBOUNDED — e.g. frame=(2, 0) is ROWS
-        BETWEEN 2 PRECEDING AND CURRENT ROW."""
+        BETWEEN 2 PRECEDING AND CURRENT ROW. frame_kind='range' reads the
+        bounds as ORDER-BY-VALUE offsets instead (RANGE BETWEEN)."""
         from ..ops import sort as sort_ops
         from ..ops import window as win_ops
 
@@ -253,7 +255,7 @@ class Rel:
         specs = tuple(
             win_ops.WindowSpec(
                 a[1], None if a[2] is None else self.idx(a[2]), a[0],
-                running=running, frame=frame,
+                running=running, frame=frame, frame_kind=frame_kind,
                 **({"offset": a[3]} if len(a) > 3 else {}),
             )
             for a in funcs

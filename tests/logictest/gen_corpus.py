@@ -349,6 +349,32 @@ def _window_matrix() -> list[tuple[str, str, str]]:
                 "select a, last_value(a) over (partition by b order by a "
                 "rows between unbounded preceding and unbounded following)"
                 " from nums order by a"))
+    # RANGE frames: value-offset windows over a TIED order key (b repeats)
+    # — exercises peer-inclusive semantics sqlite and this engine share,
+    # including NULL order keys framing to their own peer group
+    for agg, types in [("sum(a)", "II"), ("count(a)", "II"),
+                       ("min(a)", "II"), ("avg(a)", "IR")]:
+        out.append((types, "",
+                    f"select a, {agg} over (order by b range between 10 "
+                    "preceding and 10 following) from nums order by a"))
+        out.append((types, "",
+                    f"select a, {agg} over (order by b range between 5 "
+                    "preceding and current row) from nums order by a"))
+        out.append((types, "",
+                    f"select a, {agg} over (partition by s order by b "
+                    "range between 20 preceding and 0 following) "
+                    "from nums order by a"))
+        # default frame over a tied key: RANGE peer-inclusive cumulative
+        out.append((types, "",
+                    f"select a, {agg} over (order by b) from nums "
+                    "order by a"))
+    out.append(("II", "",
+                "select a, sum(a) over (order by b desc range between 10 "
+                "preceding and 10 following) from nums order by a"))
+    out.append(("II", "",
+                "select a, sum(a) over (order by b range between "
+                "unbounded preceding and 0 following) from nums "
+                "order by a"))
     return out
 
 
