@@ -51,7 +51,13 @@ class WindowSpec:
     ORDER-BY-VALUE offsets (RANGE BETWEEN x PRECEDING AND y FOLLOWING):
     the frame holds every row whose single numeric order key lies within
     the offset window of the current row's value — offset 0 is exactly
-    CURRENT ROW's peer-inclusive semantics."""
+    CURRENT ROW's peer-inclusive semantics.
+
+    frame_kind='groups' counts whole PEER GROUPS instead (GROUPS BETWEEN
+    n PRECEDING AND m FOLLOWING): the frame spans from the n-th peer
+    group before the current row's group to the m-th after, any order-key
+    shape (peer ids are integers, so the same binary-search machinery
+    answers it exactly)."""
 
     func: str
     col: int | None = None
@@ -488,7 +494,23 @@ def _framed_window(b: Batch, schema: Schema, spec: WindowSpec, seg,
     avgs answer by prefix-sum difference, min/max by RMQ sparse table,
     first/last by a gather at the frame edge."""
     p, f = spec.frame
-    if spec.frame_kind == "range":
+    if spec.frame_kind == "groups":
+        # GROUPS frames: the peer-id sequence is nondecreasing across the
+        # whole sorted batch, so group-offset bounds are integer binary
+        # searches over it, clamped to the segment
+        peer_id = jnp.cumsum(
+            jnp.asarray(peer_boundary).astype(jnp.int64)
+        ) - 1
+        lo = start_of if p is None else _lower_bound(
+            peer_id, peer_id - int(p), start_of, seg_end
+        )
+        if f is None:
+            hi = seg_end
+        else:
+            first_gt = _lower_bound(peer_id, peer_id + int(f),
+                                    start_of, seg_end, strict=True)
+            hi = first_gt - 1
+    elif spec.frame_kind == "range":
         if all(x in (None, 0) for x in spec.frame):
             # peer-only frame (the SQL default shape): bounds are the
             # current row's peer run — positional, any order-key type

@@ -1377,6 +1377,8 @@ class Binder:
             used.add(out)
             names[id(wc)] = out
             fkind = wc.frame_kind if wc.has_frame_clause else default_kind
+            if fkind == "groups" and wc.has_frame_clause and not order:
+                raise BindError("GROUPS mode requires an ORDER BY clause")
             if fkind == "range" and wc.has_frame_clause:
                 # Postgres rule: RANGE with offsets needs exactly one
                 # NUMERIC ORDER BY key; peer-only frames (UNBOUNDED /

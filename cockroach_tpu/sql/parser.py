@@ -40,8 +40,8 @@ KEYWORDS = {
     "substring", "for", "true", "false", "any", "some", "with",
     "create", "table", "primary", "key", "insert", "into", "values",
     "update", "set", "delete", "default", "alter", "add", "column", "drop",
-    "over", "partition", "rows", "range", "unbounded", "preceding",
-    "following", "current", "row",
+    "over", "partition", "rows", "range", "groups", "unbounded",
+    "preceding", "following", "current", "row",
 }
 
 
@@ -890,9 +890,10 @@ class Parser:
                 if not self.eat_op(","):
                     break
         frame_kind = "rows"
-        if self.eat_kw("rows") or self.eat_kw("range"):
-            if self.toks[self.i - 1].value == "range":
-                frame_kind = "range"
+        if (self.eat_kw("rows") or self.eat_kw("range")
+                or self.eat_kw("groups")):
+            if self.toks[self.i - 1].value in ("range", "groups"):
+                frame_kind = self.toks[self.i - 1].value
             has_frame = True
             self.expect_kw("between")
             frame = (self._frame_bound(preceding=True, kind=frame_kind),

@@ -375,6 +375,22 @@ def _window_matrix() -> list[tuple[str, str, str]]:
                 "select a, sum(a) over (order by b range between "
                 "unbounded preceding and 0 following) from nums "
                 "order by a"))
+    # GROUPS frames: peer-group offsets (any key shape; NULL group counts)
+    for agg, types in [("sum(a)", "II"), ("count(a)", "II"),
+                       ("max(a)", "II")]:
+        out.append((types, "",
+                    f"select a, {agg} over (order by b groups between 1 "
+                    "preceding and current row) from nums order by a"))
+        out.append((types, "",
+                    f"select a, {agg} over (order by b groups between 1 "
+                    "preceding and 1 following) from nums order by a"))
+        out.append((types, "",
+                    f"select a, {agg} over (partition by s order by b "
+                    "groups between unbounded preceding and 0 following) "
+                    "from nums order by a"))
+    out.append(("II", "",
+                "select a, sum(a) over (order by s groups between 1 "
+                "preceding and 1 following) from nums order by a"))
     return out
 
 
