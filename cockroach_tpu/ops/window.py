@@ -66,6 +66,8 @@ class WindowSpec:
     running: bool = False
     frame: tuple | None = None
     frame_kind: str = "rows"
+    # SQL EXCLUDE clause: "no_others" | "current" | "group" | "ties"
+    exclude: str = "no_others"
 
 
 def window_output_type(spec: WindowSpec, schema: Schema) -> SQLType:
@@ -532,27 +534,79 @@ def _framed_window(b: Batch, schema: Schema, spec: WindowSpec, seg,
     else:
         lo = start_of if p is None else jnp.maximum(start_of, pos - int(p))
         hi = seg_end if f is None else jnp.minimum(seg_end, pos + int(f))
-    loc = jnp.clip(lo, 0, b.capacity - 1)
-    hic = jnp.clip(hi, 0, b.capacity - 1)
+    cap = b.capacity
     empty = hi < lo  # e.g. 2 FOLLOWING AND 3 FOLLOWING past the edge
 
+    # frame EXCLUSION (SQL's EXCLUDE clause): a contiguous sub-range of
+    # the frame — CURRENT ROW is [pos, pos], GROUP/TIES the current peer
+    # run; TIES adds the current row itself back. Aggregates subtract the
+    # excluded span from the prefix-difference answers (min/max query the
+    # two surviving sub-ranges)
+    excl = getattr(spec, "exclude", "no_others")
+    keep_cur = None
+    if excl != "no_others":
+        if excl == "current":
+            ex_lo, ex_hi = pos, pos
+        else:
+            peer_id = jnp.cumsum(
+                jnp.asarray(peer_boundary).astype(jnp.int32)
+            ) - 1
+            ex_lo = jax.ops.segment_min(
+                jnp.where(b.mask, pos, cap), peer_id, num_segments=cap
+            )[peer_id]
+            ex_hi = jax.ops.segment_max(
+                jnp.where(b.mask, pos, -1), peer_id, num_segments=cap
+            )[peer_id]
+        exc_lo = jnp.maximum(lo, ex_lo)
+        exc_hi = jnp.minimum(hi, ex_hi)
+        has_exc = (exc_lo <= exc_hi) & ~empty
+        if excl == "ties":
+            keep_cur = (lo <= pos) & (pos <= hi)  # current row survives
+    else:
+        has_exc = None
+
+    def range_sum(c, lo_, hi_, present):
+        l_ = jnp.clip(lo_, 0, cap - 1)
+        h_ = jnp.clip(hi_, 0, cap - 1)
+        s = c[h_] - jnp.where(l_ > 0, c[l_ - 1], 0)
+        return jnp.where(present, s, 0)
+
+    def framed_total(per_row_vals):
+        """Sum of per_row_vals over the frame minus exclusions."""
+        c = jnp.cumsum(per_row_vals)
+        tot = range_sum(c, lo, hi, ~empty)
+        if has_exc is not None:
+            tot = tot - range_sum(c, exc_lo, exc_hi, has_exc)
+            if keep_cur is not None:
+                tot = tot + jnp.where(keep_cur, per_row_vals, 0)
+        return tot
+
     if spec.func in ("first_value", "last_value"):
+        if keep_cur is not None:
+            raise ValueError(
+                "EXCLUDE TIES with first_value/last_value is not "
+                "supported (bind-time rule)"
+            )
+        lo_eff, hi_eff = lo, hi
+        if has_exc is not None:
+            # an edge inside the exclusion steps past it
+            lo_eff = jnp.where(has_exc & (exc_lo == lo), exc_hi + 1, lo)
+            hi_eff = jnp.where(has_exc & (exc_hi == hi), exc_lo - 1, hi)
+        dead = empty | (hi_eff < lo_eff)
         col = b.cols[spec.col]
-        edge = loc if spec.func == "first_value" else hic
-        return col.data[edge], col.valid[edge] & ~empty
+        edge = jnp.clip(
+            lo_eff if spec.func == "first_value" else hi_eff, 0, cap - 1
+        )
+        return col.data[edge], col.valid[edge] & ~dead
 
     if spec.func == "count" and spec.col is None:
-        c = jnp.cumsum(b.mask.astype(jnp.int64))
-        d = c[hic] - jnp.where(loc > 0, c[loc - 1], 0)
-        return jnp.where(empty, 0, d), jnp.ones_like(b.mask)
+        d = framed_total(b.mask.astype(jnp.int64))
+        return d, jnp.ones_like(b.mask)
 
     col = b.cols[spec.col]
     t = schema.types[spec.col]
     m = b.mask & col.valid
-    cnt = jnp.cumsum(m.astype(jnp.int64))
-    wcnt = jnp.where(
-        empty, 0, cnt[hic] - jnp.where(loc > 0, cnt[loc - 1], 0)
-    )
+    wcnt = framed_total(m.astype(jnp.int64))
     if spec.func in ("sum", "count", "avg"):
         if spec.func == "count":
             return wcnt, jnp.ones_like(b.mask)
@@ -560,10 +614,7 @@ def _framed_window(b: Batch, schema: Schema, spec: WindowSpec, seg,
             vals = jnp.where(m, col.data.astype(jnp.float64), 0.0)
         else:
             vals = jnp.where(m, col.data.astype(jnp.int64), 0)
-        c = jnp.cumsum(vals)
-        wsum = jnp.where(
-            empty, 0, c[hic] - jnp.where(loc > 0, c[loc - 1], 0)
-        )
+        wsum = framed_total(vals)
         if spec.func == "avg":
             d = wsum.astype(jnp.float64) / jnp.where(wcnt > 0, wcnt, 1)
             if t.family is Family.DECIMAL:
@@ -588,7 +639,22 @@ def _framed_window(b: Batch, schema: Schema, spec: WindowSpec, seg,
     sent = _minmax_sentinel(data.dtype, is_min)
     vv = jnp.where(m, data, sent)
     op = jnp.minimum if is_min else jnp.maximum
-    red = _rmq_query(_rmq_levels(vv, op), op, loc, hic)
+    levels = _rmq_levels(vv, op)
+
+    def rmq(lo_, hi_, present):
+        r = _rmq_query(levels, op, jnp.clip(lo_, 0, cap - 1),
+                       jnp.clip(hi_, 0, cap - 1))
+        return jnp.where(present & (lo_ <= hi_), r, sent)
+
+    if has_exc is None:
+        red = rmq(lo, hi, ~empty)
+    else:
+        left = rmq(lo, exc_lo - 1, has_exc)
+        right = rmq(exc_hi + 1, hi, has_exc)
+        whole = rmq(lo, hi, ~empty & ~has_exc)
+        red = op(op(left, right), whole)
+        if keep_cur is not None:
+            red = op(red, jnp.where(keep_cur & m, vv, sent))
     if inv_rank is not None:
         red = inv_rank[jnp.clip(red, 0, inv_rank.shape[0] - 1)]
     return red.astype(col.data.dtype), (wcnt > 0) & ~empty

@@ -1397,12 +1397,17 @@ class Binder:
                             "RANGE frame offsets require a numeric "
                             f"ORDER BY key, got {fam.name}"
                         )
-            groups.setdefault((parts, order, frame, fkind), []).append(
-                (out, func, arg, offset)
-            )
-        for (parts, order, frame, fkind), funcs in groups.items():
+            excl = wc.exclude if wc.has_frame_clause else "no_others"
+            if excl == "ties" and func in ("first_value", "last_value"):
+                raise BindError(
+                    "EXCLUDE TIES with first_value/last_value is not "
+                    "supported"
+                )
+            groups.setdefault((parts, order, frame, fkind, excl),
+                              []).append((out, func, arg, offset))
+        for (parts, order, frame, fkind, excl), funcs in groups.items():
             rel = rel.window(list(parts), list(order), funcs, frame=frame,
-                             frame_kind=fkind)
+                             frame_kind=fkind, exclude=excl)
         return rel, names
 
     def _project(self, sel: P.Select, rel: Rel, resolver=None,

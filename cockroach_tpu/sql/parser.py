@@ -41,7 +41,8 @@ KEYWORDS = {
     "create", "table", "primary", "key", "insert", "into", "values",
     "update", "set", "delete", "default", "alter", "add", "column", "drop",
     "over", "partition", "rows", "range", "groups", "unbounded",
-    "preceding", "following", "current", "row",
+    "preceding", "following", "current", "row", "exclude", "no",
+    "others", "ties",
 }
 
 
@@ -142,7 +143,8 @@ class WindowCall(Node):
     order_by: tuple[tuple[Node, bool], ...] = ()  # (expr, desc)
     frame: tuple | None = None
     has_frame_clause: bool = False
-    frame_kind: str = "rows"  # "rows" | "range"
+    frame_kind: str = "rows"  # "rows" | "range" | "groups"
+    exclude: str = "no_others"  # EXCLUDE clause
 
 
 @dataclass(frozen=True)
@@ -890,6 +892,7 @@ class Parser:
                 if not self.eat_op(","):
                     break
         frame_kind = "rows"
+        exclude = "no_others"
         if (self.eat_kw("rows") or self.eat_kw("range")
                 or self.eat_kw("groups")):
             if self.toks[self.i - 1].value in ("range", "groups"):
@@ -899,9 +902,20 @@ class Parser:
             frame = (self._frame_bound(preceding=True, kind=frame_kind),
                      self._frame_bound(preceding=False, kind=frame_kind))
             # BETWEEN's middle AND
+            if self.eat_kw("exclude"):
+                if self.eat_kw("no"):
+                    self.expect_kw("others")
+                elif self.eat_kw("current"):
+                    self.expect_kw("row")
+                    exclude = "current"
+                elif self.eat_kw("group"):
+                    exclude = "group"
+                else:
+                    self.expect_kw("ties")
+                    exclude = "ties"
         self.expect_op(")")
         return WindowCall(fc, tuple(parts), tuple(order), frame, has_frame,
-                          frame_kind)
+                          frame_kind, exclude)
 
     def _frame_bound(self, preceding: bool, kind: str = "rows"):
         """One ROWS/RANGE bound -> offset relative to the current row

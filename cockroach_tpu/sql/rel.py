@@ -239,7 +239,8 @@ class Rel:
     def window(self, partition_by: list[str], order_by: list[tuple[str, bool]],
                funcs: list[tuple[str, str, str | None]],
                running: bool = False, frame: tuple | None = None,
-               frame_kind: str = "rows") -> "Rel":
+               frame_kind: str = "rows",
+               exclude: str = "no_others") -> "Rel":
         """funcs: (output name, window func, input col name or None).
         running=True selects the cumulative frame for aggregates; `frame`
         is the general ROWS BETWEEN spec as (preceding, following) row
@@ -256,6 +257,7 @@ class Rel:
             win_ops.WindowSpec(
                 a[1], None if a[2] is None else self.idx(a[2]), a[0],
                 running=running, frame=frame, frame_kind=frame_kind,
+                exclude=exclude,
                 **({"offset": a[3]} if len(a) > 3 else {}),
             )
             for a in funcs

@@ -391,6 +391,28 @@ def _window_matrix() -> list[tuple[str, str, str]]:
     out.append(("II", "",
                 "select a, sum(a) over (order by s groups between 1 "
                 "preceding and 1 following) from nums order by a"))
+    # EXCLUDE clause across all three frame modes
+    for excl in ("exclude current row", "exclude group", "exclude ties"):
+        out.append(("II", "",
+                    "select a, sum(a) over (order by b rows between 2 "
+                    f"preceding and 2 following {excl}) from nums "
+                    "order by a"))
+        out.append(("II", "",
+                    "select a, count(a) over (order by b range between 10 "
+                    f"preceding and 10 following {excl}) from nums "
+                    "order by a"))
+        out.append(("II", "",
+                    "select a, min(a) over (order by b groups between 1 "
+                    f"preceding and 1 following {excl}) from nums "
+                    "order by a"))
+    out.append(("II", "",
+                "select a, first_value(a) over (order by b rows between "
+                "1 preceding and 1 following exclude current row) "
+                "from nums order by a"))
+    out.append(("II", "",
+                "select a, last_value(a) over (order by b rows between "
+                "1 preceding and 1 following exclude group) from nums "
+                "order by a"))
     return out
 
 
