@@ -396,6 +396,23 @@ def _lower_bound(u, q, lo0, hi0, strict: bool = False):
     return lo
 
 
+def _peer_run(peer_boundary, pos, mask, cap, order_keys,
+              start_of, seg_end):
+    """Per-row [first, last] position of the current row's PEER run.
+    Without ORDER BY every partition row is a peer (SQL rule), so the
+    run is the whole partition."""
+    if not order_keys:
+        return start_of, seg_end
+    peer_id = jnp.cumsum(jnp.asarray(peer_boundary).astype(jnp.int32)) - 1
+    ps = jax.ops.segment_min(
+        jnp.where(mask, pos, cap), peer_id, num_segments=cap
+    )[peer_id]
+    pe = jax.ops.segment_max(
+        jnp.where(mask, pos, -1), peer_id, num_segments=cap
+    )[peer_id]
+    return ps, pe
+
+
 def _seg_run(pos, seg, member, cap, start_fallback, end_fallback):
     """Per-row [first, last] position of the rows where `member` holds,
     within the row's segment (fallbacks when the segment has none)."""
@@ -516,16 +533,8 @@ def _framed_window(b: Batch, schema: Schema, spec: WindowSpec, seg,
         if all(x in (None, 0) for x in spec.frame):
             # peer-only frame (the SQL default shape): bounds are the
             # current row's peer run — positional, any order-key type
-            peer_id = jnp.cumsum(
-                jnp.asarray(peer_boundary).astype(jnp.int32)
-            ) - 1
-            cap = b.capacity
-            ps = jax.ops.segment_min(
-                jnp.where(b.mask, pos, cap), peer_id, num_segments=cap
-            )[peer_id]
-            pe = jax.ops.segment_max(
-                jnp.where(b.mask, pos, -1), peer_id, num_segments=cap
-            )[peer_id]
+            ps, pe = _peer_run(peer_boundary, pos, b.mask, b.capacity,
+                               order_keys, start_of, seg_end)
             lo = start_of if p is None else ps
             hi = seg_end if f is None else pe
         else:
@@ -548,15 +557,8 @@ def _framed_window(b: Batch, schema: Schema, spec: WindowSpec, seg,
         if excl == "current":
             ex_lo, ex_hi = pos, pos
         else:
-            peer_id = jnp.cumsum(
-                jnp.asarray(peer_boundary).astype(jnp.int32)
-            ) - 1
-            ex_lo = jax.ops.segment_min(
-                jnp.where(b.mask, pos, cap), peer_id, num_segments=cap
-            )[peer_id]
-            ex_hi = jax.ops.segment_max(
-                jnp.where(b.mask, pos, -1), peer_id, num_segments=cap
-            )[peer_id]
+            ex_lo, ex_hi = _peer_run(peer_boundary, pos, b.mask, cap,
+                                     order_keys, start_of, seg_end)
         exc_lo = jnp.maximum(lo, ex_lo)
         exc_hi = jnp.minimum(hi, ex_hi)
         has_exc = (exc_lo <= exc_hi) & ~empty

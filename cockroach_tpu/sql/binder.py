@@ -107,6 +107,56 @@ def _fold(e: P.Node) -> P.Node:
     return e
 
 
+# SQL: now()/current_date are constant WITHIN a statement. The session
+# resets this at each execute(); every occurrence in one statement then
+# folds to the same instant (conn_executor's statement timestamp role).
+_STMT_NOW_US: list[int | None] = [None]
+
+
+def begin_statement() -> None:
+    _STMT_NOW_US[0] = None
+
+
+def _statement_now_us() -> int:
+    if _STMT_NOW_US[0] is None:
+        import time as _time
+
+        _STMT_NOW_US[0] = int(_time.time() * 1e6)
+    return _STMT_NOW_US[0]
+
+
+def _intersect_except(left: Rel, right: Rel, op: str) -> Rel:
+    """INTERSECT / EXCEPT with SQL set (DISTINCT) semantics via the
+    tagged-union reduction: dedupe both arms, tag rows 0/1, UNION ALL,
+    group by every output column, keep groups by their tag profile.
+    Grouping — unlike a join — already treats NULLs as equal, which is
+    exactly the set-operation rule, and union_all reconciles string
+    dictionaries across arms. (INTERSECT/EXCEPT ALL bag semantics are
+    rejected at parse time.)"""
+    if len(left.schema) != len(right.schema):
+        raise BindError(f"{op.upper()} inputs must have equal arity")
+    names = list(left.schema.names)
+    tag = "__setop_tag"
+    while tag in names:
+        tag += "_"
+
+    def tagged(r: Rel, t: int) -> Rel:
+        r = r.distinct()
+        items = [(n, r.c(r.schema.names[i]))
+                 for i, n in enumerate(names)]
+        return r.project(items + [(tag, ex.lit(t))])
+
+    u = tagged(left, 0).union_all(tagged(right, 1))
+    g = u.groupby(names, [("__mn", "min", tag), ("__mx", "max", tag)])
+    if op == "intersect":
+        keep = ex.and_(ex.Cmp("eq", g.c("__mn"), ex.lit(0)),
+                       ex.Cmp("eq", g.c("__mx"), ex.lit(1)))
+    else:  # except: present in left only
+        keep = ex.Cmp("eq", g.c("__mx"), ex.lit(0))
+    g = g.filter(keep)
+    return g.project([(n, g.c(n)) for n in names])
+
+
 def _like_regex(pattern: str) -> re.Pattern:
     parts = []
     for ch in pattern:
@@ -291,7 +341,19 @@ class ExprLowerer:
     def lower(self, e: P.Node) -> ex.Expr:
         e = _fold(e)
         if isinstance(e, P.Ident):
-            return ex.ColRef(self.idx(e))
+            try:
+                return ex.ColRef(self.idx(e))
+            except BindError:
+                if e.table is None and e.name in ("current_date",
+                                                  "current_timestamp"):
+                    from ..coldata.types import DATE as _DATE
+                    from ..coldata.types import TIMESTAMP as _TS
+
+                    us = _statement_now_us()
+                    if e.name == "current_date":
+                        return ex.Const(us // 86_400_000_000, _DATE)
+                    return ex.Const(us, _TS)
+                raise
         if isinstance(e, P.NumLit):
             if isinstance(e.value, int):
                 return ex.lit(int(e.value))
@@ -320,9 +382,33 @@ class ExprLowerer:
             i = self._is_string_col(e.arg)
             if i is None:
                 raise BindError("LIKE requires a string column")
-            rx = _like_regex(e.pattern)
-            pred = self._str_pred_at(i, lambda s: rx.match(s) is not None)
+            rx = _like_regex(e.pattern.lower() if e.ci else e.pattern)
+            if e.ci:  # ILIKE: case-insensitive on both sides
+                pred = self._str_pred_at(
+                    i, lambda s: rx.match(s.lower()) is not None
+                )
+            else:
+                pred = self._str_pred_at(
+                    i, lambda s: rx.match(s) is not None
+                )
             return ex.Not(pred) if e.negated else pred
+        if isinstance(e, P.IsDistinct):
+            a = self.lower(e.left)
+            b = self.lower(e.right)
+            ta = ex.expr_type(a, self.rel.schema)
+            if ta.family is Family.STRING:
+                raise BindError(
+                    "IS DISTINCT FROM over strings is not supported"
+                )
+            # NOT DISTINCT == (both NULL) OR (a = b known-true); Kleene
+            # algebra keeps the result two-valued
+            not_distinct = ex.or_(
+                ex.and_(ex.IsNull(a), ex.IsNull(b)),
+                ex.and_(ex.Cmp("eq", a, b),
+                        ex.IsNull(a, negate=True),
+                        ex.IsNull(b, negate=True)),
+            )
+            return not_distinct if e.negated else ex.Not(not_distinct)
         if isinstance(e, P.InList):
             i = self._is_string_col(e.arg)
             if i is not None:
@@ -358,17 +444,59 @@ class ExprLowerer:
             from ..coldata.types import DATE as _DATE
             from ..coldata.types import TIMESTAMP as _TS
 
+            dec = SQLType(
+                Family.DECIMAL,
+                precision=e.precision if e.precision is not None else 38,
+                scale=e.scale if e.scale is not None else 2,
+            )
             to = {
                 "int": INT64, "integer": INT64, "bigint": INT64,
                 "smallint": SQLType(Family.INT, width=16),
                 "float": FLOAT64, "double": FLOAT64, "real": FLOAT64,
-                "decimal": SQLType(Family.DECIMAL, precision=38, scale=2),
-                "numeric": SQLType(Family.DECIMAL, precision=38, scale=2),
+                "decimal": dec, "numeric": dec,
                 "bool": _BOOL, "boolean": _BOOL,
                 "date": _DATE, "timestamp": _TS,
             }.get(e.to)
             if to is None:
                 raise BindError(f"unsupported cast target {e.to}")
+            if isinstance(e.arg, P.StrLit):
+                # string-literal casts resolve at bind time ('5'::int)
+                v = e.arg.value
+                try:
+                    if to.family is Family.INT:
+                        return ex.Const(int(v), to)
+                    if to.family is Family.FLOAT:
+                        return ex.Const(float(v), to)
+                    if to.family is Family.DECIMAL:
+                        # Const holds the UNSCALED value for DECIMAL —
+                        # eval_expr applies the 10^scale encoding
+                        return ex.Const(float(v), to)
+                    if to.family is Family.BOOL:
+                        lv = v.strip().lower()
+                        if lv in ("t", "true", "yes", "on", "1"):
+                            return ex.Const(True, to)
+                        if lv in ("f", "false", "no", "off", "0"):
+                            return ex.Const(False, to)
+                        raise BindError(
+                            f"invalid bool literal {v!r}"
+                        )
+                    if to.family is Family.DATE:
+                        days = int((np.datetime64(v, "D") -
+                                    np.datetime64("1970-01-01", "D")
+                                    ).astype(int))
+                        return ex.Const(days, to)
+                    if to.family is Family.TIMESTAMP:
+                        # microsecond unit keeps the time-of-day (a "D"
+                        # parse would silently floor to midnight)
+                        us = int((np.datetime64(v.strip().replace(" ", "T"),
+                                                "us")
+                                  - np.datetime64("1970-01-01", "us")
+                                  ).astype(np.int64))
+                        return ex.Const(us, to)
+                except ValueError as err:
+                    raise BindError(
+                        f"invalid {e.to} literal {v!r}: {err}"
+                    ) from None
             return ex.Cast(self.lower(e.arg), to)
         if isinstance(e, P.Extract):
             if e.part == "year":
@@ -429,6 +557,13 @@ class ExprLowerer:
                            otherwise=a)
         if isinstance(e, P.FuncCall) and e.name == "coalesce" and e.args:
             return ex.Coalesce(tuple(self.lower(a) for a in e.args))
+        if (isinstance(e, P.FuncCall) and not e.args
+                and e.name in ("now", "current_timestamp",
+                               "transaction_timestamp",
+                               "statement_timestamp")):
+            from ..coldata.types import TIMESTAMP as _TS
+
+            return ex.Const(_statement_now_us(), _TS)
         if (isinstance(e, P.FuncCall)
                 and e.name in ("starts_with", "strpos")
                 and len(e.args) == 2):
@@ -554,6 +689,23 @@ class Binder:
             # (the distributed lowering memoizes shared subtrees, so a CTE
             # used twice computes once inside the SPMD program)
             self.ctes[name] = self.bind(csel)
+        if not sel.from_:
+            # FROM-less SELECT: one synthetic row (Postgres' implicit
+            # dual); constants/builtins project over it
+            sel = P.dataclasses.replace(
+                sel, from_=(P.TableRef("__dual", None),)
+            )
+            if "__dual" not in self.catalog.tables:
+                import numpy as _np
+
+                from ..catalog import Table as _Table
+                from ..coldata.types import INT64 as _I64
+                from ..coldata.types import Schema as _Schema
+
+                self.catalog.add(_Table.from_strings(
+                    "__dual", _Schema.of(__dual=_I64),
+                    {"__dual": _np.zeros(1, _np.int64)},
+                ))
         sources, join_filters = self._bind_from(sel.from_)
         scope = Scope(sources)
 
@@ -646,11 +798,14 @@ class Binder:
         base = _dc.replace(sel, set_ops=(), order_by=(), limit=None,
                            offset=0, ctes=())
         rel = self.bind(base)
-        for is_all, arm in sel.set_ops:
+        for op, is_all, arm in sel.set_ops:
             arm_rel = self.bind(arm)
-            rel = rel.union_all(arm_rel)
-            if not is_all:
-                rel = rel.distinct()
+            if op == "union":
+                rel = rel.union_all(arm_rel)
+                if not is_all:
+                    rel = rel.distinct()
+            else:
+                rel = _intersect_except(rel, arm_rel, op)
         keys = []
         for o in sel.order_by:
             if isinstance(o.expr, P.Ident) and o.expr.name in rel.schema.names:

@@ -26,7 +26,7 @@ _TOKEN_RE = re.compile(
   | (?P<num>\d+\.\d+|\.\d+|\d+)
   | (?P<str>'(?:[^']|'')*')
   | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<op><=|>=|<>|!=|\|\||[-+*/%(),.;<>=])
+  | (?P<op>::|<=|>=|<>|!=|\|\||[-+*/%(),.;<>=])
     """,
     re.VERBOSE,
 )
@@ -34,6 +34,7 @@ _TOKEN_RE = re.compile(
 KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "as", "and", "or", "not", "in", "exists", "between", "like",
+    "ilike", "intersect", "except", "filter",
     "is", "null", "case", "when", "then", "else", "end", "cast", "extract",
     "year", "month", "day", "date", "interval", "join", "inner", "left",
     "right", "outer", "on", "asc", "desc", "distinct", "all", "union",
@@ -51,6 +52,17 @@ class Token:
     kind: str  # name | kw | num | str | op | eof
     value: str
     pos: int
+
+
+# structural keywords can never START an expression — letting them parse
+# as identifiers turns typos like "select from t" into silent nonsense
+# (important now that FROM itself is optional)
+_STRUCTURAL_KW = {
+    "from", "where", "group", "having", "order", "limit", "offset",
+    "union", "intersect", "except", "on", "join", "inner", "when",
+    "then", "else", "end", "and", "or", "as", "by", "asc", "desc",
+    "into", "values", "set",
+}
 
 
 def tokenize(text: str) -> list[Token]:
@@ -175,10 +187,20 @@ class Between(Node):
 
 
 @dataclass(frozen=True)
+class IsDistinct(Node):
+    """a IS [NOT] DISTINCT FROM b — null-safe comparison."""
+
+    left: Node
+    right: Node
+    negated: bool = False  # negated=True is IS NOT DISTINCT FROM
+
+
+@dataclass(frozen=True)
 class Like(Node):
     arg: Node
     pattern: str
     negated: bool = False
+    ci: bool = False  # ILIKE
 
 
 @dataclass(frozen=True)
@@ -216,6 +238,8 @@ class Case(Node):
 class Cast(Node):
     arg: Node
     to: str  # type name
+    precision: int | None = None
+    scale: int | None = None
 
 
 @dataclass(frozen=True)
@@ -546,33 +570,61 @@ class Parser:
         return s
 
     def parse_select(self) -> Select:
-        """One select, plus any UNION [ALL] chain (left-associative). A
-        trailing ORDER BY / LIMIT parsed into the LAST arm is hoisted to
-        the union level (SQL: they order/limit the whole set operation)."""
-        s = self.parse_select_one()
-        arms: list[tuple[bool, Select]] = []
-        while self.eat_kw("union"):
+        """Set-operation chains with SQL precedence: INTERSECT binds
+        tighter than UNION/EXCEPT (both left-associative). A trailing
+        ORDER BY / LIMIT parsed into the LAST arm is hoisted to the chain
+        level (SQL: they order/limit the whole set operation)."""
+        return self._parse_setop_chain(
+            self._parse_intersect_chain, ("union", "except")
+        )
+
+    def _parse_intersect_chain(self) -> Select:
+        return self._parse_setop_chain(
+            self.parse_select_one, ("intersect",)
+        )
+
+    def _parse_setop_chain(self, sub, ops: tuple[str, ...]) -> Select:
+        s = sub()
+        arms: list[tuple] = []
+        while any(self.at_kw(o) for o in ops):
+            op = self.next().value
             is_all = bool(self.eat_kw("all"))
-            arms.append((is_all, self.parse_select_one()))
+            if op != "union" and is_all:
+                raise SyntaxError(
+                    f"{op.upper()} ALL (bag semantics) is not supported"
+                )
+            arms.append((op, is_all, sub()))
         if not arms:
             return s
-        # only the LAST arm's trailing ORDER BY/LIMIT is the union's;
+        # only the LAST arm's trailing ORDER BY/LIMIT is the chain's;
         # order/limit on any earlier arm needs parentheses (postgres
         # rejects the unparenthesized form too — accepting it silently
-        # would truncate the whole union to the first arm's LIMIT)
+        # would truncate the whole chain to the first arm's LIMIT)
         if s.order_by or s.limit is not None or s.offset:
             raise SyntaxError(
-                "ORDER BY/LIMIT on a UNION arm requires parentheses; "
-                "a trailing ORDER BY/LIMIT applies to the whole union"
+                "ORDER BY/LIMIT on a set-operation arm requires "
+                "parentheses; a trailing ORDER BY/LIMIT applies to "
+                "the whole chain"
             )
         order_by: tuple = ()
         limit = None
         offset = 0
-        last_all, last = arms[-1]
+        last_op, last_all, last = arms[-1]
         if last.order_by or last.limit is not None or last.offset:
             order_by, limit, offset = last.order_by, last.limit, last.offset
-            arms[-1] = (last_all, dataclasses.replace(
+            arms[-1] = (last_op, last_all, dataclasses.replace(
                 last, order_by=(), limit=None, offset=0))
+        if s.set_ops:
+            # the first arm is itself a tighter chain (A intersect B
+            # union C): wrap it as a subquery so this level's set_ops
+            # don't clobber the inner ones — the binder recurses into
+            # the FROM subquery before folding this chain
+            s = Select(
+                items=(SelectItem(Star(), None),),
+                from_=(SubqueryRef(s, "__setop"),),
+                where=None, group_by=(), having=None, order_by=(),
+                limit=None,
+            )
         return dataclasses.replace(
             s, set_ops=tuple(arms), order_by=order_by, limit=limit,
             offset=offset,
@@ -585,10 +637,11 @@ class Parser:
         items = [self.parse_select_item()]
         while self.eat_op(","):
             items.append(self.parse_select_item())
-        self.expect_kw("from")
-        from_ = [self.parse_table_expr()]
-        while self.eat_op(","):
+        from_: list = []
+        if self.eat_kw("from"):  # FROM-less SELECT: one synthetic row
             from_.append(self.parse_table_expr())
+            while self.eat_op(","):
+                from_.append(self.parse_table_expr())
         where = self.parse_expr() if self.eat_kw("where") else None
         group_by: list[Node] = []
         if self.eat_kw("group"):
@@ -708,11 +761,12 @@ class Parser:
             self.expect_kw("and")
             hi = self.parse_additive()
             return Between(e, lo, hi, negated)
-        if self.eat_kw("like"):
+        if self.eat_kw("like") or self.eat_kw("ilike"):
+            ci = self.toks[self.i - 1].value == "ilike"
             pat = self.next()
             if pat.kind != "str":
                 raise SyntaxError("LIKE pattern must be a string literal")
-            return Like(e, pat.value, negated)
+            return Like(e, pat.value, negated, ci)
         if self.eat_kw("in"):
             self.expect_op("(")
             if self.at_kw("select"):
@@ -728,6 +782,9 @@ class Parser:
             raise SyntaxError("dangling NOT")
         if self.eat_kw("is"):
             neg = bool(self.eat_kw("not"))
+            if self.eat_kw("distinct"):
+                self.expect_kw("from")
+                return IsDistinct(e, self.parse_additive(), negated=neg)
             self.expect_kw("null")
             return IsNull(e, neg)
         ops = {"<": "lt", "<=": "le", ">": "gt", ">=": "ge", "=": "eq",
@@ -735,6 +792,21 @@ class Parser:
         t = self.peek()
         if t.kind == "op" and t.value in ops:
             self.next()
+            # quantified comparison: = ANY/SOME (sub) is IN, <> ALL is
+            # NOT IN (the only two shapes with clean IN reductions)
+            if self.at_kw("any") or self.at_kw("some") or self.at_kw("all"):
+                q = self.next().value
+                self.expect_op("(")
+                sub = self.parse_select()
+                self.expect_op(")")
+                if ops[t.value] == "eq" and q in ("any", "some"):
+                    return InSelect(e, sub, False)
+                if ops[t.value] == "ne" and q == "all":
+                    return InSelect(e, sub, True)
+                raise SyntaxError(
+                    f"only = ANY(...) and <> ALL(...) quantified "
+                    f"comparisons are supported (got {t.value} {q})"
+                )
             rhs = self.parse_additive()
             return Cmp(ops[t.value], e, rhs)
         return e
@@ -763,7 +835,17 @@ class Parser:
             return Bin("-", NumLit(0), self.parse_unary())
         if self.eat_op("+"):
             return self.parse_unary()
-        return self.parse_primary()
+        e = self.parse_primary()
+        while self.eat_op("::"):  # postgres cast: expr::type
+            to = self.next().value
+            prec = scale = None
+            if self.eat_op("("):  # (p[,s]) type parameters
+                prec = int(self.next().value)
+                if self.eat_op(","):
+                    scale = int(self.next().value)
+                self.expect_op(")")
+            e = Cast(e, to, prec, scale)
+        return e
 
     def parse_primary(self) -> Node:
         t = self.peek()
@@ -802,12 +884,14 @@ class Parser:
             arg = self.parse_expr()
             self.expect_kw("as")
             to = self.next().value
-            # consume optional (p[,s]) type parameters
-            if self.eat_op("("):
-                while not self.eat_op(")"):
-                    self.next()
+            prec = scale = None
+            if self.eat_op("("):  # (p[,s]) type parameters
+                prec = int(self.next().value)
+                if self.eat_op(","):
+                    scale = int(self.next().value)
+                self.expect_op(")")
             self.expect_op(")")
-            return Cast(arg, to)
+            return Cast(arg, to, prec, scale)
         if self.at_kw("extract"):
             self.next()
             self.expect_op("(")
@@ -841,7 +925,8 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return e
-        if t.kind == "name" or t.kind == "kw":
+        if t.kind == "name" or (t.kind == "kw"
+                                and t.value not in _STRUCTURAL_KW):
             self.next()
             name = t.value
             if self.at_op("("):  # function call
@@ -857,6 +942,27 @@ class Parser:
                         args.append(self.parse_expr())
                 self.expect_op(")")
                 fc = FuncCall(name, tuple(args), distinct)
+                if self.eat_kw("filter"):
+                    # FILTER (WHERE p) desugars in place: agg(x) ->
+                    # agg(CASE WHEN p THEN x END); count(*) counts a CASE
+                    # over 1 — identical semantics, no new agg machinery
+                    self.expect_op("(")
+                    self.expect_kw("where")
+                    pred = self.parse_expr()
+                    self.expect_op(")")
+                    if distinct:
+                        raise SyntaxError(
+                            "FILTER with DISTINCT aggregates is not "
+                            "supported"
+                        )
+                    src = (NumLit(1) if not args
+                           or isinstance(args[0], Star) else args[0])
+                    guarded = Case(whens=((pred, src),), otherwise=None)
+                    fname = "count" if (not args
+                                        or isinstance(args[0], Star)
+                                        ) and name == "count" else name
+                    fc = FuncCall(fname, (guarded,) + tuple(args[1:]),
+                                  distinct)
                 if self.at_kw("over"):
                     return self.parse_over(fc)
                 return fc

@@ -157,6 +157,9 @@ class Session:
         return out
 
     def _dispatch(self, text: str):
+        from .binder import begin_statement
+
+        begin_statement()  # now()/current_date fold per statement
         handled = self._maybe_settings_stmt(text)
         if handled is None:
             handled = self._maybe_admin_stmt(text)
@@ -512,7 +515,10 @@ class Session:
         if _re.match(r"(?is)^show\s+tables$", t):
             import numpy as _np
 
-            names = sorted(self.catalog.tables)
+            # "__"-prefixed names are engine-internal (the FROM-less
+            # SELECT dual relation)
+            names = sorted(n for n in self.catalog.tables
+                           if not n.startswith("__"))
             return {"table_name": _np.array(names, dtype=object)}
         m = _re.match(r"(?is)^show\s+columns\s+from\s+([a-z0-9_]+)$", t)
         if m:
@@ -638,6 +644,10 @@ class Session:
     # -- DDL -----------------------------------------------------------------
 
     def _create_table(self, stmt: P.CreateTable):
+        if stmt.name.startswith("__"):
+            raise BindError(
+                "table names starting with '__' are reserved"
+            )
         if stmt.name in self.catalog.tables:
             raise BindError(f"table {stmt.name!r} already exists")
         names = tuple(c.name for c in stmt.columns)

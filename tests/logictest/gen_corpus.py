@@ -462,6 +462,29 @@ AREAS.append(("scalar_subqueries", NUMS, [
 ]))
 
 
+AREAS.append(("setops_filter_distinctfrom", NUMS + PAIR, [
+    ("I", "rowsort",
+     "select a from nums intersect select v - 99 from pl"),
+    ("I", "rowsort", "select a from nums except select k from pr"),
+    ("I", "rowsort",
+     "select b from nums intersect select b from nums where a > 5"),
+    ("I", "rowsort",
+     "select b from nums except select b from nums where b > 5"),
+    ("I", "nosort",
+     "select count(*) filter (where b > 5) from nums"),
+    ("II", "rowsort",
+     "select b, count(*) filter (where f > 0) from nums group by b"),
+    ("IR", "rowsort",
+     "select b, sum(f) filter (where f > 0) from nums group by b"),
+    ("II", "rowsort",
+     "select b, min(a) filter (where a > 2) from nums group by b"),
+    ("I", "rowsort", "select a from nums where b is distinct from 10"),
+    ("I", "rowsort",
+     "select a from nums where b is not distinct from null"),
+    ("I", "rowsort",
+     "select a from nums where f is distinct from null"),
+]))
+
 AREAS.append(("math_builtins", NUMS, [
     ("II", "rowsort", "select a, mod(b, 3) from nums where b is not null"),
     ("II", "rowsort", "select a, mod(b, -4) from nums where b is not null"),
