@@ -157,6 +157,33 @@ def _intersect_except(left: Rel, right: Rel, op: str) -> Rel:
     return g.project([(n, g.c(n)) for n in names])
 
 
+def _replace_node(tree: P.Node, target: P.Node, repl: P.Node) -> P.Node:
+    """Rebuild `tree` with the (identity-matched) `target` node replaced.
+    Frozen dataclass AST: rebuild only along the path to the target."""
+    if tree is target:
+        return repl
+    import dataclasses as _dc
+
+    if not _dc.is_dataclass(tree):
+        return tree
+    changes = {}
+    for f in _dc.fields(tree):
+        v = getattr(tree, f.name)
+        if isinstance(v, P.Node):
+            nv = _replace_node(v, target, repl)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, tuple):
+            nvs = tuple(
+                _replace_node(x, target, repl) if isinstance(x, P.Node)
+                else x
+                for x in v
+            )
+            if any(a is not b for a, b in zip(nvs, v)):
+                changes[f.name] = nvs
+    return _dc.replace(tree, **changes) if changes else tree
+
+
 def _like_regex(pattern: str) -> re.Pattern:
     parts = []
     for ch in pattern:
@@ -782,6 +809,50 @@ class Binder:
                 joined.rel = joined.rel.filter(
                     self._lower_with_subqueries(lower, c))
 
+        # correlated scalar subqueries in the SELECT list: LEFT-join the
+        # grouped inner (a key with no inner rows keeps the row, scalar
+        # NULL — SQL's select-position semantics, unlike the WHERE
+        # position's row-dropping inner join) and rewrite each item to
+        # reference the joined column through a marker ident
+        sub_markers: dict[str, int] = {}
+        if any(isinstance(x, P.ScalarSubquery)
+               and self._scalar_sub_is_correlated(x)
+               for it in sel.items for x in _walk(it.expr)):
+            new_items = []
+            for it in sel.items:
+                expr = it.expr
+                for x in _walk(expr):
+                    if (isinstance(x, P.ScalarSubquery)
+                            and self._scalar_sub_is_correlated(x)):
+                        rel2, sub_pos, _, _ = self._join_corr_scalar(
+                            joined, scope, x, how="left"
+                        )
+                        joined = BoundQuery(rel2, joined.sources,
+                                            joined.colmap)
+                        mname = f"_s{len(sub_markers)}"
+                        sub_markers[mname] = sub_pos
+                        marker: P.Node = P.Ident("__selsub", mname)
+                        inner_item = x.select.items[0].expr
+                        if (isinstance(inner_item, P.FuncCall)
+                                and inner_item.name == "count"):
+                            # count over an empty correlated group is 0,
+                            # not NULL (the classic decorrelation count
+                            # bug; the left join yields NULL there)
+                            marker = P.FuncCall(
+                                "coalesce", (marker, P.NumLit(0))
+                            )
+                        expr = _replace_node(expr, x, marker)
+                new_items.append(P.SelectItem(expr, it.alias))
+            sel = P.dataclasses.replace(sel, items=tuple(new_items))
+            base_resolver = resolver
+
+            def resolver(ident: P.Ident, _base=base_resolver):  # noqa: F811
+                if ident.table == "__selsub":
+                    return sub_markers[ident.name]
+                if _base is not None:
+                    return _base(ident)
+                return joined.rel.idx(ident.name)
+
         return self._finish(sel, joined.rel, resolver)
 
     def _bind_set_ops(self, sel: P.Select) -> Rel:
@@ -1141,28 +1212,16 @@ class Binder:
                         return True
         return False
 
-    def _apply_corr_scalar(self, joined: "BoundQuery", conjunct: P.Node,
-                           scope: Scope) -> "BoundQuery":
-        """Decorrelate `expr CMP (select agg(...) from ... where inner.k =
-        outer.k and ...)` — the reference's optbuilder/norm rules turn these
-        into grouped joins (plan_opt.go); here the rewrite happens on the
-        AST: bind the subquery GROUPED BY its correlation keys, inner-join
-        the group result on the keys (group output is unique per key), then
-        filter and project the helper columns away.
-
-        Inner-join semantics are exactly SQL's: a key with no inner rows
-        yields a NULL scalar, the comparison is not-true, the row drops."""
-        # fold any UNCORRELATED subqueries in the conjunct to literals first
-        # so the marker substitution below can only ever target the one
-        # correlated subquery
-        conjunct = self._replace_scalar_subqueries(conjunct)
-        subs = [x for x in _walk(conjunct)
-                if isinstance(x, P.ScalarSubquery)]
-        if len(subs) != 1:
-            raise BindError(
-                "at most one correlated scalar subquery per predicate"
-            )
-        sub = subs[0]
+    def _join_corr_scalar(self, joined: "BoundQuery", scope: Scope,
+                          sub: P.ScalarSubquery, how: str):
+        """Shared decorrelation core: bind the subquery GROUPED BY its
+        equality-correlation keys and join the group result onto the
+        outer rel (`how`: inner for WHERE position — a missing key drops
+        the row; left for SELECT position — a missing key yields a NULL
+        scalar, row kept). Returns (rel, sub_pos, n_outer, outer_names).
+        A bare (non-aggregate) inner column wraps in max(): exact when
+        the correlation key is unique, a documented divergence from the
+        reference's more-than-one-row runtime error otherwise."""
         sel2 = sub.select
         if len(sel2.items) != 1:
             raise BindError("scalar subquery must produce one column")
@@ -1200,6 +1259,14 @@ class Binder:
         if not corr:
             raise BindError("scalar subquery correlation not found")
 
+        item = sel2.items[0].expr
+        if not any(isinstance(x, P.FuncCall) and x.name in AGG_FUNCS
+                   for x in _walk(item)):
+            # a bare (aggregate-free) item gets max() single-row
+            # semantics; exact when the correlation key is unique (see
+            # docstring divergence note)
+            item = P.FuncCall("max", (item,))
+
         # rewritten inner AST: group by the correlation keys
         key_items = tuple(
             P.SelectItem(inner_id, alias=f"_ck{i}")
@@ -1209,8 +1276,7 @@ class Binder:
         for c in inner_where:
             where2 = c if where2 is None else P.Bin("and", where2, c)
         sel3 = P.Select(
-            items=key_items + (
-                P.SelectItem(sel2.items[0].expr, alias="_sub"),),
+            items=key_items + (P.SelectItem(item, alias="_sub"),),
             from_=sel2.from_,
             where=where2,
             group_by=tuple(inner_id for _, inner_id in corr),
@@ -1227,8 +1293,36 @@ class Binder:
              joined.rel.idx(outer_id.name), f"_ck{i}")
             for i, (outer_id, _) in enumerate(corr)
         ]
-        rel = joined.rel.join(grouped, on=on, how="inner", build_unique=True)
+        rel = joined.rel.join(grouped, on=on, how=how, build_unique=True)
         sub_pos = n_outer + len(corr)  # "_sub" column position
+        return rel, sub_pos, n_outer, outer_names
+
+    def _apply_corr_scalar(self, joined: "BoundQuery", conjunct: P.Node,
+                           scope: Scope) -> "BoundQuery":
+        """Decorrelate `expr CMP (select agg(...) from ... where inner.k =
+        outer.k and ...)` — the reference's optbuilder/norm rules turn these
+        into grouped joins (plan_opt.go); here the rewrite happens on the
+        AST: bind the subquery GROUPED BY its correlation keys, inner-join
+        the group result on the keys (group output is unique per key), then
+        filter and project the helper columns away.
+
+        Inner-join semantics are exactly SQL's: a key with no inner rows
+        yields a NULL scalar, the comparison is not-true, the row drops."""
+        # fold any UNCORRELATED subqueries in the conjunct to literals first
+        # so the marker substitution below can only ever target the one
+        # correlated subquery
+        conjunct = self._replace_scalar_subqueries(conjunct)
+        subs = [x for x in _walk(conjunct)
+                if isinstance(x, P.ScalarSubquery)]
+        if len(subs) != 1:
+            raise BindError(
+                "at most one correlated scalar subquery per predicate"
+            )
+        sub = subs[0]
+        rel, sub_pos, n_outer, outer_names = self._join_corr_scalar(
+            joined, scope, sub, how="inner"
+        )
+        resolver = self._make_resolver(scope, joined)
 
         # lower the conjunct with the subquery replaced by the joined column
         marker = P.Ident("__corr__", "_sub")

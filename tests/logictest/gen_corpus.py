@@ -35,10 +35,10 @@ insert into nums values
 """
 
 PAIR = """
-create table pl (id int primary key, k int, v int)
+create table pl (id int primary key, k int, v int, m int)
 """, """
-insert into pl values (1, 1, 100), (2, 1, 200), (3, 2, 300), (4, null, 400),
-                      (5, 3, 500), (6, 2, 600)
+insert into pl values (1, 1, 100, 10), (2, 1, 200, 11), (3, 2, 300, 12),
+                      (4, null, 400, 13), (5, 3, 500, 14), (6, 2, 600, 15)
 """, """
 create table pr (id int primary key, k int, w int, tag string)
 """, """
@@ -445,6 +445,21 @@ AREAS.append(("case_cast_cte", NUMS, [
     ("I", "nosort", "select count(distinct s) from nums"),
     ("I", "nosort",
      "select count(distinct b) from nums where a > 2"),
+]))
+
+AREAS.append(("select_list_subqueries", NUMS + PAIR, [
+    # bare-column subquery over a UNIQUE correlation key (multi-row
+    # inners diverge: this engine takes max(), sqlite the first row,
+    # postgres errors — not a generatable directive)
+    ("II", "rowsort",
+     "select id, (select w from pr where pr.id = pl.m) from pl"),
+    ("II", "rowsort",
+     "select id, (select sum(w) from pr where pr.k = pl.k) from pl"),
+    ("II", "rowsort",
+     "select id, coalesce((select max(w) from pr where pr.k = pl.k), -1) "
+     "from pl"),
+    ("II", "rowsort",
+     "select a, (select count(*) from pl where pl.k = nums.a) from nums"),
 ]))
 
 AREAS.append(("scalar_subqueries", NUMS, [
