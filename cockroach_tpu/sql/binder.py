@@ -387,6 +387,21 @@ class ExprLowerer:
             return ex.Const(float(e.value), FLOAT64)
         if isinstance(e, P.NullLit):
             return ex.Const(None, INT64)
+        if (isinstance(e, P.Bin) and e.op in ("+", "-")
+                and isinstance(e.right, P.IntervalLit)):
+            # column ± day/week interval: a constant day add (exact).
+            # month/year intervals on COLUMNS need per-row calendar
+            # arithmetic (literal dates fold calendar-exactly in _fold)
+            iv = e.right
+            if iv.unit in ("day", "week"):
+                days = iv.n * (7 if iv.unit == "week" else 1)
+                return ex.BinOp(e.op, self.lower(e.left),
+                                ex.Const(days, INT64))
+            raise BindError(
+                f"column {e.op} INTERVAL {iv.unit} is not supported "
+                "(day/week intervals only; month/year need per-row "
+                "calendar arithmetic)"
+            )
         if isinstance(e, P.Bin) and e.op in ("and", "or"):
             return ex.BoolOp(e.op, (self.lower(e.left), self.lower(e.right)))
         if isinstance(e, P.Bin):

@@ -874,6 +874,18 @@ class Parser:
         if self.at_kw("interval"):
             self.next()
             n = self.next()
+            if n.kind == "str":
+                # postgres forms: INTERVAL '1 day' and INTERVAL '3' day
+                parts = n.value.split()
+                if len(parts) == 2:
+                    return IntervalLit(int(parts[0]),
+                                       parts[1].rstrip("s"))
+                if len(parts) == 1:
+                    unit = self.next().value.rstrip("s")
+                    return IntervalLit(int(parts[0]), unit)
+                raise SyntaxError(
+                    f"unsupported interval literal {n.value!r}"
+                )
             unit = self.next().value.rstrip("s")
             return IntervalLit(int(n.value), unit)
         if self.at_kw("case"):
