@@ -500,6 +500,24 @@ AREAS.append(("setops_filter_distinctfrom", NUMS + PAIR, [
      "select a from nums where f is distinct from null"),
 ]))
 
+# NOTE: mixed-operator chains (union ... intersect ...) are NOT generated
+# here: sqlite evaluates all set ops left-to-right at equal precedence,
+# while this dialect follows the standard (INTERSECT binds tighter) —
+# covered by the handwritten setop_precedence.test instead
+AREAS.append(("setop_chains", NUMS + PAIR, [
+    ("I", "rowsort",
+     "select b from nums intersect select b from nums"),
+    ("I", "nosort",
+     "select a from nums where a < 4 union select k from pr "
+     "where k is not null order by 1 limit 4"),
+    ("I", "rowsort",
+     "select a from nums where a < 5 union select a from nums where a > 7 "
+     "except select a from nums where a = 2"),
+    ("I", "rowsort",
+     "select a from nums where a <= 3 union all select a from nums "
+     "where a <= 2 except select 1 from nums where a = 1"),
+]))
+
 AREAS.append(("math_builtins", NUMS, [
     ("II", "rowsort", "select a, mod(b, 3) from nums where b is not null"),
     ("II", "rowsort", "select a, mod(b, -4) from nums where b is not null"),
