@@ -274,12 +274,18 @@ def _emit(final: bool) -> None:
         geomean_ratio = float(np.exp(np.mean(np.log(ratios))))
     else:
         geomean, geomean_ratio = 0.0, 0.0
+    # vs_colexec_est: the measured-denominator ratio (BASELINE.md "Measured
+    # baseline"): 8-vCPU colexec est. = pandas_1core/8, so the north-star
+    # ">=10x the 8-vCPU baseline" is vs_colexec_est >= 10 == vs_pandas >= 80
+    for d in queries:
+        d["vs_colexec_est"] = round(d["vs_pandas"] / 8.0, 4)
     out = {
         "metric": (f"tpch_sf{_partial['sf']:g}_{_partial['platform']}"
                    "_geomean_rows_per_sec"),
         "value": round(geomean),
         "unit": "rows/sec",
         "vs_baseline": round(geomean_ratio, 3),
+        "vs_colexec_est": round(geomean_ratio / 8.0, 4),
         "detail": detail,
     }
     if errors:

@@ -1,0 +1,277 @@
+"""Measured CPU baselines for the north-star denominator.
+
+BASELINE.md's target is "≥10× rows/sec vs the 8-vCPU colexec baseline", and
+the reference's own rule is that the baseline must be *measured*, not quoted
+(reference: pkg/cmd/roachtest/tests/tpchbench.go:203-223 runs the real ladder;
+pkg/workload/tpch/tpch.go:370 validates results). This image cannot execute
+the reference (no Go toolchain, no vendored deps, zero egress — verified
+2026-08-02), so this module measures the two closest executable stand-ins on
+the SAME box and data the engine is benched on:
+
+- **pandas**: vectorized C columnar evaluation, single core. This is the
+  per-core throughput stand-in for colexec (both are columnar batch engines
+  running compiled loops; the reference's own tpchvec results put colexec
+  within ~1-3× of its row engine, and pandas is at least as fast per core on
+  these aggregate/join shapes).
+- **sqlite**: a row-at-a-time compiled engine with real SQL semantics — the
+  stand-in for the reference's *row* engine lower bound.
+
+Scaling argument (recorded in BASELINE.md): colexec on 8 vCPUs is bounded
+above by 8× its single-core throughput (DistSQL scaling is sublinear across
+cores on one node: shared memtable/KV iterator contention, stream setup).
+Taking pandas-single-core as the per-core colexec proxy,
+
+    colexec_8vcpu_est(q)  =  pandas_1core_time(q) / 8        (generous bound)
+    vs_colexec_est        =  vs_pandas / 8
+
+so the north-star "10× the 8-vCPU baseline" is "vs_pandas ≥ 80" per query.
+All numbers this module emits are measured on this box at the stated SF.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import time
+
+import numpy as np
+
+from . import tpch
+
+# Columns each ladder query actually touches — loading only these keeps the
+# sqlite ingest proportional to the workload, not the full 16-col schema.
+_NEEDED = {
+    "lineitem": ["l_orderkey", "l_partkey", "l_suppkey", "l_quantity",
+                 "l_extendedprice", "l_discount", "l_tax", "l_returnflag",
+                 "l_linestatus", "l_shipdate"],
+    "orders": ["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority",
+               "o_totalprice"],
+    "customer": ["c_custkey", "c_name", "c_mktsegment"],
+    "supplier": ["s_suppkey", "s_nationkey"],
+    "nation": ["n_nationkey", "n_name"],
+    "part": ["p_partkey", "p_name"],
+    "partsupp": ["ps_partkey", "ps_suppkey", "ps_supplycost"],
+}
+
+# Real TPC-H SQL text (dates as integer days since epoch, matching the
+# generator's DATE encoding; decimals pre-scaled to floats by to_pandas).
+_SQL = {
+    "q1": """
+        SELECT l_returnflag, l_linestatus, sum(l_quantity),
+               sum(l_extendedprice),
+               sum(l_extendedprice*(1-l_discount)),
+               sum(l_extendedprice*(1-l_discount)*(1+l_tax)),
+               avg(l_quantity), avg(l_extendedprice), avg(l_discount),
+               count(*)
+        FROM lineitem WHERE l_shipdate <= {cutoff}
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus
+    """,
+    "q3": """
+        SELECT l_orderkey, sum(l_extendedprice*(1-l_discount)) AS revenue,
+               o_orderdate, o_shippriority
+        FROM customer, orders, lineitem
+        WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+          AND l_orderkey = o_orderkey
+          AND o_orderdate < {date} AND l_shipdate > {date}
+        GROUP BY l_orderkey, o_orderdate, o_shippriority
+        ORDER BY revenue DESC, o_orderdate LIMIT 10
+    """,
+    "q9": """
+        SELECT n_name AS nation, o_year, sum(amount) AS sum_profit FROM (
+          SELECT n_name, o_orderdate/365 AS o_year,
+                 l_extendedprice*(1-l_discount) - ps_supplycost*l_quantity
+                   AS amount
+          FROM part, supplier, lineitem, partsupp, orders, nation
+          WHERE s_suppkey = l_suppkey AND ps_suppkey = l_suppkey
+            AND ps_partkey = l_partkey AND p_partkey = l_partkey
+            AND o_orderkey = l_orderkey AND s_nationkey = n_nationkey
+            AND p_name LIKE '%green%'
+        ) GROUP BY nation, o_year ORDER BY nation, o_year DESC
+    """,
+    "q18": """
+        SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+               sum(l_quantity)
+        FROM customer, orders, lineitem
+        WHERE o_orderkey IN (
+            SELECT l_orderkey FROM lineitem GROUP BY l_orderkey
+            HAVING sum(l_quantity) > 300)
+          AND c_custkey = o_custkey AND o_orderkey = l_orderkey
+        GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+        ORDER BY o_totalprice DESC, o_orderdate LIMIT 100
+    """,
+}
+
+
+def _pandas_time(qname: str, frames: dict, runs: int = 2) -> float:
+    """Best-of-runs single-core pandas time for one ladder query. The query
+    bodies mirror bench.py's oracle implementations (which also assert
+    engine-result equality every bench run)."""
+    import pandas as pd
+
+    li = frames["lineitem"]
+    times = []
+    for _ in range(runs):
+        if qname == "q1":
+            t0 = time.time()
+            cutoff = tpch.d("1998-12-01") - 90
+            f = li[li.l_shipdate <= cutoff].copy()
+            f["disc_price"] = f.l_extendedprice * (1 - f.l_discount)
+            f["charge"] = f.disc_price * (1 + f.l_tax)
+            f.groupby(["l_returnflag", "l_linestatus"]).agg(
+                sum_qty=("l_quantity", "sum"),
+                sum_base_price=("l_extendedprice", "sum"),
+                sum_disc_price=("disc_price", "sum"),
+                sum_charge=("charge", "sum"),
+                avg_qty=("l_quantity", "mean"),
+                avg_price=("l_extendedprice", "mean"),
+                avg_disc=("l_discount", "mean"),
+                count_order=("l_quantity", "size"),
+            ).sort_index()
+            times.append(time.time() - t0)
+        elif qname == "q3":
+            o, c = frames["orders"], frames["customer"]
+            t0 = time.time()
+            date = tpch.d("1995-03-15")
+            cb = c[c.c_mktsegment == "BUILDING"]
+            ob = o[o.o_orderdate < date].merge(
+                cb, left_on="o_custkey", right_on="c_custkey")
+            lb = li[li.l_shipdate > date]
+            j = lb.merge(ob, left_on="l_orderkey", right_on="o_orderkey")
+            j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+            (j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
+             .agg(revenue=("revenue", "sum")).reset_index()
+             .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+             .head(10))
+            times.append(time.time() - t0)
+        elif qname == "q9":
+            o, s = frames["orders"], frames["supplier"]
+            n, p, ps = frames["nation"], frames["part"], frames["partsupp"]
+            t0 = time.time()
+            pg = p[p.p_name.str.contains("green")]
+            j = (li[li.l_partkey.isin(pg.p_partkey)]
+                 .merge(ps, left_on=["l_partkey", "l_suppkey"],
+                        right_on=["ps_partkey", "ps_suppkey"])
+                 .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+                 .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+                 .merge(o, left_on="l_orderkey", right_on="o_orderkey"))
+            j["o_year"] = pd.to_datetime(
+                j.o_orderdate, unit="D", origin="unix").dt.year
+            j["amount"] = (j.l_extendedprice * (1 - j.l_discount)
+                           - j.ps_supplycost * j.l_quantity)
+            (j.groupby(["n_name", "o_year"]).agg(sum_profit=("amount", "sum"))
+             .reset_index()
+             .sort_values(["n_name", "o_year"], ascending=[True, False]))
+            times.append(time.time() - t0)
+        elif qname == "q18":
+            o, c = frames["orders"], frames["customer"]
+            t0 = time.time()
+            qty = li.groupby("l_orderkey").l_quantity.sum()
+            big = qty[qty > 300].index
+            j = (o[o.o_orderkey.isin(big)]
+                 .merge(c, left_on="o_custkey", right_on="c_custkey")
+                 .merge(li, left_on="o_orderkey", right_on="l_orderkey"))
+            (j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                        "o_totalprice"])
+             .agg(sum_qty=("l_quantity", "sum")).reset_index()
+             .sort_values(["o_totalprice", "o_orderdate"],
+                          ascending=[False, True])
+             .head(100))
+            times.append(time.time() - t0)
+        else:
+            raise ValueError(qname)
+    return min(times)
+
+
+def _sqlite_load(frames: dict) -> tuple[sqlite3.Connection, float]:
+    """Load the needed columns into an in-memory sqlite DB; returns (conn,
+    load_seconds). No explicit indexes — sqlite's planner builds automatic
+    transient indexes for the joins, which is how an ad-hoc analytic run
+    against a row engine behaves."""
+    conn = sqlite3.connect(":memory:")
+    t0 = time.time()
+    for name, cols in _NEEDED.items():
+        df = frames[name]
+        decls = []
+        import pandas.api.types as ptypes
+
+        for cname in cols:
+            kind = ("REAL" if ptypes.is_float_dtype(df[cname]) else
+                    "INTEGER" if ptypes.is_integer_dtype(df[cname])
+                    else "TEXT")
+            decls.append(f"{cname} {kind}")
+        conn.execute(f"CREATE TABLE {name} ({', '.join(decls)})")
+        ph = ", ".join("?" * len(cols))
+        rows = list(zip(*[df[cname].tolist() for cname in cols]))
+        conn.executemany(f"INSERT INTO {name} VALUES ({ph})", rows)
+    conn.commit()
+    return conn, time.time() - t0
+
+
+def _sqlite_time(qname: str, conn: sqlite3.Connection,
+                 runs: int = 2) -> float:
+    sql = _SQL[qname].format(cutoff=tpch.d("1998-12-01") - 90,
+                             date=tpch.d("1995-03-15"))
+    times = []
+    for _ in range(runs):
+        t0 = time.time()
+        conn.execute(sql).fetchall()
+        times.append(time.time() - t0)
+    return min(times)
+
+
+def measure(sf: float = 1.0, queries=("q1", "q3", "q9", "q18"),
+            with_sqlite: bool = True, runs: int = 2) -> dict:
+    """Measure the stand-in baselines; returns the BASELINE_MEASURED dict."""
+    import os
+    import platform as plat
+
+    cat = tpch.gen_tpch_cached(sf=sf)
+    nrows = cat.get("lineitem").num_rows
+    frames = {name: tpch.to_pandas(cat, name) for name in _NEEDED}
+    out = {
+        "sf": sf,
+        "lineitem_rows": int(nrows),
+        "box": {"nproc": os.cpu_count(), "machine": plat.machine(),
+                "python": plat.python_version()},
+        "method": ("pandas single-core + sqlite row engine on this box; "
+                   "colexec_8vcpu_est = pandas_1core / 8 (see module doc)"),
+        "queries": {},
+    }
+    conn = None
+    if with_sqlite:
+        conn, load_s = _sqlite_load(frames)
+        out["sqlite_load_s"] = round(load_s, 1)
+    for q in queries:
+        p = _pandas_time(q, frames, runs=runs)
+        entry = {
+            "pandas_1core_s": round(p, 3),
+            "pandas_rows_per_sec": round(nrows / p),
+            "colexec_8vcpu_est_s": round(p / 8, 3),
+            "colexec_8vcpu_est_rows_per_sec": round(nrows / (p / 8)),
+        }
+        if conn is not None:
+            s = _sqlite_time(q, conn, runs=runs)
+            entry["sqlite_1core_s"] = round(s, 3)
+        out["queries"][q] = entry
+        print(f"# baseline {q}: pandas {p:.2f}s"
+              + (f", sqlite {entry.get('sqlite_1core_s', '-')}s"
+                 if conn else ""), flush=True)
+    if conn is not None:
+        conn.close()
+    return out
+
+
+def main() -> None:
+    import os
+
+    sf = float(os.environ.get("TPCH_SF", "1.0"))
+    res = measure(sf=sf)
+    path = os.environ.get("BASELINE_OUT", "BASELINE_MEASURED.json")
+    with open(path, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps({"written": path, "sf": sf}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
