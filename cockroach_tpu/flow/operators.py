@@ -113,6 +113,28 @@ def _consume(op: OneInputOperator, tile_fn_name: str, tile_fn,
 # Scan
 
 
+def _wire_source_metadata(op, table, names: tuple[str, ...]) -> None:
+    """Install the plan-static metadata every table source carries:
+    output_schema, per-column dictionaries, and (lo, hi) column stats —
+    shared by ScanOp and IndexScanOp so the downstream contract has one
+    definition."""
+    idxs = tuple(table.schema.index(n) for n in names)
+    op.col_idxs = idxs
+    op.output_schema = table.schema.select(idxs)
+    full_dicts = table.dict_by_index()
+    op.dictionaries = {
+        i: full_dicts[ci] for i, ci in enumerate(idxs) if ci in full_dicts
+    }
+    stats_fn = getattr(table, "col_stats", None)
+    if callable(stats_fn):
+        by_name = stats_fn()
+        op.col_stats = {
+            i: by_name[n]
+            for i, n in enumerate(op.output_schema.names)
+            if n in by_name
+        }
+
+
 class ScanOp(SourceOperator):
     """Tile-granular scan (cFetcher analog). Two modes:
 
@@ -137,23 +159,7 @@ class ScanOp(SourceOperator):
         super().__init__()
         self.table = table
         self.shard = shard  # (i, n): emit only rows [i*rows//n, (i+1)*rows//n)
-        names = columns or table.schema.names
-        self.col_idxs = tuple(table.schema.index(n) for n in names)
-        self.output_schema = table.schema.select(self.col_idxs)
-        full_dicts = table.dict_by_index()
-        self.dictionaries = {
-            i: full_dicts[ci]
-            for i, ci in enumerate(self.col_idxs)
-            if ci in full_dicts
-        }
-        stats_fn = getattr(table, "col_stats", None)
-        if callable(stats_fn):
-            by_name = stats_fn()
-            self.col_stats = {
-                i: by_name[n]
-                for i, n in enumerate(self.output_schema.names)
-                if n in by_name
-            }
+        _wire_source_metadata(self, table, columns or table.schema.names)
         self._batch = None
         self.tile = tile
         self._offset = 0
@@ -308,6 +314,35 @@ class ScanOp(SourceOperator):
         return out
 
 
+class IndexScanOp(SourceOperator):
+    """Index-backed read (plan/spec.IndexScan): resolve matching primary
+    keys from the secondary-index keyspace, then fetch the rows in one
+    Streamer pass (joinreader.go + kvstreamer/streamer.go:517 roles). The
+    output batch's capacity is sized by the MATCH COUNT — downstream
+    kernels compile at lookup-result shape, not table shape."""
+
+    def __init__(self, table, index_name: str, lo: int | None,
+                 hi: int | None, columns: tuple[str, ...] | None = None):
+        super().__init__()
+        self.table = table
+        self.ix = next(i for i in table.indexes if i.name == index_name)
+        self.lo, self.hi = lo, hi
+        self.names = tuple(columns or table.schema.names)
+        _wire_source_metadata(self, table, self.names)
+        self._batch = None
+
+    def init(self):
+        from ..kv import index as ixm
+
+        pks = ixm.scan_pks(self.table, self.ix, self.lo, self.hi)
+        self._batch = ixm.Streamer(self.table).fetch(pks, self.names)
+        super().init()
+
+    def _next(self):
+        b, self._batch = self._batch, None
+        return b
+
+
 def _identity_fn(b):
     return b
 
@@ -320,6 +355,75 @@ def _slice_tile(tile: int, b: Batch, off) -> Batch:
 
 # ---------------------------------------------------------------------------
 # Streaming ops
+
+
+class HashBucketOp(OneInputOperator):
+    """One outgoing stream of a HashRouter (colflow/routers.go:420): mask
+    away rows whose key-hash bucket is not `part` of `n_parts`. A producer
+    runs one HashBucketOp per consumer over the same scan — together they
+    partition the input exactly (same splitmix64 the join/agg hash paths
+    use, so co-partitioned sides land on the same peer)."""
+
+    def __init__(self, child: Operator, keys: tuple[int, ...],
+                 n_parts: int, part: int):
+        super().__init__(child)
+        self.output_schema = child.output_schema
+        from ..coldata.types import Family
+        from ..ops import hashing
+
+        schema = child.output_schema
+        for k in keys:
+            if schema.types[k].family is Family.STRING:
+                raise TypeError(
+                    "cross-host repartition on STRING keys is not "
+                    "supported (dictionary codes are per-process)"
+                )
+
+        def raw(b: Batch) -> Batch:
+            h = hashing.hash_columns(
+                [b.cols[k] for k in keys],
+                [schema.types[k] for k in keys],
+            )
+            return b.with_mask(
+                b.mask & (hashing.bucket(h, n_parts) == part))
+
+        self._raw = raw
+        self._fn = jax.jit(raw)
+
+    def stream_parts(self):
+        return _compose_parts(self, self.child, self._raw)
+
+    def _next(self):
+        b = self.child.next_batch()
+        return None if b is None else self._fn(b)
+
+
+class RemoteStreamOp(SourceOperator):
+    """Leaf that attaches to a peer host's registered flow stream at init
+    and pulls its batches — the Inbox half of a host-to-host stream
+    (colrpc/inbox.go:48; plan/spec.RemoteStream)."""
+
+    def __init__(self, addr, flow_id: str, stream_id: int, schema):
+        super().__init__()
+        self.addr = tuple(addr)
+        self.flow_id = flow_id
+        self.stream_id = stream_id
+        self.output_schema = schema
+        self._inbox = None
+
+    def init(self):
+        from .disthost import attach_stream
+
+        self._inbox = attach_stream(self.addr, self.flow_id,
+                                    self.stream_id, self.output_schema)
+        super().init()
+
+    def _next(self):
+        return self._inbox.next_batch()
+
+    def close(self):
+        if self._inbox is not None:
+            self._inbox.close()
 
 
 class FilterOp(OneInputOperator):

@@ -68,7 +68,8 @@ class _TableDict:
 
 class KVTable:
     def __init__(self, db: DB, name: str, schema: Schema, pk: str,
-                 table_id: int, dict_table_id: int | None = None):
+                 table_id: int, dict_table_id: int | None = None,
+                 indexes: list | None = None):
         for t in schema.types:
             if t.family in _UNSUPPORTED:
                 raise TypeError(
@@ -103,6 +104,9 @@ class KVTable:
             if t.family is Family.STRING
         )
         self.dict_table_id = dict_table_id
+        # secondary indexes (kv/index.IndexDesc); maintained inside every
+        # row write's txn, visible to the planner via plan/indexopt.py
+        self.indexes: list = list(indexes or [])
         self._dicts: dict[int, _TableDict] = {}
         if self._string_cols:
             if dict_table_id is None:
@@ -173,10 +177,10 @@ class KVTable:
             code = slot.get(v)
         if code is None:
             enc = v.encode("utf-8")
-            if len(enc) + 2 > vw:
+            if len(enc) > 0xFFFF:
                 raise ValueError(
-                    f"string of {len(enc)} bytes exceeds engine value "
-                    f"width {vw}"
+                    f"string of {len(enc)} bytes exceeds the 64KiB "
+                    "dictionary-entry bound (2-byte length header)"
                 )
             code = len(d.values) + len(slot)
             slot[v] = code
@@ -235,8 +239,32 @@ class KVTable:
         vb = values.tobytes()
         kw = keys.shape[1]
         vw_row = values.shape[1]
+        # upsert discipline: old rows must be read BEFORE the puts land
+        # (afterwards t.get returns the txn's own fresh intent and the
+        # stale-entry tombstone below would never fire)
+        old_rows: dict[int, dict] = {}
+        if self.indexes:
+            for r in range(n):
+                old_v = t.get(kb[r * kw:(r + 1) * kw])
+                if old_v is not None:
+                    old_rows[r] = rowcodec.decode_row(self.schema, old_v)
         for r in range(n):
             t.put(kb[r * kw:(r + 1) * kw], vb[r * vw_row:(r + 1) * vw_row])
+        if self.indexes:
+            from . import index as ixm
+
+            for r in range(n):
+                new_row = {}
+                for name in self.schema.names:
+                    a = cols.get(name)
+                    if a is None:
+                        continue
+                    vmask = valids.get(name)
+                    if vmask is not None and not vmask[r]:
+                        continue
+                    new_row[name] = a[r]
+                ixm.maintain_row(t, self.indexes, self.schema, new_row,
+                                 old_rows.get(r), int(pks[r]))
         self._count_cache = None
         return n
 
@@ -290,22 +318,64 @@ class KVTable:
                         len(enc).to_bytes(2, "little") + enc,
                     )
         ts = self.db.clock.now()
-        keys = rowcodec.encode_pk_batch(
-            self.table_id, np.asarray(cols[self.pk], dtype=np.int64))
+        pks = np.asarray(cols[self.pk], dtype=np.int64)
+        keys = rowcodec.encode_pk_batch(self.table_id, pks)
         values = rowcodec.encode_rows(self.schema, cols, valids)
         for lo in range(0, n, chunk):
             hi = min(lo + chunk, n)
             self.db.engine.ingest(keys[lo:hi], values[lo:hi], ts=ts)
+        if self.indexes:
+            # index runs ingest alongside the rows (IMPORT assumes fresh
+            # pks — the insert path handles upsert tombstoning)
+            from . import index as ixm
+
+            for ix in self.indexes:
+                a = cols.get(ix.col)
+                if a is None:
+                    continue
+                vmask = valids.get(ix.col)
+                keep = (np.asarray(vmask, dtype=bool) if vmask is not None
+                        else np.ones(n, dtype=bool))
+                ik = ixm.encode_entries(
+                    ix.index_id, np.asarray(a, dtype=np.int64)[keep],
+                    pks[keep])
+                # entries must land SORTED (ingest builds one sorted run)
+                order = np.lexsort(ik.T[::-1])
+                ik = ik[order]
+                iv = np.zeros((len(ik), 0), dtype=np.uint8)
+                for lo in range(0, len(ik), chunk):
+                    hi = min(lo + chunk, len(ik))
+                    self.db.engine.ingest(ik[lo:hi], iv[lo:hi], ts=ts)
         self._count_cache = None
         return n
 
     def insert(self, t: Txn, row: dict) -> None:
         row = self._encode_strings(t, row)
-        key = rowcodec.encode_pk(self.table_id, int(row[self.pk]))
+        pk = int(row[self.pk])
+        key = rowcodec.encode_pk(self.table_id, pk)
+        if self.indexes:
+            # MVCC puts are upserts: a replaced row's stale index entries
+            # must tombstone in the same txn (rowenc secondary-index
+            # maintenance; the reference reads the old row for updates too)
+            from . import index as ix
+
+            old_v = t.get(key)
+            old = (rowcodec.decode_row(self.schema, old_v)
+                   if old_v is not None else None)
+            ix.maintain_row(t, self.indexes, self.schema, row, old, pk)
         t.put(key, rowcodec.encode_row(self.schema, row))
 
     def delete_pk(self, t: Txn, pk: int) -> None:
-        t.delete(rowcodec.encode_pk(self.table_id, int(pk)))
+        key = rowcodec.encode_pk(self.table_id, int(pk))
+        if self.indexes:
+            from . import index as ix
+
+            old_v = t.get(key)
+            if old_v is not None:
+                ix.maintain_row(t, self.indexes, self.schema, None,
+                                rowcodec.decode_row(self.schema, old_v),
+                                int(pk))
+        t.delete(key)
 
     def get_row_txn(self, t: Txn, pk: int) -> dict | None:
         """Transactional row read: goes through Txn.get so the read lands in
@@ -507,6 +577,10 @@ def write_descriptor(db: DB, t: KVTable, writer=None) -> None:
         "pk": t.pk,
         "table_id": t.table_id,
         "dict_table_id": t.dict_table_id,
+        "indexes": [
+            {"name": ix.name, "col": ix.col, "index_id": ix.index_id}
+            for ix in t.indexes
+        ],
     }
     from .chunked import chunk_blob
 
@@ -551,8 +625,12 @@ def load_catalog_from_engine(catalog, db: DB,
                     precision=d["precision"], scale=d["scale"])
             for d in desc["types"]
         )
+        from .index import IndexDesc
+
         t = KVTable(db, desc["name"], S(tuple(desc["names"]), types),
-                    desc["pk"], desc["table_id"], desc["dict_table_id"])
+                    desc["pk"], desc["table_id"], desc["dict_table_id"],
+                    indexes=[IndexDesc(d["name"], d["col"], d["index_id"])
+                             for d in desc.get("indexes", [])])
         catalog.tables[desc["name"]] = t
         out.append(desc["name"])
     return out
@@ -578,6 +656,7 @@ def create_kv_table(catalog, db: DB, name: str, schema: Schema, pk: str,
             used.add(t.table_id)
             if t.dict_table_id is not None:
                 used.add(t.dict_table_id)
+            used.update(ix.index_id for ix in t.indexes)
 
     def alloc() -> int:
         # only ids INSIDE the range matter: a foreign tenant's high id in

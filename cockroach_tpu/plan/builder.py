@@ -116,6 +116,11 @@ def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
             tile=settings.get("sql.distsql.tile_size"),
             shard=plan.shard,
         )
+    if isinstance(plan, S.IndexScan):
+        return ops.IndexScanOp(
+            catalog.get(plan.table), plan.index, plan.lo, plan.hi,
+            plan.columns,
+        )
     if isinstance(plan, S.Filter):
         return ops.FilterOp(build(plan.input, catalog), plan.predicate)
     if isinstance(plan, S.Project):

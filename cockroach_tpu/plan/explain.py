@@ -16,6 +16,10 @@ def _node_label(n: S.PlanNode) -> str:
     if isinstance(n, S.TableScan):
         cols = f" columns={list(n.columns)}" if n.columns else ""
         return f"scan {n.table}{cols}"
+    if isinstance(n, S.IndexScan):
+        lo = "-inf" if n.lo is None else n.lo
+        hi = "+inf" if n.hi is None else n.hi
+        return f"index-scan {n.table}@{n.index} [{lo}, {hi}]"
     if isinstance(n, S.Filter):
         return f"filter {n.predicate}"
     if isinstance(n, S.Project):

@@ -1,0 +1,152 @@
+"""Index selection — rewrite Filter(TableScan) into IndexScan.
+
+Reference: the optimizer's GenerateIndexScans / GenerateConstrainedScans
+exploration rules turn filtered full scans into constrained index scans
+when a filter conjunct constrains an indexed column
+(pkg/sql/opt/xform/select_funcs.go); the execbuilder then plans an index
+join to fetch unindexed columns (pkg/sql/rowexec/joinreader.go).
+
+Reduction: single-column indexes, conjuncts of the form
+``col <cmp> literal`` (and BETWEEN, which the binder lowers to two
+conjuncts). The whole original predicate stays as a residual filter over
+the fetched rows — re-applying the bound conjunct is one fused mask op,
+and it keeps boundary/NULL semantics independent of the span math.
+
+Selectivity gate: the scan flips to the index only when the constrained
+value range is estimated under ``sql.opt.index_scan_max_frac`` of the
+column's (lo, hi) span from table statistics — a full-table IndexScan
+would be strictly worse than the resident columnar scan."""
+
+from __future__ import annotations
+
+from ..ops import expr as ex
+from ..utils import settings
+from . import spec as S
+
+INDEX_SCAN_ENABLED = settings.register_bool(
+    "sql.opt.index_scan.enabled", True,
+    "plan index-backed reads for selective filters on indexed columns",
+)
+INDEX_SCAN_MAX_FRAC = settings.register_float(
+    "sql.opt.index_scan.max_frac", 0.25,
+    "estimated selected fraction above which a filtered full scan beats "
+    "an index scan + fetch", lo=0.0, hi=1.0,
+)
+
+
+def _conjuncts(e: ex.Expr) -> list[ex.Expr]:
+    if isinstance(e, ex.BoolOp) and e.op == "and":
+        out = []
+        for part in e.args:
+            out.extend(_conjuncts(part))
+        return out
+    return [e]
+
+
+def _col_bound(c: ex.Expr) -> tuple[int, str, int] | None:
+    """(scan column index, cmp op, literal) for `col <cmp> int-literal`
+    conjuncts, normalized so the column is on the left."""
+    if not isinstance(c, ex.Cmp) or c.op == "ne":
+        return None
+    flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+    left, right, op = c.left, c.right, c.op
+    if isinstance(right, ex.ColRef) and isinstance(left, ex.Const):
+        left, right, op = right, left, flip[op]
+    if not (isinstance(left, ex.ColRef) and isinstance(right, ex.Const)):
+        return None
+    v = right.value
+    if isinstance(v, bool) or not (
+            isinstance(v, int) or hasattr(v, "__index__")):
+        return None
+    return left.idx, op, int(v)
+
+
+def _bounds_for(conjs, names, indexed: dict[str, object]):
+    """Tightest (index, lo, hi) over the conjuncts, or None."""
+    best: dict[str, list] = {}
+    for c in conjs:
+        m = _col_bound(c)
+        if m is None:
+            continue
+        i, op, v = m
+        if i >= len(names) or names[i] not in indexed:
+            continue
+        lo, hi = best.setdefault(names[i], [None, None])
+        if op == "eq":
+            nlo, nhi = v, v
+        elif op == "lt":
+            nlo, nhi = None, v - 1
+        elif op == "le":
+            nlo, nhi = None, v
+        elif op == "gt":
+            nlo, nhi = v + 1, None
+        else:  # ge
+            nlo, nhi = v, None
+        b = best[names[i]]
+        b[0] = nlo if b[0] is None else (b[0] if nlo is None else max(b[0], nlo))
+        b[1] = nhi if b[1] is None else (b[1] if nhi is None else min(b[1], nhi))
+    for col, (lo, hi) in best.items():
+        if lo is not None or hi is not None:
+            return indexed[col], lo, hi
+    return None
+
+
+def _selective_enough(table, ix, lo, hi) -> bool:
+    if lo is not None and hi is not None and hi < lo:
+        return True  # empty span: the index scan is free
+    stats = table.col_stats()
+    b = stats.get(ix.col)
+    if b is None:
+        # no statistics: only a two-sided constraint is trusted
+        return lo is not None and hi is not None
+    clo, chi = int(b[0]), int(b[1])
+    width = max(1, chi - clo + 1)
+    elo = clo if lo is None else max(clo, lo)
+    ehi = chi if hi is None else min(chi, hi)
+    frac = max(0, ehi - elo + 1) / width
+    return frac <= settings.get("sql.opt.index_scan.max_frac")
+
+
+def use_indexes(plan: S.PlanNode, catalog) -> S.PlanNode:
+    """Recursively rewrite eligible Filter(TableScan) subtrees."""
+    if not settings.get("sql.opt.index_scan.enabled"):
+        return plan
+    return _rewrite(plan, catalog)
+
+
+def _rewrite(plan, catalog):
+    from ..kv.table import KVTable
+
+    if isinstance(plan, S.Filter) and isinstance(plan.input, S.TableScan):
+        scan = plan.input
+        table = catalog.tables.get(scan.table)
+        if (isinstance(table, KVTable) and table.indexes
+                and scan.shard is None):
+            names = scan.columns or table.schema.names
+            indexed = {ix.col: ix for ix in table.indexes}
+            got = _bounds_for(_conjuncts(plan.predicate), names, indexed)
+            if got is not None:
+                ix, lo, hi = got
+                if _selective_enough(table, ix, lo, hi):
+                    return S.Filter(
+                        S.IndexScan(scan.table, ix.name, lo, hi,
+                                    scan.columns),
+                        plan.predicate,
+                    )
+    # generic recursion over PlanNode dataclass fields
+    import dataclasses
+
+    if not dataclasses.is_dataclass(plan):
+        return plan
+    changes = {}
+    for f in dataclasses.fields(plan):
+        v = getattr(plan, f.name)
+        if isinstance(v, S.PlanNode):
+            nv = _rewrite(v, catalog)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, tuple) and v and isinstance(v[0], S.PlanNode):
+            nv = tuple(_rewrite(x, catalog) for x in v)
+            if any(a is not b for a, b in zip(nv, v)):
+                changes[f.name] = nv
+    return dataclasses.replace(plan, **changes) if changes else plan

@@ -35,6 +35,53 @@ class TableScan(PlanNode):
 
 
 @dataclass(frozen=True)
+class IndexScan(PlanNode):
+    """Index-backed read: scan the secondary index keyspace for values in
+    [lo, hi], then fetch the matched primary rows through the Streamer
+    (joinreader/kvstreamer role). Output capacity is sized by the match
+    count, not the table."""
+
+    table: str
+    index: str  # IndexDesc.name
+    lo: int | None  # inclusive value bounds in the indexed column's
+    hi: int | None  # int-encoded domain (None = unbounded)
+    columns: tuple[str, ...] | None = None
+
+
+@dataclass(frozen=True)
+class HashBucket(PlanNode):
+    """Keep only rows whose key-hash bucket equals `part` of `n_parts` —
+    one outgoing stream of a HashRouter (colflow/routers.go:420): a
+    producer plans one HashBucket per consumer over the same input."""
+
+    input: PlanNode
+    keys: tuple[int, ...]
+    n_parts: int
+    part: int
+
+
+@dataclass(frozen=True)
+class RemoteStream(PlanNode):
+    """Leaf that attaches to a peer host's registered flow stream and
+    yields its batches — the StreamEndpointSpec REMOTE type
+    (execinfrapb/data.proto) + Inbox (colrpc/inbox.go:48)."""
+
+    addr: tuple  # (host, port)
+    flow_id: str
+    stream_id: int
+    schema: Schema
+
+
+@dataclass(frozen=True)
+class StreamUnion(PlanNode):
+    """Unordered fan-in of several inputs with one puller thread per
+    input (ParallelUnorderedSynchronizer role) — used for inbound remote
+    streams so hosts stream concurrently."""
+
+    inputs: tuple[PlanNode, ...]
+
+
+@dataclass(frozen=True)
 class Filter(PlanNode):
     input: PlanNode
     predicate: Expr

@@ -26,7 +26,7 @@ _TOKEN_RE = re.compile(
   | (?P<num>\d+\.\d+|\.\d+|\d+)
   | (?P<str>'(?:[^']|'')*')
   | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<op>::|<=|>=|<>|!=|\|\||[-+*/%(),.;<>=])
+  | (?P<op>::|<=|>=|<>|!=|\|\||[-+*/%(),.;<>=@])
     """,
     re.VERBOSE,
 )
@@ -41,6 +41,7 @@ KEYWORDS = {
     "substring", "for", "true", "false", "any", "some", "with",
     "create", "table", "primary", "key", "insert", "into", "values",
     "update", "set", "delete", "default", "alter", "add", "column", "drop",
+    "index",
     "over", "partition", "rows", "range", "groups", "unbounded",
     "preceding", "following", "current", "row", "exclude", "no",
     "others", "ties",
@@ -315,6 +316,24 @@ class AlterTable(Node):
 
 
 @dataclass(frozen=True)
+class CreateIndex(Node):
+    """CREATE INDEX <name> ON <table> (<col>). Reference grammar: sql.y
+    create_index_stmt (reduced: one column, no STORING/UNIQUE/partial)."""
+
+    name: str
+    table: str
+    col: str
+
+
+@dataclass(frozen=True)
+class DropIndex(Node):
+    """DROP INDEX <table>@<name> | DROP INDEX <name> ON <table>."""
+
+    name: str
+    table: str
+
+
+@dataclass(frozen=True)
 class Insert(Node):
     table: str
     columns: tuple[str, ...] | None  # None = all, in schema order
@@ -408,7 +427,12 @@ class Parser:
         """Statement entry: SELECT (incl. WITH) | CREATE TABLE | INSERT |
         UPDATE | DELETE. Reference grammar: pkg/sql/parser/sql.y."""
         if self.at_kw("create"):
-            s = self.parse_create_table()
+            if self.peek(1).value.lower() == "index":
+                s = self.parse_create_index()
+            else:
+                s = self.parse_create_table()
+        elif self.at_kw("drop"):
+            s = self.parse_drop_index()
         elif self.at_kw("alter"):
             s = self.parse_alter_table()
         elif self.at_kw("insert"):
@@ -424,6 +448,26 @@ class Parser:
             t = self.peek()
             raise SyntaxError(f"trailing input at {t.pos}: {t.value!r}")
         return s
+
+    def parse_create_index(self) -> CreateIndex:
+        self.expect_kw("create")
+        self.expect_kw("index")
+        name = self.next().value
+        self.expect_kw("on")
+        table = self.next().value
+        self.expect_op("(")
+        col = self.next().value
+        self.expect_op(")")
+        return CreateIndex(name, table, col)
+
+    def parse_drop_index(self) -> DropIndex:
+        self.expect_kw("drop")
+        self.expect_kw("index")
+        first = self.next().value
+        if self.eat_op("@"):  # table@index (the CRDB spelling)
+            return DropIndex(self.next().value, first)
+        self.expect_kw("on")
+        return DropIndex(first, self.next().value)
 
     def parse_create_table(self) -> CreateTable:
         self.expect_kw("create")

@@ -400,8 +400,15 @@ class Rel:
 
     # -- execution ----------------------------------------------------------
 
+    def optimized_plan(self) -> S.PlanNode:
+        """Plan after local optimization passes (index selection —
+        plan/indexopt.py). Distribution has its own rewrite."""
+        from ..plan.indexopt import use_indexes
+
+        return use_indexes(self.plan, self.catalog)
+
     def run(self) -> dict[str, np.ndarray]:
-        return run_plan(self.plan, self.catalog)
+        return run_plan(self.optimized_plan(), self.catalog)
 
     def run_distributed(self, mesh=None,
                         broadcast_rows: int | None = None
@@ -438,7 +445,7 @@ class Rel:
     def explain(self) -> str:
         from ..plan.explain import explain_plan
 
-        return explain_plan(self.plan)
+        return explain_plan(self.optimized_plan())
 
     def explain_analyze(self) -> tuple[str, dict[str, np.ndarray]]:
         """Run with ComponentStats collection; returns (rendered tree,
@@ -446,5 +453,6 @@ class Rel:
         from ..flow.runtime import run_plan_with_stats
         from ..plan.explain import explain_analyze
 
-        res, root = run_plan_with_stats(self.plan, self.catalog)
-        return explain_analyze(self.plan, root), res
+        plan = self.optimized_plan()
+        res, root = run_plan_with_stats(plan, self.catalog)
+        return explain_analyze(plan, root), res

@@ -170,18 +170,19 @@ class Session:
         stmt = P.parse_statement(text)
         if isinstance(stmt, P.Select):
             return self._select(stmt)
+        if isinstance(stmt, (P.CreateTable, P.AlterTable, P.CreateIndex,
+                             P.DropIndex)) and self._txn is not None:
+            raise BindError(
+                "DDL inside an explicit transaction is not supported"
+            )
         if isinstance(stmt, P.CreateTable):
-            if self._txn is not None:
-                raise BindError(
-                    "DDL inside an explicit transaction is not supported"
-                )
             return self._create_table(stmt)
         if isinstance(stmt, P.AlterTable):
-            if self._txn is not None:
-                raise BindError(
-                    "DDL inside an explicit transaction is not supported"
-                )
             return self._alter_table(stmt)
+        if isinstance(stmt, P.CreateIndex):
+            return self._create_index(stmt)
+        if isinstance(stmt, P.DropIndex):
+            return self._drop_index(stmt)
         if isinstance(stmt, P.Insert):
             return self._insert(stmt)
         if isinstance(stmt, P.Update):
@@ -698,6 +699,33 @@ class Session:
                 f"schema change failed: {done.error or done.state}"
             )
         return {"altered": stmt.name, "job_id": done.job_id}
+
+    def _create_index(self, stmt: P.CreateIndex):
+        """CREATE INDEX as a create_index job: chunked checkpointed entry
+        backfill, then a fenced descriptor swap (pkg/sql/backfill.go
+        discipline, same machinery as ALTER TABLE)."""
+        from ..kv.index import plan_create_index, register_create_index_job
+
+        id_range = ((self.tenant.id_lo, self.tenant.id_hi)
+                    if self.tenant is not None else None)
+        payload = plan_create_index(self.catalog, self.db, stmt,
+                                    id_range=id_range)
+        reg = self._jobs_registry()
+        register_create_index_job(reg, self.catalog)
+        job = reg.create("create_index", payload)
+        done = reg.adopt_and_resume(job.job_id)
+        if done.state != "succeeded":
+            raise BindError(
+                f"CREATE INDEX failed: {done.error or done.state}"
+            )
+        return {"created_index": stmt.name, "job_id": done.job_id}
+
+    def _drop_index(self, stmt: P.DropIndex):
+        from ..kv.index import drop_index
+
+        t = self._kv_table(stmt.table)
+        drop_index(self.catalog, self.db, t.name, stmt.name)
+        return {"dropped_index": stmt.name}
 
     # -- DML -----------------------------------------------------------------
 

@@ -165,7 +165,9 @@ class _Memtable:
     seq: list[int] = field(default_factory=list)
     txn: list[int] = field(default_factory=list)
     tomb: list[bool] = field(default_factory=list)
-    value: list[bytes] = field(default_factory=list)
+    value: list[bytes] = field(default_factory=list)  # inline slot bytes
+    vlen: list[int] = field(default_factory=list)  # LOGICAL value length
+    # (vlen > engine.val_width marks an overflow pointer record)
 
     def __len__(self) -> int:
         return len(self.ts)
@@ -313,6 +315,13 @@ class Engine:
         self._scan_windows: dict[int, int] = {}  # max_keys -> learned window
         self._mem_cache: tuple[int, mvcc.KVBlock] | None = None
         self._overlay_cache = None  # ((gen, mem len), merged view)
+        # variable-width value overflow heap (the WiscKey / pebble
+        # value-separation shape): values longer than the fixed inline
+        # slot live here, the slot stores an 8-byte offset pointer, and
+        # vlen > val_width is the overflow marker. Append-only; dead
+        # blobs are reclaimed only by checkpoint+reopen (value-log GC is
+        # out of scope, like pebble's is a separate subsystem).
+        self._blob = bytearray()
         # durable write-ahead log
         self.wal_path = wal_path
         self.wal_fsync = wal_fsync
@@ -427,6 +436,8 @@ class Engine:
                             rows = {f: z[f] for f in (
                                 "key", "ts", "seq", "txn", "tomb", "value",
                                 "vlen")}
+                            if "blob" in z.files:
+                                rows["blob"] = z["blob"]
                         except (FileNotFoundError, ValueError, OSError,
                                 KeyError, EOFError,
                                 __import__("zipfile").BadZipFile) as e:
@@ -487,8 +498,11 @@ class Engine:
             raise ValueError(f"key must not contain 0x00 bytes: {b!r}")
         if len(b) > self.key_width:
             raise ValueError(f"key too long ({len(b)} > {self.key_width})")
-        if len(v) > self.val_width:
-            raise ValueError(f"value too long ({len(v)} > {self.val_width})")
+        if len(v) > self.val_width and self.val_width < 8:
+            raise ValueError(
+                f"value of {len(v)} bytes needs the overflow heap, which "
+                f"requires val_width >= 8 (have {self.val_width})"
+            )
         from ..utils import metric
 
         metric.ENGINE_WRITES.inc()
@@ -507,12 +521,29 @@ class Engine:
             self._locks[b] = int(txn)
         else:
             self._newest_committed.put(b, ts)
+        n = len(v)
+        if n > self.val_width:
+            # overflow: payload to the heap, an offset pointer inline.
+            # Done HERE (not _append) so WAL replay — which logs the full
+            # value and re-runs this path — rebuilds the heap itself.
+            off = len(self._blob)
+            self._blob += v
+            v = off.to_bytes(8, "little")
         self.mem.keys.append(b)
         self.mem.ts.append(ts)
         self.mem.seq.append(seq)
         self.mem.txn.append(txn)
         self.mem.tomb.append(tomb)
         self.mem.value.append(v)
+        self.mem.vlen.append(n)
+
+    def _resolve_value(self, row: np.ndarray, n: int) -> bytes:
+        """Inline slot bytes + logical length -> the stored value (follows
+        the overflow pointer when n exceeds the inline width)."""
+        if n <= self.val_width:
+            return bytes(row[:n])
+        off = int.from_bytes(bytes(row[:8]), "little")
+        return bytes(self._blob[off:off + n])
 
     # -- flush / compaction -------------------------------------------------
 
@@ -524,10 +555,10 @@ class Engine:
         n = len(self.mem)
         keys = K.encode_keys(self.mem.keys, self.key_width)
         vals = np.zeros((n, self.val_width), dtype=np.uint8)
-        vlen = np.zeros((n,), dtype=np.int32)
+        vlen = np.asarray(self.mem.vlen, dtype=np.int32)
         for i, v in enumerate(self.mem.value):
-            vals[i, : len(v)] = np.frombuffer(v, dtype=np.uint8)
-            vlen[i] = len(v)
+            if len(v):
+                vals[i, : len(v)] = np.frombuffer(v, dtype=np.uint8)
         # sort on the HOST (canonical MVCC order: key asc, ts desc, seq
         # desc — _mvcc_sort_operands' ordering): a memtable is <=
         # memtable_size rows, so np.lexsort costs microseconds while the
@@ -983,7 +1014,8 @@ class Engine:
             ks = K.decode_keys(np.asarray(view.key)[idx])
             vals = np.asarray(view.value)[idx]
             vls = np.asarray(view.vlen)[idx]
-            return [(k, bytes(v[:n])) for k, v, n in zip(ks, vals, vls)]
+            return [(k, self._resolve_value(v, int(n)))
+                    for k, v, n in zip(ks, vals, vls)]
 
     @_locked
     def scan_batch(
@@ -1068,7 +1100,7 @@ class Engine:
                 k = min(int(counts[b]), max_keys)
                 ks = K.decode_keys(keys_np[b][:k])
                 out.append([
-                    (key, bytes(v[:n]))
+                    (key, self._resolve_value(v, int(n)))
                     for key, v, n in zip(ks, vals_np[b][:k], vlen_np[b][:k])
                 ])
             return out
@@ -1096,7 +1128,7 @@ class Engine:
             return None
         i = idx[0]
         n = int(np.asarray(view.vlen)[i])
-        return bytes(np.asarray(view.value)[i][:n])
+        return self._resolve_value(np.asarray(view.value)[i], n)
 
     # -- intents ------------------------------------------------------------
 
@@ -1189,6 +1221,7 @@ class Engine:
             "tomb": np.zeros((0,), np.bool_),
             "value": np.zeros((0, self.val_width), np.uint8),
             "vlen": np.zeros((0,), np.int32),
+            "blob": np.zeros((0,), np.uint8),
         }
         if view is None:
             return empty
@@ -1200,14 +1233,24 @@ class Engine:
         idx = np.nonzero(np.asarray(m))[0]
         if not len(idx):
             return empty
+        vals_np = np.asarray(view.value)[idx]
+        vlen_np = np.asarray(view.vlen)[idx]
         return {
             "key": np.asarray(view.key)[idx],
             "ts": np.asarray(view.ts)[idx],
             "seq": np.asarray(view.seq)[idx],
             "txn": np.asarray(view.txn)[idx],
             "tomb": np.asarray(view.tomb)[idx],
-            "value": np.asarray(view.value)[idx],
-            "vlen": np.asarray(view.vlen)[idx],
+            "value": vals_np,
+            "vlen": vlen_np,
+            # overflow payloads materialize into the export in row order
+            # (this heap's offsets are meaningless to the importing
+            # engine); import_rows re-homes them into its own heap by
+            # walking the same order
+            "blob": np.frombuffer(b"".join(
+                self._resolve_value(vals_np[i], int(vlen_np[i]))
+                for i in np.nonzero(vlen_np > self.val_width)[0]
+            ), dtype=np.uint8),
         }
 
     @_locked
@@ -1224,7 +1267,8 @@ class Engine:
             return
         if rows["key"].shape[1] != self.key_width:
             raise ValueError("imported keys do not match engine key width")
-        if rows["value"].shape[1] > self.val_width:
+        src_w = rows["value"].shape[1]
+        if src_w > self.val_width:
             raise ValueError("imported values wider than engine val width")
         cap = _pad(n)
 
@@ -1234,7 +1278,30 @@ class Engine:
             return out
 
         vb = np.zeros((cap, self.val_width), np.uint8)
-        vb[:n, : rows["value"].shape[1]] = rows["value"]
+        vb[:n, :src_w] = rows["value"]
+        # re-home exported overflow payloads (vlen > SOURCE inline width)
+        # — the exported pointer slots are meaningless here. A payload
+        # that fits THIS engine's inline width lands inline (a narrower
+        # source's overflow can be a wider target's inline row; storing a
+        # pointer there would be read back as inline bytes); bigger ones
+        # go to this engine's heap. The side file below persists the
+        # original rows + blob, so crash replay re-runs this re-homing.
+        vlen_in = np.asarray(rows["vlen"], np.int64)
+        if (vlen_in > src_w).any():
+            blob_b = bytes(np.asarray(rows["blob"], np.uint8).tobytes())
+            off = 0
+            for i in np.nonzero(vlen_in > src_w)[0]:
+                ln = int(vlen_in[i])
+                payload = blob_b[off:off + ln]
+                off += ln
+                vb[i] = 0
+                if ln <= self.val_width:
+                    vb[i, :ln] = np.frombuffer(payload, np.uint8)
+                else:
+                    ptr = len(self._blob)
+                    self._blob += payload
+                    vb[i, :8] = np.frombuffer(ptr.to_bytes(8, "little"),
+                                              np.uint8)
         seq = rows["seq"].astype(np.int64)
         self._seq = max(self._seq, int(seq.max()))
         if self._wal is not None and not self._replaying:
@@ -1249,7 +1316,9 @@ class Engine:
             with open(side, "wb") as f:
                 np.savez(f, key=rows["key"], ts=rows["ts"], seq=seq,
                          txn=rows["txn"], tomb=rows["tomb"],
-                         value=rows["value"], vlen=rows["vlen"])
+                         value=rows["value"], vlen=rows["vlen"],
+                         blob=np.asarray(rows.get(
+                             "blob", np.zeros(0, np.uint8)), np.uint8))
                 f.flush()
                 if self.wal_fsync:
                     os.fsync(f.fileno())
@@ -1364,6 +1433,13 @@ class Engine:
                 )
                 f.flush()
                 os.fsync(f.fileno())
+        if self._blob:
+            # runs reference the overflow heap by offset; a checkpoint
+            # without it would dangle every var-width value
+            with open(os.path.join(path, "blob.bin"), "wb") as f:
+                f.write(bytes(self._blob))
+                f.flush()
+                os.fsync(f.fileno())
         with open(os.path.join(path, "MANIFEST"), "w") as f:
             f.write(f"{len(self.runs)} {self.key_width} {self.val_width}\n")
             f.flush()
@@ -1395,6 +1471,10 @@ class Engine:
         wal_path = kwargs.pop("wal_path", None)
         eng = cls(key_width=kw, val_width=vw, **kwargs)
         assert eng._wal is None, "pass wal_path to open_checkpoint, not cls"
+        blob_path = os.path.join(path, "blob.bin")
+        if os.path.exists(blob_path):
+            with open(blob_path, "rb") as f:
+                eng._blob = bytearray(f.read())
         for i in range(nruns):
             z = np.load(os.path.join(path, f"run{i:04d}.npz"))
             eng.runs.append(
