@@ -248,6 +248,113 @@ def run_distributed_hosts(plan: S.PlanNode, catalog, host_addrs: list):
     return run_operator(final)
 
 
+# -- cross-host hash-repartitioned joins ------------------------------------
+#
+# The HashRouter-over-DCN step (colflow/routers.go:420 + colrpc): every
+# host scans its shard of BOTH join sides and hash-partitions rows to P
+# consumer streams; peer p joins partition p and streams the joined rows
+# to the gateway. Co-partitioning makes each partition's join exact.
+#
+# stream-id layout under one flow_id (execinfrapb StreamEndpointSpec):
+#   scatter probe h->p : 1000 + h*P + p
+#   scatter build h->p : 2000 + h*P + p
+#   joined partition p : 3000 + p
+
+
+def _sid_scatter(side: str, h: int, p: int, n: int) -> int:
+    return (1000 if side == "probe" else 2000) + h * n + p
+
+
+def _sid_join(p: int) -> int:
+    return 3000 + p
+
+
+def plan_host_join(plan: S.HashJoin, addrs: list, flow_id: str, catalog):
+    """Fragments for a hash-repartitioned cross-host join.
+
+    Returns (scatter_frags, join_frags): scatter_frags[h] is the
+    {stream_id: plan} dict to register on host h (2*P bucket streams over
+    its shards); join_frags[p] is host p's join fragment — a HashJoin
+    whose inputs are StreamUnions of RemoteStreams from every host."""
+    from ..plan.distribute import _schema_of
+
+    n = len(addrs)
+    if not isinstance(plan, S.HashJoin):
+        raise TypeError("plan_host_join covers HashJoin roots")
+    probe_schema = _schema_of(plan.probe, catalog)
+    build_schema = _schema_of(plan.build, catalog)
+    scatter_frags: list[dict[int, S.PlanNode]] = []
+    for h in range(n):
+        streams: dict[int, S.PlanNode] = {}
+        probe_shard = _shard_scans(plan.probe, h, n)
+        build_shard = _shard_scans(plan.build, h, n)
+        for p in range(n):
+            streams[_sid_scatter("probe", h, p, n)] = S.HashBucket(
+                probe_shard, plan.probe_keys, n, p)
+            streams[_sid_scatter("build", h, p, n)] = S.HashBucket(
+                build_shard, plan.build_keys, n, p)
+        scatter_frags.append(streams)
+    join_frags: list[S.PlanNode] = []
+    for p in range(n):
+        probe_in = S.StreamUnion(tuple(
+            S.RemoteStream(tuple(addrs[h]), flow_id,
+                           _sid_scatter("probe", h, p, n), probe_schema)
+            for h in range(n)))
+        build_in = S.StreamUnion(tuple(
+            S.RemoteStream(tuple(addrs[h]), flow_id,
+                           _sid_scatter("build", h, p, n), build_schema)
+            for h in range(n)))
+        join_frags.append(S.HashJoin(probe_in, build_in, plan.probe_keys,
+                                     plan.build_keys, plan.spec))
+    return scatter_frags, join_frags
+
+
+def run_distributed_join(plan: S.HashJoin, catalog, host_addrs: list):
+    """Gateway execution of a hash-repartitioned cross-host join.
+
+    Setup order matters: every scatter fragment registers before any join
+    fragment's streams attach (the registry's stream-wait covers races).
+    The gateway unions the P joined-partition streams."""
+    from ..flow import operators as ops
+    from ..plan import builder as plan_builder
+    from .runtime import run_operator
+
+    flow_id = uuid.uuid4().hex[:12]
+    scatter_frags, join_frags = plan_host_join(
+        plan, host_addrs, flow_id, catalog)
+    for addr, streams in zip(host_addrs, scatter_frags):
+        setup_flow(addr, flow_id, streams)
+    # learn the joined schema without initializing (RemoteStream attaches
+    # only at init)
+    out_schema = plan_builder.build(join_frags[0], catalog).output_schema
+    for p, addr in enumerate(host_addrs):
+        setup_flow(addr, flow_id, {_sid_join(p): join_frags[p]})
+    inboxes = [
+        attach_stream(addr, flow_id, _sid_join(p), out_schema)
+        for p, addr in enumerate(host_addrs)
+    ]
+    sync = ops.ParallelUnorderedSyncOp(tuple(inboxes))
+    return run_operator(sync)
+
+
+def explain_host_join(plan: S.HashJoin, n_hosts: int) -> list[str]:
+    """EXPLAIN (DISTSQL) lines for the repartitioned join stages."""
+    out = []
+    for h in range(n_hosts):
+        out.append(
+            f"host {h}: scan shard {h}/{n_hosts} of both sides, "
+            f"hash-repartition into {n_hosts} bucket streams per side "
+            f"(HashRouter over DCN)"
+        )
+    for p in range(n_hosts):
+        out.append(
+            f"host {p}: join partition {p} over {n_hosts} probe + "
+            f"{n_hosts} build inbound streams"
+        )
+    out.append(f"gateway: union {n_hosts} joined-partition streams")
+    return out
+
+
 def explain_hosts(plan: S.PlanNode, n_hosts: int) -> list[str]:
     """EXPLAIN (DISTSQL) lines for the cross-host stages."""
     frags, (group_cols, aggs) = plan_host_fragments(plan, n_hosts)

@@ -137,7 +137,32 @@ def enc_plan(p: S.PlanNode) -> dict:
                 "group_cols": list(p.group_cols),
                 "aggs": [[a.func, a.col, a.name] for a in p.aggs],
                 "mode": p.mode}
+    if isinstance(p, S.HashBucket):
+        return {"k": "bucket", "in": enc_plan(p.input),
+                "keys": list(p.keys), "n_parts": p.n_parts, "part": p.part}
+    if isinstance(p, S.RemoteStream):
+        return {"k": "remote", "addr": list(p.addr), "flow_id": p.flow_id,
+                "stream_id": p.stream_id, "schema": enc_schema(p.schema)}
+    if isinstance(p, S.StreamUnion):
+        return {"k": "stream_union",
+                "inputs": [enc_plan(x) for x in p.inputs]}
+    if isinstance(p, S.HashJoin):
+        return {"k": "hash_join", "probe": enc_plan(p.probe),
+                "build": enc_plan(p.build),
+                "probe_keys": list(p.probe_keys),
+                "build_keys": list(p.build_keys),
+                "join_type": p.spec.join_type,
+                "build_unique": p.spec.build_unique}
     raise TypeError(f"unshippable plan node {type(p).__name__}")
+
+
+def enc_schema(s: T.Schema) -> dict:
+    return {"names": list(s.names), "types": [_enc_type(t) for t in s.types]}
+
+
+def dec_schema(d: dict) -> T.Schema:
+    return T.Schema(tuple(d["names"]),
+                    tuple(_dec_type(t) for t in d["types"]))
 
 
 def dec_plan(d: dict) -> S.PlanNode:
@@ -159,5 +184,21 @@ def dec_plan(d: dict) -> S.PlanNode:
             dec_plan(d["in"]), tuple(d["group_cols"]),
             tuple(AggSpec(f, c, n) for f, c, n in d["aggs"]),
             mode=d["mode"],
+        )
+    if k == "bucket":
+        return S.HashBucket(dec_plan(d["in"]), tuple(d["keys"]),
+                            d["n_parts"], d["part"])
+    if k == "remote":
+        return S.RemoteStream(tuple(d["addr"]), d["flow_id"],
+                              d["stream_id"], dec_schema(d["schema"]))
+    if k == "stream_union":
+        return S.StreamUnion(tuple(dec_plan(x) for x in d["inputs"]))
+    if k == "hash_join":
+        from ..ops.join import JoinSpec
+
+        return S.HashJoin(
+            dec_plan(d["probe"]), dec_plan(d["build"]),
+            tuple(d["probe_keys"]), tuple(d["build_keys"]),
+            JoinSpec(d["join_type"], d["build_unique"]),
         )
     raise TypeError(f"unknown plan kind {k}")

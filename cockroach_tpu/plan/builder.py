@@ -121,6 +121,15 @@ def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
             catalog.get(plan.table), plan.index, plan.lo, plan.hi,
             plan.columns,
         )
+    if isinstance(plan, S.HashBucket):
+        return ops.HashBucketOp(build(plan.input, catalog), plan.keys,
+                                plan.n_parts, plan.part)
+    if isinstance(plan, S.RemoteStream):
+        return ops.RemoteStreamOp(plan.addr, plan.flow_id, plan.stream_id,
+                                  plan.schema)
+    if isinstance(plan, S.StreamUnion):
+        return ops.ParallelUnorderedSyncOp(
+            tuple(build(p, catalog) for p in plan.inputs))
     if isinstance(plan, S.Filter):
         return ops.FilterOp(build(plan.input, catalog), plan.predicate)
     if isinstance(plan, S.Project):

@@ -231,6 +231,16 @@ def _schema_of(plan: S.PlanNode, catalog: Catalog):
         return win_ops.window_output_schema(
             _schema_of(plan.input, catalog), plan.specs
         )
+    if isinstance(plan, S.HashBucket):
+        return _schema_of(plan.input, catalog)
+    if isinstance(plan, S.RemoteStream):
+        return plan.schema
+    if isinstance(plan, S.StreamUnion):
+        return _schema_of(plan.inputs[0], catalog)
+    if isinstance(plan, S.IndexScan):
+        t = catalog.get(plan.table)
+        names = plan.columns or t.schema.names
+        return t.schema.select(tuple(t.schema.index(n) for n in names))
     raise TypeError(f"no schema rule for {type(plan).__name__}")
 
 

@@ -253,6 +253,10 @@ class Source:
     # base-table cardinality, captured before filter pushdown (join ordering
     # still sees the true relative sizes); subqueries get a large default
     base_rows: int = 1 << 30
+    # post-pushdown cardinality estimate from ANALYZE histograms
+    # (statistics_builder.go selectivity role); None = no estimate, join
+    # ordering falls back to base_rows
+    est_rows: int | None = None
     # base-table provenance (None for subquery sources); lets bind-time
     # checks prove column non-nullability from the catalog's valid bitmaps
     table: str | None = None
@@ -802,6 +806,7 @@ class Binder:
             for p in preds:
                 s.rel = s.rel.filter(self._lower_with_subqueries(lower, p))
                 lower = ExprLowerer(s.rel)
+            s.est_rows = self._estimate_source_rows(s, preds)
 
         # greedy join order: start at the largest source
         joined = self._join_sources(sources, equi_edges, scope)
@@ -1016,13 +1021,95 @@ class Binder:
 
     # -- join planning ------------------------------------------------------
 
+    # -- cardinality estimation (statistics_builder.go reduction) -----------
+
+    def _source_stats(self, s: "Source"):
+        if s.table is None:
+            return None
+        return getattr(self.catalog.get(s.table), "table_stats", None)
+
+    def _estimate_source_rows(self, s: "Source", preds) -> int | None:
+        """base_rows x the product of per-conjunct selectivities estimated
+        from ANALYZE histograms (independence assumption, like the
+        reference). None when the base table has no statistics."""
+        st = self._source_stats(s)
+        if st is None:
+            return None
+        frac = 1.0
+        for p in preds:
+            frac *= self._pred_fraction(st, p, s)
+        return max(1, int(round(st.row_count * frac)))
+
+    _DEFAULT_PRED_FRAC = 1.0 / 3.0  # unestimatable conjunct (reference's
+    # unknown-selectivity constant is also 1/3, memo/statistics_builder.go)
+
+    def _pred_fraction(self, st, p: P.Node, s: "Source") -> float:
+        if isinstance(p, P.Cmp) and p.op in ("lt", "le", "gt", "ge", "eq"):
+            col, lit, op = None, None, p.op
+            if isinstance(p.left, P.Ident):
+                col, lit = p.left, p.right
+            elif isinstance(p.right, P.Ident):
+                col, lit = p.right, p.left
+                flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                        "eq": "eq"}
+                op = flip[op]
+            if col is not None and col.name in st.cols:
+                v = self._literal_for_stats(lit, col.name, s)
+                if v is not None:
+                    return st.cols[col.name].cmp_fraction(op, v)
+        if isinstance(p, P.Between) and isinstance(p.arg, P.Ident) \
+                and p.arg.name in st.cols:
+            lo = self._literal_for_stats(p.lo, p.arg.name, s)
+            hi = self._literal_for_stats(p.hi, p.arg.name, s)
+            if lo is not None and hi is not None:
+                cs = st.cols[p.arg.name]
+                f = max(0.0, cs.frac_le(hi) - cs.frac_le(lo - 1))
+                return 1.0 - f if p.negated else f
+        return self._DEFAULT_PRED_FRAC
+
+    def _literal_for_stats(self, e: P.Node, col: str, s: "Source"):
+        """Literal -> the RAW statistics domain (scaled DECIMALs, day
+        counts) for column `col`, or None if not a literal."""
+        from .session import NotALiteral, Session
+
+        try:
+            t = s.rel.type_of(col)
+        except (KeyError, ValueError):
+            return None
+        try:
+            v = Session._literal(_fold(e), t)
+        except (NotALiteral, BindError):
+            return None
+        if v is None or isinstance(v, str):
+            return None
+        return int(v) if not isinstance(v, float) else int(round(v))
+
+    def _col_ndv(self, s: "Source", pos: int, est: float) -> float:
+        st = self._source_stats(s)
+        if st is not None and pos < len(s.rel.schema.names):
+            cs = st.cols.get(s.rel.schema.names[pos])
+            if cs is not None and cs.ndv > 0:
+                # a filtered source cannot have more distinct keys than rows
+                return float(min(cs.ndv, max(1.0, est)))
+        return max(1.0, est)  # unknown: assume keys ~unique (FK shape)
+
     def _join_sources(self, sources, equi_edges, scope) -> "BoundQuery":
         n = len(sources)
         if n == 1:
             colmap = {(0, p): p
                       for p in range(len(sources[0].rel.schema))}
             return BoundQuery(sources[0].rel, {0: sources[0]}, colmap)
-        sizes = [s.base_rows for s in sources]
+        sizes = [
+            s.est_rows if s.est_rows is not None else s.base_rows
+            for s in sources
+        ]
+        from ..utils import settings as _settings
+
+        if (_settings.get("sql.opt.join_order") == "cost"
+                and 2 <= n <= 6):
+            tree = self._dp_join_order(sources, equi_edges, sizes)
+            if tree is not None:
+                return self._build_join_tree(tree, sources, equi_edges)
         start = max(range(n), key=lambda i: sizes[i])
         placed = {start}
         rel = sources[start].rel

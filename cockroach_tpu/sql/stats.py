@@ -29,12 +29,61 @@ from dataclasses import dataclass, field
 import numpy as np
 
 
+HIST_BUCKETS = 32
+
+
 @dataclass
 class ColumnStat:
     lo: int | None = None  # min over non-NULL rows (int-represented cols)
     hi: int | None = None
     ndv: int = 0  # distinct non-NULL values
     null_count: int = 0
+    # equi-depth histogram (statistics_builder.go's histogram role):
+    # hist_bounds[i] is the UPPER bound (inclusive) of bucket i, ascending;
+    # hist_counts[i] is that bucket's row count
+    hist_bounds: list | None = None
+    hist_counts: list | None = None
+
+    def frac_le(self, v: int) -> float:
+        """Estimated fraction of non-NULL rows with value <= v."""
+        if self.lo is None or self.hi is None:
+            return 0.5
+        if v < self.lo:
+            return 0.0
+        if v >= self.hi:
+            return 1.0
+        if self.hist_bounds:
+            total = sum(self.hist_counts)
+            acc = 0.0
+            prev_hi = self.lo - 1
+            for b, c in zip(self.hist_bounds, self.hist_counts):
+                if v >= b:
+                    acc += c
+                    prev_hi = b
+                else:
+                    # linear interpolation inside the bucket
+                    width = max(1, b - prev_hi)
+                    acc += c * min(1.0, max(0.0, (v - prev_hi) / width))
+                    break
+            return min(1.0, acc / max(1, total))
+        return (v - self.lo + 1) / max(1, self.hi - self.lo + 1)
+
+    def cmp_fraction(self, op: str, v: int) -> float:
+        """Estimated selected fraction for `col <op> v` (eq lt le gt ge),
+        over non-NULL rows — the statistics_builder selectivity role."""
+        if op == "eq":
+            if self.lo is not None and not self.lo <= v <= self.hi:
+                return 0.0
+            return 1.0 / max(1, self.ndv)
+        if op == "le":
+            return self.frac_le(v)
+        if op == "lt":
+            return self.frac_le(v - 1)
+        if op == "ge":
+            return 1.0 - self.frac_le(v - 1)
+        if op == "gt":
+            return 1.0 - self.frac_le(v)
+        return 1.0
 
 
 @dataclass
@@ -51,12 +100,16 @@ class TableStats:
                 n: [c.lo, c.hi, c.ndv, c.null_count]
                 for n, c in self.cols.items()
             },
+            "hists": {
+                n: [c.hist_bounds, c.hist_counts]
+                for n, c in self.cols.items() if c.hist_bounds
+            },
         }, separators=(",", ":"))
 
     @staticmethod
     def from_json(s: str) -> "TableStats":
         d = json.loads(s)
-        return TableStats(
+        st = TableStats(
             row_count=d["row_count"],
             created_unix=d.get("created_unix", 0.0),
             cols={
@@ -64,6 +117,32 @@ class TableStats:
                 for n, (lo, hi, ndv, nc) in d["cols"].items()
             },
         )
+        for n, (bounds, counts) in d.get("hists", {}).items():
+            st.cols[n].hist_bounds = bounds
+            st.cols[n].hist_counts = counts
+        return st
+
+
+def _equi_depth_hist(live: np.ndarray) -> tuple[list, list]:
+    """Equi-depth histogram over sorted int values: ~HIST_BUCKETS buckets,
+    each holding ~n/HIST_BUCKETS rows; bounds are inclusive upper edges."""
+    v = np.sort(live.astype(np.int64))
+    n = len(v)
+    per = max(1, n // HIST_BUCKETS)
+    bounds: list[int] = []
+    counts: list[int] = []
+    start = 0
+    while start < n:
+        end = min(n, start + per)
+        b = int(v[end - 1])
+        # a bucket must end at a value boundary or equal values straddle
+        # buckets and frac_le double-counts
+        while end < n and int(v[end]) == b:
+            end += 1
+        bounds.append(b)
+        counts.append(end - start)
+        start = end
+    return bounds, counts
 
 
 def analyze_table(table) -> TableStats:
@@ -120,6 +199,8 @@ def analyze_table(table) -> TableStats:
                     and np.issubdtype(live.dtype, np.integer)):
                 cs.lo = int(live.min())
                 cs.hi = int(live.max())
+                if cs.ndv > 1:
+                    cs.hist_bounds, cs.hist_counts = _equi_depth_hist(live)
         st.cols[name] = cs
     return st
 
