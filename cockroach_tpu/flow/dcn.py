@@ -119,6 +119,12 @@ class FlowInbox(SourceOperator):
         payload = _recv_msg(self.sock)
         if payload is None:
             self._done = True
+            # a drained stream's socket is dead weight: close it HERE so
+            # fd censuses don't depend on when the inbox gets collected
+            try:
+                self.sock.close()
+            except OSError:
+                pass
             return None
         b, schema, dicts = _decode_batch(payload)
         # remote dictionaries override (codes are stream-relative)

@@ -11,11 +11,19 @@ connections attach to registered flows by (flow_id, stream_id). Here:
   registry; a FLOW_STREAM request attaches to one (flow_id, stream_id)
   and streams its batches back (Arrow IPC framing from flow/dcn.py).
   Either arrival order works — streams wait for their setup briefly, the
-  registry's ConnectInboundStream timeout discipline.
+  registry's ConnectInboundStream timeout discipline. A CANCEL_FLOW
+  request tears down every registered entry of a flow (the gateway's
+  CancelDeadFlows reduction) and poisons the flow id so late setups and
+  stream-waits for it fail instead of lingering to TTL expiry.
 - ``run_distributed_hosts`` is the gateway half (DistSQLPlanner.PlanAndRun
   reduction): split an aggregation plan into per-host partial fragments
   over table shards, SetupFlow each, attach the streams, and run the
-  final aggregation locally over the inboxes' union.
+  final aggregation locally over the inboxes' union. Both gateway
+  runners execute under an end-to-end flow deadline
+  (sql.distsql.flow_deadline_s): the first fragment failure cancels the
+  flow on every reachable host and the query DEGRADES — re-planned onto
+  the surviving hosts, or run single-host locally when none survive
+  (distsql_degraded_queries counts these; EXPLAIN surfaces the policy).
 
 The in-process SPMD mesh (parallel/planner.py) remains the intra-slice
 plane; this module is the ACROSS-hosts plane stacked above it.
@@ -31,36 +39,58 @@ import uuid
 
 from ..coldata.types import Schema
 from ..plan import spec as S
+from ..utils import faults, metric, retry
+from ..utils.faults import InjectedFault
 from . import wire
 from .dcn import FlowInbox, FlowOutbox, _recv_msg, _send_msg
 from .operator import Operator
 
 
 class HostFlowServer:
-    """SetupFlow + FlowStream service over one listening socket."""
+    """SetupFlow + FlowStream + CancelFlow service over one socket."""
 
     def __init__(self, catalog, host: str = "127.0.0.1", port: int = 0,
                  stream_wait_s: float = 10.0, flow_ttl_s: float = 60.0):
         self.catalog = catalog
+        # SO_REUSEADDR so back-to-back restarts rebind the port while the
+        # previous incarnation's conns sit in TIME_WAIT
         self._srv = socket.create_server((host, port))
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.addr = self._srv.getsockname()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._handlers: list[threading.Thread] = []
         # the flow registry: (flow_id, stream_id) -> (operator, expiry)
         # waiting for its stream connection (flow_registry.go:164); flows
         # no stream attaches to within flow_ttl_s are purged
         self._registry: dict[tuple[str, int], tuple[Operator, float]] = {}
+        # flow_id -> poison expiry: cancelled flows reject late setups and
+        # wake stream-waiters immediately instead of timing out
+        self._cancelled: dict[str, float] = {}
         self._reg_lock = threading.Condition()
         self.stream_wait_s = stream_wait_s
         self.flow_ttl_s = flow_ttl_s
 
+    def registry_size(self) -> int:
+        """Live registered streams (leak checks in chaos tests)."""
+        with self._reg_lock:
+            self._purge_expired_locked()
+            return len(self._registry)
+
     def serve_background(self) -> "HostFlowServer":
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="host-flow-server")
         self._thread.start()
         return self
 
     def _serve(self) -> None:
-        self._srv.settimeout(0.2)
+        try:
+            self._srv.settimeout(0.2)
+        except OSError:
+            return  # close() raced serve_background
+
         while not self._stop.is_set():
             try:
                 conn, _ = self._srv.accept()
@@ -68,9 +98,15 @@ class HostFlowServer:
                 continue
             except OSError:
                 return  # close() raced the accept
-            threading.Thread(
-                target=self._handle, args=(conn,), daemon=True
-            ).start()
+            with self._conns_lock:
+                if self._stop.is_set():
+                    conn.close()
+                    return
+                self._conns.add(conn)
+                t = threading.Thread(target=self._handle, args=(conn,),
+                                     daemon=True)
+                self._handlers.append(t)
+            t.start()
 
     def _handle(self, conn: socket.socket) -> None:
         from ..utils import log
@@ -84,6 +120,12 @@ class HostFlowServer:
             if op == "setup_flow":
                 try:
                     self._setup_flow(req)
+                except InjectedFault as e:
+                    if e.kind == "drop":
+                        raise  # sever: the gateway sees a dead host
+                    _send_msg(conn, json.dumps({
+                        "error": str(e)}).encode("utf-8"))
+                    return
                 except Exception as e:
                     # the gateway must learn WHY its fragment was rejected
                     # (unknown table, undecodable spec), not just see a
@@ -95,6 +137,8 @@ class HostFlowServer:
                 _send_msg(conn, b'{"ok": true}')
             elif op == "flow_stream":
                 self._flow_stream(conn, req)
+            elif op == "cancel_flow":
+                self._cancel_flow(conn, req)
             else:
                 _send_msg(conn, b'{"error": "unknown op"}')
         except Exception as e:
@@ -102,10 +146,13 @@ class HostFlowServer:
                         error=f"{type(e).__name__}: {e}")
         finally:
             conn.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def _setup_flow(self, req: dict) -> None:
         from ..plan import builder as plan_builder
 
+        faults.fire("flow.host.setup")
         flow_id = str(req["flow_id"])
         # build EVERY stream before registering ANY: a failure mid-request
         # must not leave half a flow in the registry
@@ -117,6 +164,10 @@ class HostFlowServer:
         deadline = time.time() + self.flow_ttl_s
         with self._reg_lock:
             self._purge_expired_locked()
+            if flow_id in self._cancelled:
+                # the gateway already gave up on this flow: registering now
+                # would pin operators nothing will ever drain
+                raise RuntimeError(f"flow {flow_id} was cancelled")
             for key, op in built.items():
                 self._registry[key] = (op, deadline)
             self._reg_lock.notify_all()
@@ -124,17 +175,24 @@ class HostFlowServer:
     def _purge_expired_locked(self) -> None:
         """Drop flows no stream ever attached to (a crashed gateway must
         not pin operators forever — flow_registry.go's timeout on the
-        setup side)."""
+        setup side), and expire cancellation poison entries so a reused
+        flow id eventually works again."""
         now = time.time()
         for key in [k for k, (_, dl) in self._registry.items() if dl < now]:
             del self._registry[key]
+        for fid in [f for f, dl in self._cancelled.items() if dl < now]:
+            del self._cancelled[fid]
 
     def _flow_stream(self, conn: socket.socket, req: dict) -> None:
+        faults.fire("flow.host.stream")
         key = (str(req["flow_id"]), int(req["stream_id"]))
         deadline = time.time() + self.stream_wait_s
         with self._reg_lock:
             self._purge_expired_locked()
             while key not in self._registry:
+                if key[0] in self._cancelled:
+                    _send_msg(conn, b'{"error": "flow cancelled"}')
+                    return
                 left = deadline - time.time()
                 if left <= 0:
                     _send_msg(conn, b'{"error": "no such flow"}')
@@ -144,40 +202,176 @@ class HostFlowServer:
         _send_msg(conn, b'{"ok": true}')
         FlowOutbox(op, conn).run()
 
+    def _cancel_flow(self, conn: socket.socket, req: dict) -> None:
+        flow_id = str(req["flow_id"])
+        with self._reg_lock:
+            self._purge_expired_locked()
+            doomed = [k for k in self._registry if k[0] == flow_id]
+            for k in doomed:
+                del self._registry[k]
+            self._cancelled[flow_id] = time.time() + self.flow_ttl_s
+            # wake stream-waiters parked on this flow so they fail NOW
+            self._reg_lock.notify_all()
+        _send_msg(conn, json.dumps(
+            {"ok": True, "removed": len(doomed)}).encode("utf-8"))
+
     def close(self) -> None:
+        """Idempotent full teardown: stop accepting, sever accepted conns
+        (unblocking handlers parked in recv or mid-stream), join the
+        accept + handler threads, drop the registry. A closed server
+        holds no port, no fd, and no thread."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
         self._srv.close()
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+            handlers = list(self._handlers)
+            self._handlers.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if (self._thread is not None
+                and self._thread is not threading.current_thread()):
+            self._thread.join(timeout=5)
+        for t in handlers:
+            if t is not threading.current_thread():
+                t.join(timeout=5)
+        with self._reg_lock:
+            self._registry.clear()
+            self._cancelled.clear()
+            self._reg_lock.notify_all()
+
+
+def _rpc_timeout_s() -> float:
+    from ..utils import settings
+
+    return settings.get("rpc.batch.deadline_s")
 
 
 def setup_flow(addr, flow_id: str, streams: dict[int, S.PlanNode]) -> None:
-    """Ship plan fragments to a host's registry (SetupFlowRequest)."""
-    sock = socket.create_connection(tuple(addr))
-    try:
-        _send_msg(sock, json.dumps({
-            "op": "setup_flow", "flow_id": flow_id,
-            "streams": {sid: wire.enc_plan(p) for sid, p in streams.items()},
-        }).encode("utf-8"))
-        resp = json.loads(_recv_msg(sock).decode("utf-8"))
-        if not resp.get("ok"):
-            raise RuntimeError(f"setup_flow rejected: {resp}")
-    finally:
-        sock.close()
+    """Ship plan fragments to a host's registry (SetupFlowRequest).
+
+    Transport failures retry with backoff under the RPC deadline —
+    re-registering the same (flow_id, stream_id) keys is idempotent
+    (the registry overwrites). Typed rejections surface immediately."""
+    payload = json.dumps({
+        "op": "setup_flow", "flow_id": flow_id,
+        "streams": {sid: wire.enc_plan(p) for sid, p in streams.items()},
+    }).encode("utf-8")
+
+    def once():
+        sock = socket.create_connection(tuple(addr),
+                                        timeout=_rpc_timeout_s())
+        try:
+            _send_msg(sock, payload)
+            msg = _recv_msg(sock)
+            if msg is None:
+                raise ConnectionError(f"setup_flow: {addr} severed stream")
+            resp = json.loads(msg.decode("utf-8"))
+            if not resp.get("ok"):
+                raise RuntimeError(f"setup_flow rejected: {resp}")
+        finally:
+            sock.close()
+
+    retry.call(once, retry.Backoff(max_attempts=3),
+               retryable=_transport_error)
 
 
 def attach_stream(addr, flow_id: str, stream_id: int,
                   schema: Schema) -> FlowInbox:
-    """Attach to a registered flow's stream (FlowStream RPC)."""
-    sock = socket.create_connection(tuple(addr))
-    _send_msg(sock, json.dumps({
-        "op": "flow_stream", "flow_id": flow_id, "stream_id": stream_id,
-    }).encode("utf-8"))
-    resp = json.loads(_recv_msg(sock).decode("utf-8"))
-    if not resp.get("ok"):
+    """Attach to a registered flow's stream (FlowStream RPC). The
+    handshake retries past transport failures; the returned inbox socket
+    keeps its read timeout so a wedged host surfaces as socket.timeout
+    in the puller instead of hanging the query forever."""
+
+    def once():
+        sock = socket.create_connection(tuple(addr),
+                                        timeout=_rpc_timeout_s())
+        try:
+            _send_msg(sock, json.dumps({
+                "op": "flow_stream", "flow_id": flow_id,
+                "stream_id": stream_id,
+            }).encode("utf-8"))
+            msg = _recv_msg(sock)
+            if msg is None:
+                raise ConnectionError(f"flow_stream: {addr} severed stream")
+            resp = json.loads(msg.decode("utf-8"))
+            if not resp.get("ok"):
+                raise RuntimeError(f"flow_stream rejected: {resp}")
+        except BaseException:
+            sock.close()
+            raise
+        return FlowInbox(sock, schema)
+
+    return retry.call(once, retry.Backoff(max_attempts=3),
+                      retryable=_transport_error)
+
+
+def cancel_flow(addr, flow_id: str) -> int:
+    """Tear down every registered entry of flow_id on one host (the
+    CancelDeadFlows RPC reduction). Best-effort single attempt — the
+    host may be the one that died. Returns entries removed (0 when the
+    host is unreachable)."""
+    try:
+        sock = socket.create_connection(tuple(addr), timeout=1.0)
+    except OSError:
+        return 0
+    try:
+        _send_msg(sock, json.dumps(
+            {"op": "cancel_flow", "flow_id": flow_id}).encode("utf-8"))
+        msg = _recv_msg(sock)
+        if msg is None:
+            return 0
+        resp = json.loads(msg.decode("utf-8"))
+        removed = int(resp.get("removed", 0))
+        if removed:
+            metric.DIST_FLOWS_CANCELLED.inc(removed)
+        return removed
+    except (OSError, ConnectionError, ValueError):
+        return 0
+    finally:
         sock.close()
-        raise RuntimeError(f"flow_stream rejected: {resp}")
-    return FlowInbox(sock, schema)
+
+
+def _transport_error(e: BaseException) -> bool:
+    """Wire-level failures only; typed rejections (RuntimeError) surface."""
+    return isinstance(e, (ConnectionError, socket.timeout, TimeoutError,
+                          OSError))
+
+
+def probe_host(addr, timeout_s: float = 0.5) -> bool:
+    """Is anything listening at addr? (the gateway's liveness check when
+    deciding which hosts survive a mid-flow failure)."""
+    try:
+        sock = socket.create_connection(tuple(addr), timeout=timeout_s)
+    except OSError:
+        return False
+    sock.close()
+    return True
+
+
+def _retryable_failure(e: BaseException | None) -> bool:
+    """Walk the cause chain: did this query die of a TRANSIENT distributed
+    failure (drop/timeout/injected fault) rather than a planning or data
+    error? QueryError wraps the operator failure with __cause__ intact."""
+    seen: set[int] = set()
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        if retry.is_retryable(e):
+            return True
+        e = e.__cause__ if e.__cause__ is not None else e.__context__
+    return False
+
+
+def _cancel_everywhere(host_addrs: list, flow_id: str) -> None:
+    for addr in host_addrs:
+        cancel_flow(addr, flow_id)
 
 
 def plan_host_fragments(plan: S.PlanNode, n_hosts: int):
@@ -216,12 +410,49 @@ def _shard_scans(p: S.PlanNode, i: int, n: int) -> S.PlanNode:
     )
 
 
-def run_distributed_hosts(plan: S.PlanNode, catalog, host_addrs: list):
+def run_distributed_hosts(plan: S.PlanNode, catalog, host_addrs: list,
+                          deadline_s: float | None = None):
     """Gateway execution: one partial fragment per host, final agg here.
 
     The fragment count equals the host count; stream ids are 0..n-1 under
-    one fresh flow id (the FlowID/StreamID pairing of api.proto)."""
-    from ..coldata.batch import to_host
+    one fresh flow id (the FlowID/StreamID pairing of api.proto). Runs
+    under the flow deadline with cancel-on-failure + degradation: a
+    transient fragment failure cancels the flow everywhere, probes which
+    hosts still answer, and re-plans onto the survivors — or runs the
+    whole plan locally when none do."""
+    from ..utils import log, settings
+
+    if deadline_s is None:
+        deadline_s = settings.get("sql.distsql.flow_deadline_s")
+    try:
+        return _run_hosts_once(plan, catalog, host_addrs, deadline_s)
+    except Exception as e:
+        if not _retryable_failure(e):
+            raise
+        survivors = [a for a in host_addrs if probe_host(a)]
+        metric.DIST_DEGRADED.inc()
+        if survivors and len(survivors) < len(host_addrs):
+            log.warning(log.OPS, "distributed agg degraded to survivors",
+                        hosts=len(host_addrs), survivors=len(survivors),
+                        error=f"{type(e).__name__}: {e}")
+            return _run_hosts_once(plan, catalog, survivors, deadline_s)
+        # every host still answers (a transient blip we already retried
+        # through) or none do: the local plan is the only safe harbor
+        log.warning(log.OPS, "distributed agg degraded to local execution",
+                    hosts=len(host_addrs),
+                    error=f"{type(e).__name__}: {e}")
+        return _run_local(plan, catalog)
+
+
+def _run_local(plan: S.PlanNode, catalog):
+    from ..plan import builder as plan_builder
+    from .runtime import run_operator
+
+    return run_operator(plan_builder.build(plan, catalog))
+
+
+def _run_hosts_once(plan: S.PlanNode, catalog, host_addrs: list,
+                    deadline_s: float):
     from ..flow import operators as ops
     from ..plan import builder as plan_builder
     from .runtime import run_operator
@@ -234,18 +465,31 @@ def run_distributed_hosts(plan: S.PlanNode, catalog, host_addrs: list):
     state_schema = probe_op.output_schema
     base_schema = plan_builder.build(plan.input, catalog).output_schema
 
-    for i, (addr, frag) in enumerate(zip(host_addrs, frags)):
-        setup_flow(addr, flow_id, {i: frag})
-    inboxes = [
-        attach_stream(addr, flow_id, i, state_schema)
-        for i, addr in enumerate(host_addrs)
-    ]
-    # unordered fan-in with one puller thread per host: remote hosts
-    # stream concurrently instead of draining one at a time
-    sync = ops.ParallelUnorderedSyncOp(tuple(inboxes))
-    final = ops.AggregateOp(sync, group_cols, aggs, mode="final",
-                            input_schema=base_schema)
-    return run_operator(final)
+    inboxes: list[FlowInbox] = []
+    try:
+        for i, (addr, frag) in enumerate(zip(host_addrs, frags)):
+            setup_flow(addr, flow_id, {i: frag})
+        for i, addr in enumerate(host_addrs):
+            inbox = attach_stream(addr, flow_id, i, state_schema)
+            inbox.sock.settimeout(deadline_s)
+            inboxes.append(inbox)
+        # unordered fan-in with one puller thread per host: remote hosts
+        # stream concurrently instead of draining one at a time
+        sync = ops.ParallelUnorderedSyncOp(tuple(inboxes))
+        final = ops.AggregateOp(sync, group_cols, aggs, mode="final",
+                                input_schema=base_schema)
+        return run_operator(final)
+    except Exception:
+        # first fragment failure: tear down the whole flow — no remote
+        # registry entry may outlive the query it belonged to
+        _cancel_everywhere(host_addrs, flow_id)
+        raise
+    finally:
+        for inbox in inboxes:
+            try:
+                inbox.sock.close()
+            except OSError:
+                pass
 
 
 # -- cross-host hash-repartitioned joins ------------------------------------
@@ -276,13 +520,13 @@ def plan_host_join(plan: S.HashJoin, addrs: list, flow_id: str, catalog):
     {stream_id: plan} dict to register on host h (2*P bucket streams over
     its shards); join_frags[p] is host p's join fragment — a HashJoin
     whose inputs are StreamUnions of RemoteStreams from every host."""
-    from ..plan.distribute import _schema_of
+    from ..plan.distribute import schema_of
 
     n = len(addrs)
     if not isinstance(plan, S.HashJoin):
         raise TypeError("plan_host_join covers HashJoin roots")
-    probe_schema = _schema_of(plan.probe, catalog)
-    build_schema = _schema_of(plan.build, catalog)
+    probe_schema = schema_of(plan.probe, catalog)
+    build_schema = schema_of(plan.build, catalog)
     scatter_frags: list[dict[int, S.PlanNode]] = []
     for h in range(n):
         streams: dict[int, S.PlanNode] = {}
@@ -309,12 +553,41 @@ def plan_host_join(plan: S.HashJoin, addrs: list, flow_id: str, catalog):
     return scatter_frags, join_frags
 
 
-def run_distributed_join(plan: S.HashJoin, catalog, host_addrs: list):
-    """Gateway execution of a hash-repartitioned cross-host join.
+def run_distributed_join(plan: S.HashJoin, catalog, host_addrs: list,
+                         deadline_s: float | None = None):
+    """Gateway execution of a hash-repartitioned cross-host join, under
+    the same deadline + cancel + degradation discipline as
+    run_distributed_hosts: a transient failure cancels the flow on every
+    reachable host, then the join re-plans onto the surviving hosts (the
+    shard/bucket layout re-derives from the new host count) or falls
+    back to local single-host execution."""
+    from ..utils import log, settings
 
-    Setup order matters: every scatter fragment registers before any join
-    fragment's streams attach (the registry's stream-wait covers races).
-    The gateway unions the P joined-partition streams."""
+    if deadline_s is None:
+        deadline_s = settings.get("sql.distsql.flow_deadline_s")
+    try:
+        return _run_join_once(plan, catalog, host_addrs, deadline_s)
+    except Exception as e:
+        if not _retryable_failure(e):
+            raise
+        survivors = [a for a in host_addrs if probe_host(a)]
+        metric.DIST_DEGRADED.inc()
+        if survivors and len(survivors) < len(host_addrs):
+            log.warning(log.OPS, "distributed join degraded to survivors",
+                        hosts=len(host_addrs), survivors=len(survivors),
+                        error=f"{type(e).__name__}: {e}")
+            return _run_join_once(plan, catalog, survivors, deadline_s)
+        log.warning(log.OPS, "distributed join degraded to local execution",
+                    hosts=len(host_addrs),
+                    error=f"{type(e).__name__}: {e}")
+        return _run_local(plan, catalog)
+
+
+def _run_join_once(plan: S.HashJoin, catalog, host_addrs: list,
+                   deadline_s: float):
+    """Setup order matters: every scatter fragment registers before any
+    join fragment's streams attach (the registry's stream-wait covers
+    races). The gateway unions the P joined-partition streams."""
     from ..flow import operators as ops
     from ..plan import builder as plan_builder
     from .runtime import run_operator
@@ -322,19 +595,42 @@ def run_distributed_join(plan: S.HashJoin, catalog, host_addrs: list):
     flow_id = uuid.uuid4().hex[:12]
     scatter_frags, join_frags = plan_host_join(
         plan, host_addrs, flow_id, catalog)
-    for addr, streams in zip(host_addrs, scatter_frags):
-        setup_flow(addr, flow_id, streams)
-    # learn the joined schema without initializing (RemoteStream attaches
-    # only at init)
-    out_schema = plan_builder.build(join_frags[0], catalog).output_schema
-    for p, addr in enumerate(host_addrs):
-        setup_flow(addr, flow_id, {_sid_join(p): join_frags[p]})
-    inboxes = [
-        attach_stream(addr, flow_id, _sid_join(p), out_schema)
-        for p, addr in enumerate(host_addrs)
-    ]
-    sync = ops.ParallelUnorderedSyncOp(tuple(inboxes))
-    return run_operator(sync)
+    inboxes: list[FlowInbox] = []
+    try:
+        for addr, streams in zip(host_addrs, scatter_frags):
+            setup_flow(addr, flow_id, streams)
+        # learn the joined schema without initializing (RemoteStream
+        # attaches only at init)
+        out_schema = plan_builder.build(join_frags[0],
+                                        catalog).output_schema
+        for p, addr in enumerate(host_addrs):
+            setup_flow(addr, flow_id, {_sid_join(p): join_frags[p]})
+        for p, addr in enumerate(host_addrs):
+            inbox = attach_stream(addr, flow_id, _sid_join(p), out_schema)
+            inbox.sock.settimeout(deadline_s)
+            inboxes.append(inbox)
+        sync = ops.ParallelUnorderedSyncOp(tuple(inboxes))
+        return run_operator(sync)
+    except Exception:
+        _cancel_everywhere(host_addrs, flow_id)
+        raise
+    finally:
+        for inbox in inboxes:
+            try:
+                inbox.sock.close()
+            except OSError:
+                pass
+
+
+def _explain_degradation(n_hosts: int) -> str:
+    from ..utils import settings
+
+    return (
+        f"fault policy: flow deadline "
+        f"{settings.get('sql.distsql.flow_deadline_s'):g}s; on fragment "
+        f"failure cancel flow on all {n_hosts} hosts, re-plan onto "
+        f"survivors or run locally (distsql_degraded_queries)"
+    )
 
 
 def explain_host_join(plan: S.HashJoin, n_hosts: int) -> list[str]:
@@ -352,6 +648,7 @@ def explain_host_join(plan: S.HashJoin, n_hosts: int) -> list[str]:
             f"{n_hosts} build inbound streams"
         )
     out.append(f"gateway: union {n_hosts} joined-partition streams")
+    out.append(_explain_degradation(n_hosts))
     return out
 
 
@@ -367,4 +664,5 @@ def explain_hosts(plan: S.PlanNode, n_hosts: int) -> list[str]:
     out.append(
         f"gateway: final aggregation over {n_hosts} inbound streams"
     )
+    out.append(_explain_degradation(n_hosts))
     return out

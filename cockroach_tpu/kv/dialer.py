@@ -73,9 +73,13 @@ class _Breaker:
         self.probing = False
 
     def fail(self) -> None:
+        from ..utils import metric
+
         self.failures += 1
         self.probing = False
         if self.failures >= self.trip_threshold:
+            if self.opened_at is None:
+                metric.BREAKER_TRIPS.inc()
             self.opened_at = time.monotonic()
 
 
@@ -85,8 +89,14 @@ def advertise(gossip, node_id: int, addr) -> None:
 
 
 class NodeDialer:
-    def __init__(self, gossip, trip_threshold: int = 3,
-                 cooldown_s: float = 5.0):
+    def __init__(self, gossip, trip_threshold: int | None = None,
+                 cooldown_s: float | None = None):
+        from ..utils import settings
+
+        if trip_threshold is None:
+            trip_threshold = settings.get("rpc.breaker.trip_threshold")
+        if cooldown_s is None:
+            cooldown_s = settings.get("rpc.breaker.cooldown_s")
         self.gossip = gossip
         self._conns: dict[int, tuple[tuple, BatchClient]] = {}
         self._breakers: dict[int, _Breaker] = {}
@@ -128,6 +138,9 @@ class NodeDialer:
                 self._breaker(node_id).probe_aborted()  # no probe needed
                 return cached[1]
         try:
+            from ..utils import faults
+
+            faults.fire("kv.dialer.dial")
             client = BatchClient(addr)
         except Exception:
             with self._lock:

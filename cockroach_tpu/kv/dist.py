@@ -150,7 +150,10 @@ class RangeCache:
         return d
 
     def evict(self, d: RangeDescriptor) -> None:
+        from ..utils import metric
+
         self.evictions += 1
+        metric.RANGE_CACHE_EVICTIONS.inc()
         self._by_start.pop(d.start_key, None)
 
 

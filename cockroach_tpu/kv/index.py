@@ -31,8 +31,10 @@ import numpy as np
 from ..coldata.types import Family
 from ..storage import rowcodec
 
-# index entry: 1 prefix byte + 10 value bytes + 10 pk bytes = 21 <= the
-# engine's 24-byte default key width
+# index entry: 1 prefix byte + 10 value bytes + 10 pk bytes = 21 bytes.
+# Fits the engine's 24-byte default key width (storage.keys.
+# DEFAULT_KEY_WIDTH) — plan_create_index rejects engines provisioned
+# narrower, since every entry write would fail mid-backfill otherwise.
 ENTRY_BYTES = 1 + 2 * rowcodec.PK_BYTES
 
 
@@ -238,6 +240,12 @@ def plan_create_index(catalog, db, stmt,
         raise BindError(f"unknown table {stmt.table!r}")
     if not isinstance(tbl, KVTable):
         raise BindError("CREATE INDEX targets KV-backed tables")
+    if db.engine.key_width < ENTRY_BYTES:
+        raise BindError(
+            f"engine key_width {db.engine.key_width} cannot hold "
+            f"{ENTRY_BYTES}-byte index entries (provision the store with "
+            f"key_width >= {ENTRY_BYTES})"
+        )
     if any(ix.name == stmt.name for ix in tbl.indexes):
         raise BindError(f"index {stmt.name!r} already exists")
     if stmt.col not in tbl.schema.names:

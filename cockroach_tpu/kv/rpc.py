@@ -24,6 +24,7 @@ import socket
 import threading
 
 from ..storage.lsm import WriteIntentError
+from ..utils.faults import InjectedFault
 from .txn import DB
 
 
@@ -40,14 +41,20 @@ class BatchServer:
 
     def __init__(self, db: DB, host: str = "127.0.0.1", port: int = 0):
         self.db = db
+        # SO_REUSEADDR so a restart rebinds the port while the previous
+        # incarnation's conns sit in TIME_WAIT (create_server sets it on
+        # POSIX; made explicit because restart-on-same-port is contract)
         self._srv = socket.create_server((host, port))
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.settimeout(0.2)
         self.addr = self._srv.getsockname()
         self._stop = threading.Event()
         self._conns: set = set()
         self._conns_lock = threading.Lock()
-        threading.Thread(target=self._serve, daemon=True,
-                         name="kv-batch-server").start()
+        self._threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._serve, daemon=True, name="kv-batch-server")
+        self._accept_thread.start()
 
     def _serve(self):
         while not self._stop.is_set():
@@ -62,8 +69,10 @@ class BatchServer:
                     conn.close()
                     return
                 self._conns.add(conn)
-            threading.Thread(target=self._conn_loop, args=(conn,),
-                             daemon=True).start()
+                t = threading.Thread(target=self._conn_loop, args=(conn,),
+                                     daemon=True)
+                self._threads.append(t)
+            t.start()
 
     def _conn_loop(self, conn):
         """Persistent per-connection loop (BatchStream shape): one bad
@@ -78,6 +87,10 @@ class BatchServer:
                 try:
                     req = json.loads(msg.decode("utf-8"))
                     resp = self._eval_batch(req)
+                except InjectedFault as e:
+                    if e.kind == "drop":
+                        raise  # sever the stream, like a crashed replica
+                    resp = {"error": str(e), "code": "Internal"}
                 except WriteIntentError as e:
                     # carry the REAL conflicting keys/txns: clients format
                     # them into user errors and conflict handling keys on
@@ -98,6 +111,12 @@ class BatchServer:
 
     def _eval_batch(self, req: dict) -> dict:
         """Evaluate sub-requests in order (batcheval's cmd_* dispatch)."""
+        from ..utils import faults
+
+        # replica-side evaluation fault (TestingEvalFilter analog): fires
+        # BEFORE any sub-request touches the store, so a dropped batch is
+        # all-or-nothing and a retry replays it exactly
+        faults.fire("kv.rpc.server.eval")
         out = []
         for r in req.get("requests", ()):
             op = r["op"]
@@ -121,40 +140,112 @@ class BatchServer:
         return {"responses": out}
 
     def close(self):
+        """Idempotent full teardown: stop accepting, sever every accepted
+        conn, and JOIN the accept + per-conn threads (the stopper's
+        "start/stop bound every thread" contract) — a closed server holds
+        no port, no fd, and no thread, so back-to-back restarts on the
+        same port never collide."""
         self._stop.set()
         self._srv.close()
-        # established connections must stop serving too (Node.stop's
-        # "start/stop bound every thread" contract): closing them unblocks
-        # the per-connection loops parked in recv
+        # closing established conns unblocks per-connection loops parked
+        # in recv
         with self._conns_lock:
             conns = list(self._conns)
             self._conns.clear()
+            threads = list(self._threads)
+            self._threads.clear()
         for c in conns:
             try:
                 c.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
             c.close()
+        if self._accept_thread is not threading.current_thread():
+            self._accept_thread.join(timeout=5)
+        for t in threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5)
 
 
 class BatchClient:
     """Dial a BatchServer; issue batches over one persistent connection.
     Raises WriteIntentError/RuntimeError mirroring the server's typed
-    error codes (the DistSender would catch the former and retry)."""
+    error codes (the DistSender would catch the former and retry).
 
-    def __init__(self, addr):
-        self._sock = socket.create_connection(tuple(addr))
+    Transport discipline (the DistSender's send-retry reduction): every
+    RPC runs under a per-call deadline (rpc.batch.deadline_s) and
+    TRANSPORT failures — drops, resets, timeouts — re-dial and re-send
+    with exponential backoff + jitter (rpc.batch.max_retries attempts).
+    Typed SERVER answers (WriteIntentError, Internal) are never retried
+    here: the txn layer owns intent waits, and hard errors must surface.
+    A re-sent batch may double-apply if the failure hit after evaluation
+    (the reference's AmbiguousResultError window); sub-requests are
+    MVCC-idempotent enough for the non-txn surface this serves."""
+
+    def __init__(self, addr, deadline_s: float | None = None,
+                 max_retries: int | None = None):
+        from ..utils import settings
+
+        self.addr = tuple(addr)
+        self.deadline_s = (deadline_s if deadline_s is not None
+                          else settings.get("rpc.batch.deadline_s"))
+        self.max_retries = (max_retries if max_retries is not None
+                            else settings.get("rpc.batch.max_retries"))
+        self._sock = self._dial()
         self._lock = threading.Lock()
 
+    def _dial(self) -> socket.socket:
+        s = socket.create_connection(self.addr, timeout=self.deadline_s)
+        s.settimeout(self.deadline_s)
+        return s
+
+    def _redial(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = self._dial()
+
+    @staticmethod
+    def _transport_error(e: BaseException) -> bool:
+        """Retry ONLY wire-level failures; typed server errors surface."""
+        return isinstance(e, (ConnectionError, socket.timeout,
+                              TimeoutError, OSError))
+
     def batch(self, requests: list[dict]) -> list[dict]:
+        from ..utils import faults, metric, retry
         from ..flow.dcn import _recv_msg, _send_msg
 
-        with self._lock:  # one in-flight batch per connection
-            _send_msg(self._sock, json.dumps(
-                {"requests": requests}).encode("utf-8"))
-            msg = _recv_msg(self._sock)
-        if msg is None:
-            raise ConnectionError("batch server closed the stream")
+        payload = json.dumps({"requests": requests}).encode("utf-8")
+
+        def send_once():
+            with self._lock:  # one in-flight batch per connection
+                faults.fire("kv.rpc.client.batch")
+                try:
+                    _send_msg(self._sock, payload)
+                    msg = _recv_msg(self._sock)
+                except (socket.timeout, TimeoutError) as e:
+                    metric.RPC_TIMEOUTS.inc()
+                    # a timed-out stream has unknown framing state: the
+                    # next attempt MUST start on a fresh connection
+                    self._redial()
+                    raise retry.RPCDeadlineError(
+                        f"batch rpc deadline ({self.deadline_s}s) "
+                        f"exceeded against {self.addr}") from e
+                except (ConnectionError, OSError):
+                    self._redial()
+                    raise
+            if msg is None:
+                self._redial()
+                raise ConnectionError("batch server closed the stream")
+            return msg
+
+        msg = retry.call(
+            send_once,
+            retry.Backoff(max_attempts=self.max_retries,
+                          deadline_s=self.deadline_s * self.max_retries),
+            retryable=self._transport_error,
+        )
         resp = json.loads(msg.decode("utf-8"))
         if "error" in resp:
             if resp.get("code") == "WriteIntentError":

@@ -1,0 +1,19 @@
+"""jax version shim for the parallel plane.
+
+The shard_map entry point moved (jax.experimental.shard_map -> jax.shard_map)
+and renamed its replication-check kwarg (check_rep -> check_vma) across jax
+releases; the baked-in toolchain may carry either side of the move. Callers
+here always use the NEW spelling and this module adapts downward.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5: top-level export, check_vma kwarg
+    from jax import shard_map
+except ImportError:  # older jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map(f, **kw)

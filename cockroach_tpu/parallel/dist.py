@@ -20,7 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ._compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..coldata.batch import Batch

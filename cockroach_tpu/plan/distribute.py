@@ -90,7 +90,7 @@ def _rewrite(plan, catalog, broadcast_rows):
         k = len(plan.group_cols)
         exch = S.Exchange(partial, tuple(range(k)))
         final = S.Aggregate(exch, plan.group_cols, plan.aggs, mode="final",
-                            base_schema=_schema_of(plan.input, catalog))
+                            base_schema=schema_of(plan.input, catalog))
         return final, False
 
     if isinstance(plan, S.ScalarAggregate):
@@ -190,7 +190,7 @@ def _rest_fields(plan):
     return (plan.exprs, plan.names, plan.dict_overrides)
 
 
-def _schema_of(plan: S.PlanNode, catalog: Catalog):
+def schema_of(plan: S.PlanNode, catalog: Catalog):
     """Output schema of a plan subtree — a lightweight metadata walk (no
     operator construction, no dictionary bridges)."""
     from ..coldata.types import FLOAT64, Schema
@@ -205,38 +205,38 @@ def _schema_of(plan: S.PlanNode, catalog: Catalog):
         return t.schema.select(tuple(t.schema.index(n) for n in names))
     if isinstance(plan, (S.Filter, S.Sort, S.Limit,
                          S.Exchange, S.Broadcast, S.Gather)):
-        return _schema_of(plan.input, catalog)
+        return schema_of(plan.input, catalog)
     if isinstance(plan, S.Union):
-        return _schema_of(plan.inputs[0], catalog)
+        return schema_of(plan.inputs[0], catalog)
     if isinstance(plan, S.Project):
-        base = _schema_of(plan.input, catalog)
+        base = schema_of(plan.input, catalog)
         return Schema(tuple(plan.names),
                       tuple(ex.expr_type(e, base) for e in plan.exprs))
     if isinstance(plan, S.Distinct):
-        base = _schema_of(plan.input, catalog)
+        base = schema_of(plan.input, catalog)
         cols = plan.cols or tuple(range(len(base)))
         return base.select(cols)
     if isinstance(plan, (S.Aggregate, S.ScalarAggregate)):
         gcols = getattr(plan, "group_cols", ())
         mode = getattr(plan, "mode", "complete")
         base = (plan.base_schema if mode == "final"
-                else _schema_of(plan.input, catalog))
+                else schema_of(plan.input, catalog))
         return agg_ops.agg_output_schema(base, gcols, plan.aggs, mode)
     if isinstance(plan, (S.HashJoin, S.MergeJoin)):
         return join_ops.join_output_schema(
-            _schema_of(plan.probe, catalog),
-            _schema_of(plan.build, catalog), plan.spec,
+            schema_of(plan.probe, catalog),
+            schema_of(plan.build, catalog), plan.spec,
         )
     if isinstance(plan, S.Window):
         return win_ops.window_output_schema(
-            _schema_of(plan.input, catalog), plan.specs
+            schema_of(plan.input, catalog), plan.specs
         )
     if isinstance(plan, S.HashBucket):
-        return _schema_of(plan.input, catalog)
+        return schema_of(plan.input, catalog)
     if isinstance(plan, S.RemoteStream):
         return plan.schema
     if isinstance(plan, S.StreamUnion):
-        return _schema_of(plan.inputs[0], catalog)
+        return schema_of(plan.inputs[0], catalog)
     if isinstance(plan, S.IndexScan):
         t = catalog.get(plan.table)
         names = plan.columns or t.schema.names
@@ -244,5 +244,9 @@ def _schema_of(plan: S.PlanNode, catalog: Catalog):
     raise TypeError(f"no schema rule for {type(plan).__name__}")
 
 
+# back-compat private alias (pre-public-API callers)
+_schema_of = schema_of
+
+
 def _schema_len(plan: S.PlanNode, catalog: Catalog) -> int:
-    return len(_schema_of(plan, catalog))
+    return len(schema_of(plan, catalog))

@@ -8,8 +8,9 @@ join to fetch unindexed columns (pkg/sql/rowexec/joinreader.go).
 
 Reduction: single-column indexes, conjuncts of the form
 ``col <cmp> literal`` (and BETWEEN, which the binder lowers to two
-conjuncts). The whole original predicate stays as a residual filter over
-the fetched rows — re-applying the bound conjunct is one fused mask op,
+conjuncts — possibly as separate stacked Filter nodes, which the rewrite
+walks as one chain). The whole original predicate stays as a residual
+filter over the fetched rows — re-applying the bound conjunct is one fused mask op,
 and it keeps boundary/NULL semantics independent of the span math.
 
 Selectivity gate: the scan flips to the index only when the constrained
@@ -117,22 +118,34 @@ def use_indexes(plan: S.PlanNode, catalog) -> S.PlanNode:
 def _rewrite(plan, catalog):
     from ..kv.table import KVTable
 
-    if isinstance(plan, S.Filter) and isinstance(plan.input, S.TableScan):
-        scan = plan.input
-        table = catalog.tables.get(scan.table)
-        if (isinstance(table, KVTable) and table.indexes
-                and scan.shard is None):
-            names = scan.columns or table.schema.names
-            indexed = {ix.col: ix for ix in table.indexes}
-            got = _bounds_for(_conjuncts(plan.predicate), names, indexed)
-            if got is not None:
-                ix, lo, hi = got
-                if _selective_enough(table, ix, lo, hi):
-                    return S.Filter(
-                        S.IndexScan(scan.table, ix.name, lo, hi,
-                                    scan.columns),
-                        plan.predicate,
-                    )
+    if isinstance(plan, S.Filter):
+        # The binder pushes WHERE conjuncts down one at a time, so a
+        # two-sided bound (k >= 30 AND k <= 36) arrives as STACKED Filter
+        # nodes over the scan. Walk the whole chain and size the span over
+        # the union of every level's conjuncts; the residual filters are
+        # re-applied unchanged over the IndexScan.
+        preds = [plan.predicate]
+        inner = plan.input
+        while isinstance(inner, S.Filter):
+            preds.append(inner.predicate)
+            inner = inner.input
+        if isinstance(inner, S.TableScan):
+            scan = inner
+            table = catalog.tables.get(scan.table)
+            if (isinstance(table, KVTable) and table.indexes
+                    and scan.shard is None):
+                names = scan.columns or table.schema.names
+                indexed = {ix.col: ix for ix in table.indexes}
+                conjs = [c for p in preds for c in _conjuncts(p)]
+                got = _bounds_for(conjs, names, indexed)
+                if got is not None:
+                    ix, lo, hi = got
+                    if _selective_enough(table, ix, lo, hi):
+                        node: S.PlanNode = S.IndexScan(
+                            scan.table, ix.name, lo, hi, scan.columns)
+                        for p in reversed(preds):
+                            node = S.Filter(node, p)
+                        return node
     # generic recursion over PlanNode dataclass fields
     import dataclasses
 

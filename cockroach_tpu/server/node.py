@@ -163,8 +163,11 @@ class Node:
         if self._settings_cb is not None:
             settings.remove_on_change(self._settings_cb)
             self._settings_cb = None
+        # stop() may run ON a node thread (the fenced heartbeat path):
+        # joining yourself deadlocks, so skip the calling thread
         for t in self._threads:
-            t.join(timeout=5)
+            if t is not threading.current_thread():
+                t.join(timeout=5)
         self._threads.clear()
         if self.gossip is not None:
             self.gossip.close()

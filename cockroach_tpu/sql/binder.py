@@ -1148,6 +1148,76 @@ class Binder:
             placed.add(nxt)
         return BoundQuery(rel, {i: sources[i] for i in placed}, colmap)
 
+    def _dp_join_order(self, sources, equi_edges, sizes):
+        """Selinger-style left-deep DP over the equi-join graph
+        (opt/xform's JoinOrderBuilder reduced to reorder_joins_limit=6
+        left-deep trees). State = subset of placed sources; value =
+        (cost, est rows, order). Joining a connected source keeps
+        max(rows, size) rows (the FK-join assumption the distributor's
+        estimated_rows also makes); an unconnected source multiplies
+        (cartesian). Cost = sum of intermediate result sizes. Returns the
+        best order as an index tuple, or None to decline (missing
+        estimates) so the caller falls back to the greedy heuristic."""
+        n = len(sources)
+        if any(sz is None for sz in sizes):
+            return None
+        adj = [set() for _ in range(n)]
+        for li, _lp, ri, _rp in equi_edges:
+            adj[li].add(ri)
+            adj[ri].add(li)
+        # best[mask] = (cost, rows, order)
+        best: dict[int, tuple[float, float, tuple[int, ...]]] = {
+            1 << i: (0.0, float(max(1, sizes[i])), (i,)) for i in range(n)
+        }
+        for mask in range(1, 1 << n):
+            cur = best.get(mask)
+            if cur is None or mask == (1 << n) - 1:
+                continue
+            cost, rows, order = cur
+            connected = set()
+            for i in order:
+                connected |= adj[i]
+            for j in range(n):
+                if mask & (1 << j):
+                    continue
+                sj = float(max(1, sizes[j]))
+                out = (max(rows, sj) if j in connected else rows * sj)
+                cand = (cost + out, out, order + (j,))
+                prev = best.get(mask | (1 << j))
+                if prev is None or cand[0] < prev[0]:
+                    best[mask | (1 << j)] = cand
+        full = best.get((1 << n) - 1)
+        return None if full is None else full[2]
+
+    def _build_join_tree(self, order, sources, equi_edges) -> "BoundQuery":
+        """Materialize a left-deep join in the DP's order: each step joins
+        the next source on every equi edge reaching the placed prefix
+        (positions resolved through colmap), or cross-joins when no edge
+        reaches (the DP already priced that cartesian)."""
+        n = len(sources)
+        start = order[0]
+        placed = {start}
+        rel = sources[start].rel
+        colmap = {(start, p): p for p in range(len(rel.schema))}
+        for nxt in order[1:]:
+            on = []  # (probe joined POSITION, build local POSITION)
+            for li, lp, ri, rp in equi_edges:
+                if li in placed and ri == nxt:
+                    on.append((colmap[(li, lp)], rp))
+                elif ri in placed and li == nxt:
+                    on.append((colmap[(ri, rp)], lp))
+            off = len(rel.schema)
+            nb = len(sources[nxt].rel.schema)
+            if on:
+                rel = rel.join(sources[nxt].rel, on=on, how="inner",
+                               build_unique=False)
+            else:
+                rel = rel.cross_join(sources[nxt].rel)
+            for p in range(nb):
+                colmap[(nxt, p)] = off + p
+            placed.add(nxt)
+        return BoundQuery(rel, {i: sources[i] for i in range(n)}, colmap)
+
     def _apply_sub_join(self, joined: "BoundQuery", node, negate, scope,
                         sources) -> "BoundQuery":
         if isinstance(node, P.InSelect):

@@ -70,7 +70,7 @@ class Session:
     """
 
     def __init__(self, catalog: Catalog | None = None, db: DB | None = None,
-                 val_width: int = 128, key_width: int = 16,
+                 val_width: int = 128, key_width: int = 24,
                  bootstrap: bool = True, tenant: str | None = None):
         """bootstrap=False skips the catalog rediscovery scan — for servers
         (pgwire) that bootstrap the shared catalog ONCE and hand every
@@ -84,6 +84,10 @@ class Session:
         gate CREATE TABLE / BACKUP. None = the unscoped legacy session
         (system-tenant powers, no restrictions)."""
         self.catalog = catalog if catalog is not None else Catalog()
+        # key_width must fit the WIDEST key family the session can write:
+        # secondary-index entries are 21 bytes (kv/index.ENTRY_BYTES),
+        # so the default is 24 (next multiple of 8), not the 16 a bare
+        # primary-key session would need
         self.db = db if db is not None else DB(
             Engine(key_width=key_width, val_width=val_width,
                    memtable_size=4096),

@@ -355,13 +355,27 @@ class Engine:
 
     def _wal_record(self, kind: int, key: bytes, value: bytes, ts: int,
                     seq: int, txn: int, flag: bool) -> None:
+        from ..utils import faults
+
         rec = _WAL_REC.pack(kind, ts, seq, txn, 1 if flag else 0,
                             len(key), len(value))
         mon = self.disk_monitor  # one read: may be attached concurrently
         t0 = time.time() if mon is not None else 0.0
-        self._wal.write(rec + key + value)
+        payload = rec + key + value
+        # chaos sites (pebble errorfs analog): a `delay` fault models a
+        # stalling disk, `error` EIO before any byte lands, `partial` a
+        # torn append — half the record hits the file, then the "disk"
+        # dies. Replay's torn-tail truncation must recover all three.
+        faults.fire("storage.wal.append")
+        frac = faults.partial_fraction("storage.wal.append")
+        if frac is not None:
+            self._wal.write(payload[:max(1, int(len(payload) * frac))])
+            self._wal.flush()
+            raise faults.InjectedFault("storage.wal.append", "partial")
+        self._wal.write(payload)
         self._wal.flush()
         if self.wal_fsync:
+            faults.fire("storage.wal.fsync")
             os.fsync(self._wal.fileno())
         if mon is not None:
             # the WAL append IS the write-latency signal the disk monitor

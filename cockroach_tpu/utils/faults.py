@@ -1,0 +1,158 @@
+"""Fault-injection registry — settings-gated, deterministic chaos hooks.
+
+Reference mapping (each named site's CockroachDB analogue):
+
+- ``kv.rpc.client.batch``   — DistSender send errors (kvcoord/
+  dist_sender.go's sendError paths): the request is dropped/delayed on
+  the wire before the server evaluates it.
+- ``kv.rpc.server.eval``    — replica-side evaluation failure
+  (kvserver's TestingEvalFilter knobs): the server errors/hangs before
+  touching the store, the client sees a severed stream.
+- ``flow.host.setup``       — SetupFlow RPC failure (distsql/server.go
+  SetupFlow returning an error to the gateway).
+- ``flow.host.stream``      — FlowStream attach/stream failure
+  (flowinfra's ConnectInboundStream timeout/error discipline).
+- ``kv.dialer.dial``        — nodedialer connect failures (rpc/
+  nodedialer's breaker-tracked dials).
+- ``storage.wal.append``    — pebble WAL write errors (vfs error
+  injection, pebble's errorfs): delay models a stalling disk, `partial`
+  models a torn append (half a record hits the platter before the
+  crash), error models EIO.
+- ``storage.wal.fsync``     — fsync stall/failure (pebble's
+  WALFailover trigger condition).
+
+Discipline: everything is OFF unless ``fault.injection.enabled`` is set
+AND the test armed specs via :func:`arm`. Firing decisions come from ONE
+seeded ``random.Random`` so a chaos run replays exactly given its seed.
+Sites call :func:`fire` which is a cheap no-op (one module-bool check)
+when disarmed — production paths pay nothing.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class InjectedFault(ConnectionError):
+    """Raised by `error`/`drop` faults. Subclasses ConnectionError so the
+    retry layer classifies an injected drop exactly like a real one."""
+
+    def __init__(self, site: str, kind: str):
+        super().__init__(f"injected {kind} at {site}")
+        self.site = site
+        self.kind = kind
+
+
+@dataclass
+class FaultSpec:
+    """What can happen at one site.
+
+    kind: 'error' | 'drop' | 'delay' | 'partial'
+      - error/drop raise InjectedFault (drop = the wire died; error = the
+        peer answered with a failure) — sites may translate further.
+      - delay sleeps `delay_s` then proceeds (slow disk / slow peer).
+      - partial is site-interpreted (WAL: append a torn half-record).
+    p:         firing probability per pass through the site.
+    max_fires: stop firing after this many hits (so a retrying caller
+               eventually succeeds — the chaos harness's "transient"
+               knob). None = unlimited (a dead-forever peer).
+    """
+
+    kind: str = "error"
+    p: float = 1.0
+    delay_s: float = 0.01
+    max_fires: int | None = None
+    fires: int = field(default=0, compare=False)
+
+
+_lock = threading.Lock()
+_armed = False
+_rng = random.Random(0)
+_specs: dict[str, FaultSpec] = {}
+_log: list[tuple[str, str]] = []  # (site, kind) of every fired fault
+
+
+def arm(seed: int, specs: dict[str, FaultSpec]) -> None:
+    """Enable injection with a fixed seed (also flips the gating setting
+    so `fire` sites are live). Tests pair this with `disarm` in finally."""
+    from . import settings
+
+    global _armed, _rng
+    with _lock:
+        _rng = random.Random(seed)
+        _specs.clear()
+        _specs.update(specs)
+        _log.clear()
+        _armed = True
+    settings.set("fault.injection.enabled", True)
+
+
+def disarm() -> None:
+    from . import settings
+
+    global _armed
+    with _lock:
+        _armed = False
+        _specs.clear()
+        _log.clear()
+    settings.set("fault.injection.enabled", False)
+
+
+def fired() -> list[tuple[str, str]]:
+    """(site, kind) of every fault that actually fired, in order."""
+    with _lock:
+        return list(_log)
+
+
+def fire(site: str) -> None:
+    """Called at an instrumented site. Raises InjectedFault for error/drop
+    faults, sleeps for delay faults, no-ops when disarmed or the die-roll
+    misses. `partial` never fires here — sites with a partial-capable
+    action consult :func:`partial_fraction` instead."""
+    if not _armed:
+        return
+    spec = _roll(site)
+    if spec is None or spec.kind == "partial":
+        return
+    if spec.kind == "delay":
+        time.sleep(spec.delay_s)
+        return
+    raise InjectedFault(site, spec.kind)
+
+
+def partial_fraction(site: str) -> float | None:
+    """For sites that can tear a write: returns the fraction of the write
+    to persist (then the site raises as if the disk died mid-append), or
+    None when no partial fault fires."""
+    if not _armed:
+        return None
+    spec = _roll(site, kinds=("partial",))
+    if spec is None:
+        return None
+    return 0.5
+
+
+def _roll(site: str, kinds: tuple[str, ...] | None = None):
+    from . import metric
+
+    with _lock:
+        if not _armed:
+            return None
+        spec = _specs.get(site)
+        if spec is None:
+            return None
+        if kinds is not None and spec.kind not in kinds:
+            return None
+        if kinds is None and spec.kind == "partial":
+            return None
+        if spec.max_fires is not None and spec.fires >= spec.max_fires:
+            return None
+        if _rng.random() >= spec.p:
+            return None
+        spec.fires += 1
+        _log.append((site, spec.kind))
+    metric.FAULTS_INJECTED.inc()
+    return spec

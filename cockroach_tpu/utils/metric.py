@@ -147,3 +147,21 @@ EXTERNAL_AGG_SPILLS = DEFAULT.counter(
     "sql_external_agg_spills", "aggregations spilled to Grace partitions")
 RANGE_MOVES = DEFAULT.counter(
     "range_moves", "range relocations between stores")
+RPC_RETRIES = DEFAULT.counter(
+    "rpc_retries", "RPC attempts retried past transient errors")
+RPC_TIMEOUTS = DEFAULT.counter(
+    "rpc_timeouts", "RPCs that exceeded their per-call deadline")
+FAULTS_INJECTED = DEFAULT.counter(
+    "faults_injected", "chaos faults fired by utils/faults.py")
+DIST_DEGRADED = DEFAULT.counter(
+    "distsql_degraded_queries",
+    "cross-host queries re-planned onto surviving hosts or run locally "
+    "after a host became unreachable")
+DIST_FLOWS_CANCELLED = DEFAULT.counter(
+    "distsql_flows_cancelled",
+    "remote flow registrations torn down by gateway cancellation")
+BREAKER_TRIPS = DEFAULT.counter(
+    "rpc_breaker_trips", "circuit breakers opened by failure reports")
+RANGE_CACHE_EVICTIONS = DEFAULT.counter(
+    "range_cache_evictions",
+    "stale range-descriptor cache entries evicted after mismatches")

@@ -1,0 +1,112 @@
+"""Retry/backoff/deadline discipline — the util/retry.Options analog.
+
+Reference: CockroachDB wraps every network-facing loop in
+pkg/util/retry (retry.go:30 Options{InitialBackoff, MaxBackoff,
+Multiplier, MaxRetries} driving an exponential-with-jitter iterator);
+DistSender leans on it to re-send batches past transient send errors
+(kvcoord/dist_sender.go), and the breaker's cooldown turns a fast-fail
+peer back into a retryable target. The discipline here is the same,
+reduced:
+
+- ``Backoff``: deterministic-given-rng exponential backoff with jitter
+  and an optional overall deadline (monotonic clock).
+- ``is_retryable``: the one shared classification of transient vs hard
+  errors. WriteIntentError (retry after the writer finishes), socket
+  timeouts and connection drops (re-dial and re-send), and
+  BreakerOpenError (retryable only AFTER the breaker's cooldown — the
+  caller backs off long enough for the half-open probe window) are
+  transient; everything else is a hard error and must surface.
+- ``call``: run a callable under that policy, re-raising the last error
+  when attempts or the deadline run out.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import time
+
+
+class RPCDeadlineError(ConnectionError):
+    """A single RPC exceeded its deadline (DeadlineExceeded analog).
+    Subclasses ConnectionError: a timed-out send leaves the stream in an
+    unknown framing state, so callers must re-dial like a drop."""
+
+
+class Backoff:
+    """Exponential backoff with jitter + optional overall deadline.
+
+    attempts() yields attempt indices, sleeping between them; it stops
+    yielding when max_attempts or the deadline is exhausted. Durations
+    use the monotonic clock. `rng` makes the jitter deterministic for
+    tests (the chaos harness seeds it)."""
+
+    def __init__(self, max_attempts: int = 4, initial_s: float = 0.01,
+                 multiplier: float = 2.0, max_backoff_s: float = 1.0,
+                 jitter: float = 0.25, deadline_s: float | None = None,
+                 rng: random.Random | None = None):
+        assert max_attempts >= 1
+        self.max_attempts = max_attempts
+        self.initial_s = initial_s
+        self.multiplier = multiplier
+        self.max_backoff_s = max_backoff_s
+        self.jitter = jitter
+        self.deadline_s = deadline_s
+        self.rng = rng if rng is not None else random
+
+    def attempts(self):
+        start = time.monotonic()
+        pause = self.initial_s
+        for i in range(self.max_attempts):
+            yield i
+            if i == self.max_attempts - 1:
+                return
+            if self.deadline_s is not None and (
+                    time.monotonic() - start + pause > self.deadline_s):
+                return
+            # jitter spreads synchronized retriers (retry.go's Mult+jitter)
+            frac = 1.0 + self.jitter * (2.0 * self.rng.random() - 1.0)
+            time.sleep(pause * frac)
+            pause = min(pause * self.multiplier, self.max_backoff_s)
+
+
+def is_retryable(e: BaseException) -> bool:
+    """Shared transient-vs-hard classification for the distributed plane."""
+    from ..kv.dialer import BreakerOpenError
+    from ..storage.lsm import WriteIntentError
+
+    if isinstance(e, WriteIntentError):
+        return True  # the writer will commit/abort; wait and re-read
+    if isinstance(e, (socket.timeout, TimeoutError, RPCDeadlineError)):
+        return True  # deadline: re-dial (stream framing state unknown)
+    if isinstance(e, (ConnectionError, BrokenPipeError)):
+        return True  # drop: re-dial and re-send
+    if isinstance(e, OSError):
+        return True  # refused/reset during (re)connect of a restarting peer
+    if isinstance(e, BreakerOpenError):
+        # retryable-after-cooldown: the backoff must outlast the breaker's
+        # cooldown for the half-open probe to be admitted
+        return True
+    return False
+
+
+def call(fn, policy: Backoff | None = None, retryable=is_retryable,
+         on_retry=None):
+    """Run fn() under the retry policy. Transient errors (per `retryable`)
+    retry with backoff; hard errors and exhaustion re-raise."""
+    from . import metric
+
+    policy = policy if policy is not None else Backoff()
+    last: BaseException | None = None
+    for attempt in policy.attempts():
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - classified below
+            if not retryable(e):
+                raise
+            last = e
+            metric.RPC_RETRIES.inc()
+            if on_retry is not None:
+                on_retry(attempt, e)
+    assert last is not None
+    raise last

@@ -269,3 +269,47 @@ COLLECT_STATS = register_bool(
     "collect per-operator ComponentStats on every query; stats are recorded "
     "on the active tracing span (EXPLAIN ANALYZE always collects)",
 )
+JOIN_ORDER = register_enum(
+    "sql.opt.join_order", "heuristic",
+    "multi-way join ordering: 'heuristic' starts at the largest estimated "
+    "source and greedily joins the smallest connected build side; 'cost' "
+    "runs a Selinger-style left-deep DP over the equi-join graph for 2..6 "
+    "sources (reorder_joins_limit analog), falling back to the heuristic "
+    "when the DP declines",
+    choices=("heuristic", "cost"),
+)
+FAULT_INJECTION = register_bool(
+    "fault.injection.enabled", False,
+    "arm the chaos fault-injection registry (utils/faults.py); test builds "
+    "only — the testing-knobs analog, never enabled in production",
+)
+RPC_DEADLINE_S = register_float(
+    "rpc.batch.deadline_s", 5.0,
+    "per-RPC deadline for KV Batch calls (DeadlineExceeded analog); a "
+    "timed-out RPC re-dials and retries under rpc.batch.max_retries",
+    lo=0.05, hi=300.0,
+)
+RPC_MAX_RETRIES = register_int(
+    "rpc.batch.max_retries", 4,
+    "attempts per KV Batch RPC against transient errors (drops, timeouts) "
+    "before the failure surfaces (util/retry MaxRetries analog)",
+    lo=1, hi=64,
+)
+BREAKER_TRIP = register_int(
+    "rpc.breaker.trip_threshold", 3,
+    "consecutive reported RPC failures that open a peer's circuit breaker "
+    "(rpc/peer.go reduction)",
+    lo=1, hi=100,
+)
+BREAKER_COOLDOWN_S = register_float(
+    "rpc.breaker.cooldown_s", 5.0,
+    "open-breaker cooldown before the half-open probe is admitted",
+    lo=0.01, hi=600.0,
+)
+FLOW_DEADLINE_S = register_float(
+    "sql.distsql.flow_deadline_s", 30.0,
+    "end-to-end deadline for a cross-host distributed query (setup + "
+    "stream drain); on expiry remote flows are cancelled and the gateway "
+    "degrades or errors (flowinfra timeout discipline)",
+    lo=0.1, hi=3600.0,
+)

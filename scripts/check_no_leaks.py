@@ -1,0 +1,94 @@
+"""Thread/socket leak census — the leaktest.AfterTest(t) analog.
+
+Reference: CockroachDB wraps every test in pkg/testutils/leaktest, which
+snapshots goroutines before the test and fails if new ones survive it.
+Here the census covers the two resources the socket plane can leak:
+live threads (threading.enumerate) and open socket fds (/proc/self/fd
+symlinks pointing at socket inodes).
+
+Usage (chaos + dcn tests):
+
+    from scripts.check_no_leaks import snapshot, assert_no_leaks
+
+    before = snapshot()
+    ... start servers, run queries, close servers ...
+    assert_no_leaks(before)
+
+`assert_no_leaks` retries for a grace period: closed sockets and joined
+threads take a beat to disappear (TIME_WAIT is NOT counted — the census
+reads this process's fds, not kernel conn state).
+
+Also runnable standalone for a quick census of the current interpreter:
+``python -m scripts.check_no_leaks``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Census:
+    threads: frozenset[str]
+    n_threads: int
+    socket_fds: int
+
+
+def _socket_fd_count() -> int:
+    """Open socket fds of THIS process (anon_inode/pipe/file excluded)."""
+    fd_dir = "/proc/self/fd"
+    if not os.path.isdir(fd_dir):  # non-Linux: thread census only
+        return 0
+    n = 0
+    for fd in os.listdir(fd_dir):
+        try:
+            if os.readlink(os.path.join(fd_dir, fd)).startswith("socket:"):
+                n += 1
+        except OSError:
+            continue  # fd closed while listing
+    return n
+
+
+def snapshot() -> Census:
+    threads = frozenset(
+        f"{t.name}:{t.ident}" for t in threading.enumerate())
+    return Census(threads, len(threads), _socket_fd_count())
+
+
+def leaks(before: Census) -> list[str]:
+    """What exists now that did not exist at `before` (empty = clean)."""
+    now = snapshot()
+    out = []
+    new_threads = [
+        n for n in now.threads - before.threads
+        # pytest's own machinery may spin a watcher thread mid-test
+        if not n.startswith(("pytest", "MainThread"))
+    ]
+    if new_threads:
+        out.append(f"threads leaked: {sorted(new_threads)}")
+    if now.socket_fds > before.socket_fds:
+        out.append(
+            f"socket fds leaked: {before.socket_fds} -> {now.socket_fds}")
+    return out
+
+
+def assert_no_leaks(before: Census, grace_s: float = 5.0) -> None:
+    """Fail if threads/sockets born after `before` still exist. Retries
+    within grace_s: daemon threads observe their stop event and fds close
+    asynchronously with the test's teardown calls."""
+    deadline = time.monotonic() + grace_s
+    remaining = leaks(before)
+    while remaining and time.monotonic() < deadline:
+        time.sleep(0.05)
+        remaining = leaks(before)
+    assert not remaining, "; ".join(remaining)
+
+
+if __name__ == "__main__":
+    c = snapshot()
+    print(f"threads={c.n_threads} socket_fds={c.socket_fds}")
+    for t in sorted(c.threads):
+        print(f"  {t}")

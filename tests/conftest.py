@@ -23,6 +23,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic seeded fault-injection tests (fast seeds "
+        "run in tier-1; exclude with -m 'not chaos')")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,339 @@
+"""Chaos harness: distributed join + KV RPC suites under seeded injected
+faults (utils/faults.py). Every test asserts results equal the no-fault
+oracle AND that no threads/sockets/flow-registry entries leak — the
+leaktest.AfterTest + TestingKnobs discipline combined.
+
+Fast seeds only: everything here is deterministic (one seeded RNG drives
+all firing decisions) and finishes in seconds, so the suite runs inside
+tier-1. Exclude with -m 'not chaos'."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from scripts.check_no_leaks import assert_no_leaks, snapshot
+
+from cockroach_tpu.catalog import Catalog, Table
+from cockroach_tpu.coldata.types import FLOAT64, INT64, Schema
+from cockroach_tpu.flow.disthost import (
+    HostFlowServer,
+    cancel_flow,
+    run_distributed_hosts,
+    run_distributed_join,
+    setup_flow,
+)
+from cockroach_tpu.kv import DB, Clock
+from cockroach_tpu.kv.rpc import BatchClient, BatchServer
+from cockroach_tpu.ops.aggregation import AggSpec
+from cockroach_tpu.plan import builder as plan_builder
+from cockroach_tpu.plan import spec as S
+from cockroach_tpu.flow.runtime import run_operator
+from cockroach_tpu.storage.lsm import Engine
+from cockroach_tpu.utils import faults, metric
+from cockroach_tpu.utils.faults import FaultSpec, InjectedFault
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    faults.disarm()
+
+
+def _mini_catalog(n=600, c=16, seed=7) -> Catalog:
+    """Small deterministic two-table catalog (fast chaos iterations; the
+    tpch generator would dominate runtime)."""
+    rng = np.random.default_rng(seed)
+    cat = Catalog()
+    cat.add(Table(
+        name="orders",
+        schema=Schema(("o_key", "o_cust", "o_val"),
+                      (INT64, INT64, FLOAT64)),
+        columns={
+            "o_key": np.arange(n, dtype=np.int64),
+            "o_cust": rng.integers(0, c, n, dtype=np.int64),
+            "o_val": rng.uniform(1.0, 100.0, n),
+        },
+    ))
+    cat.add(Table(
+        name="cust",
+        schema=Schema(("c_key", "c_grp"), (INT64, INT64)),
+        columns={
+            "c_key": np.arange(c, dtype=np.int64),
+            "c_grp": np.arange(c, dtype=np.int64) % 4,
+        },
+    ))
+    return cat
+
+
+def _agg_plan(cat: Catalog) -> S.PlanNode:
+    sch = cat.get("orders").schema
+    return S.Aggregate(
+        S.TableScan("orders"),
+        group_cols=(sch.index("o_cust"),),
+        aggs=(AggSpec("count_rows", None, "n"),
+              AggSpec("sum", sch.index("o_val"), "total")),
+        mode="complete",
+    )
+
+
+def _join_plan() -> S.HashJoin:
+    return S.HashJoin(
+        probe=S.TableScan("orders", ("o_key", "o_cust")),
+        build=S.TableScan("cust", ("c_key", "c_grp")),
+        probe_keys=(1,),
+        build_keys=(0,),
+    )
+
+
+def _canon(res: dict) -> np.ndarray:
+    rows = np.stack([np.asarray(res[k], dtype=np.float64)
+                     for k in sorted(res.keys())], axis=1)
+    return rows[np.lexsort(rows.T[::-1])]
+
+
+def _assert_equal(got: dict, want: dict) -> None:
+    assert sorted(got.keys()) == sorted(want.keys())
+    np.testing.assert_allclose(_canon(got), _canon(want), rtol=1e-9)
+
+
+# -- determinism ------------------------------------------------------------
+
+
+def test_fault_registry_deterministic_replay():
+    """Same seed, same specs => the exact same fault sequence (the whole
+    point of seeding: a chaos failure replays)."""
+    spec = {"site.a": FaultSpec(kind="error", p=0.5, max_fires=3),
+            "site.b": FaultSpec(kind="delay", p=0.5, delay_s=0.0)}
+    runs = []
+    for _ in range(2):
+        faults.arm(1234, {k: FaultSpec(**{
+            "kind": v.kind, "p": v.p, "delay_s": v.delay_s,
+            "max_fires": v.max_fires}) for k, v in spec.items()})
+        for _ in range(30):
+            for site in ("site.a", "site.b"):
+                try:
+                    faults.fire(site)
+                except InjectedFault:
+                    pass
+        runs.append(faults.fired())
+        faults.disarm()
+    assert runs[0] == runs[1]
+    assert any(s == "site.a" for s, _ in runs[0])  # it actually fired
+
+
+def test_disarmed_sites_are_free():
+    faults.disarm()
+    faults.fire("kv.rpc.client.batch")  # no-op, no exception
+    assert faults.partial_fraction("storage.wal.append") is None
+
+
+# -- KV RPC under drops -----------------------------------------------------
+
+
+def test_kv_rpc_drops_retry_to_oracle():
+    """Client-wire drops AND server-eval drops: the retry layer re-dials
+    and re-sends until the (max_fires-bounded) faults exhaust; every
+    read then equals the no-fault oracle."""
+    before = snapshot()
+    db = DB(Engine(key_width=16, val_width=32, memtable_size=64), Clock())
+    srv = BatchServer(db)
+    client = BatchClient(srv.addr, deadline_s=2.0, max_retries=8)
+    retries_before = metric.RPC_RETRIES.value
+    faults.arm(11, {
+        "kv.rpc.client.batch": FaultSpec(kind="drop", p=0.25, max_fires=4),
+        "kv.rpc.server.eval": FaultSpec(kind="drop", p=0.25, max_fires=4),
+    })
+    try:
+        oracle = {}
+        for i in range(30):
+            k = b"k%03d" % i
+            v = b"v%03d" % (i * 7)
+            client.put(k, v)
+            oracle[k] = v
+        for k, v in oracle.items():
+            assert client.get(k) == v
+        assert faults.fired(), "chaos run injected nothing"
+        assert metric.RPC_RETRIES.value > retries_before
+    finally:
+        faults.disarm()
+        client.close()
+        srv.close()
+    assert_no_leaks(before)
+
+
+def test_batch_server_restart_same_port_and_idempotent_close():
+    """Back-to-back start/stop on the SAME port never raises; close() is
+    idempotent and leaves no thread or socket behind."""
+    before = snapshot()
+    db = DB(Engine(key_width=16, val_width=32, memtable_size=64), Clock())
+    port = None
+    for round_no in range(3):
+        srv = BatchServer(db, port=port or 0)
+        port = srv.addr[1]
+        c = BatchClient(srv.addr)
+        c.put(b"r%d" % round_no, b"x")
+        c.close()
+        srv.close()
+        srv.close()  # idempotent
+    assert_no_leaks(before)
+
+
+def test_host_flow_server_restart_same_port_and_idempotent_close():
+    before = snapshot()
+    cat = _mini_catalog()
+    port = None
+    for _ in range(3):
+        srv = HostFlowServer(cat, port=port or 0).serve_background()
+        port = srv.addr[1]
+        srv.close()
+        srv.close()  # idempotent
+    assert_no_leaks(before)
+
+
+# -- distributed plane under chaos ------------------------------------------
+
+
+def test_distributed_join_under_rpc_drops_equals_oracle():
+    """Setup/stream RPC drops (bounded) against both hosts: retries — and,
+    if they exhaust, degradation — still produce the oracle result, and
+    no flow-registry entry outlives the query."""
+    before = snapshot()
+    cat = _mini_catalog()
+    plan = _join_plan()
+    want = run_operator(plan_builder.build(plan, cat))
+    srvs = [HostFlowServer(cat).serve_background() for _ in range(2)]
+    faults.arm(29, {
+        "flow.host.setup": FaultSpec(kind="drop", p=0.4, max_fires=2),
+        "flow.host.stream": FaultSpec(kind="error", p=0.4, max_fires=2),
+    })
+    try:
+        got = run_distributed_join(plan, cat, [s.addr for s in srvs])
+        _assert_equal(got, want)
+        assert faults.fired(), "chaos run injected nothing"
+        faults.disarm()
+        for s in srvs:
+            assert s.registry_size() == 0, "leaked flow-registry entries"
+    finally:
+        faults.disarm()
+        for s in srvs:
+            s.close()
+    assert_no_leaks(before)
+
+
+def test_distributed_agg_host_killed_mid_flow_degrades():
+    """One host dies while its stream is still being established: the
+    gateway cancels the flow everywhere, probes survivors, re-plans onto
+    them, and still returns the oracle result (surfaced via the
+    distsql_degraded_queries metric)."""
+    before = snapshot()
+    cat = _mini_catalog()
+    plan = _agg_plan(cat)
+    want = run_operator(plan_builder.build(plan, cat))
+    srv_a = HostFlowServer(cat).serve_background()
+    srv_b = HostFlowServer(cat).serve_background()
+    degraded_before = metric.DIST_DEGRADED.value
+    # every stream handshake stalls 0.4s; host B dies at 0.15s — so B is
+    # guaranteed to go down after setup registered its fragment but
+    # before its stream delivers (the "killed mid-flow" window)
+    faults.arm(23, {
+        "flow.host.stream": FaultSpec(kind="delay", p=1.0, delay_s=0.4),
+    })
+    killer = threading.Timer(0.15, srv_b.close)
+    killer.start()
+    try:
+        got = run_distributed_hosts(plan, cat, [srv_a.addr, srv_b.addr])
+        _assert_equal(got, want)
+        assert metric.DIST_DEGRADED.value > degraded_before
+        faults.disarm()
+        assert srv_a.registry_size() == 0, "leaked flow-registry entries"
+    finally:
+        killer.cancel()
+        faults.disarm()
+        srv_a.close()
+        srv_b.close()
+    assert_no_leaks(before)
+
+
+def test_distributed_agg_all_hosts_dead_falls_back_local():
+    """No host reachable at all: the gateway degrades to single-host
+    local execution rather than erroring."""
+    cat = _mini_catalog()
+    plan = _agg_plan(cat)
+    want = run_operator(plan_builder.build(plan, cat))
+    srv = HostFlowServer(cat).serve_background()
+    dead_addr = srv.addr
+    srv.close()  # nothing listens here anymore
+    degraded_before = metric.DIST_DEGRADED.value
+    got = run_distributed_hosts(plan, cat, [dead_addr])
+    _assert_equal(got, want)
+    assert metric.DIST_DEGRADED.value > degraded_before
+
+
+def test_cancel_flow_purges_registry_and_poisons_late_arrivals():
+    """cancel_flow removes every registered entry of the flow and fails
+    late setups/stream-waits for it (no TTL-long lingering)."""
+    cat = _mini_catalog()
+    srv = HostFlowServer(cat, stream_wait_s=0.5).serve_background()
+    try:
+        frag = S.TableScan("orders")
+        setup_flow(srv.addr, "doomed", {0: frag, 1: frag})
+        assert srv.registry_size() == 2
+        removed = cancel_flow(srv.addr, "doomed")
+        assert removed == 2
+        assert srv.registry_size() == 0
+        # a late setup for the cancelled flow is rejected outright
+        with pytest.raises(RuntimeError):
+            setup_flow(srv.addr, "doomed", {2: frag})
+        assert srv.registry_size() == 0
+    finally:
+        srv.close()
+
+
+# -- WAL chaos --------------------------------------------------------------
+
+
+def test_wal_torn_append_recovers_on_reopen(tmp_path):
+    """A partial fault tears an append mid-record (the crash-mid-write
+    shape): reopening truncates the torn tail and replays everything
+    before it; the store keeps working."""
+    wal = str(tmp_path / "w.wal")
+    eng = Engine(key_width=16, val_width=8, wal_path=wal)
+    eng.put(b"a", b"1", ts=3)
+    faults.arm(31, {
+        "storage.wal.append": FaultSpec(kind="partial", p=1.0, max_fires=1),
+    })
+    with pytest.raises(InjectedFault):
+        eng.put(b"b", b"2", ts=4)
+    faults.disarm()
+    # crash: reopen from the WAL alone
+    eng2 = Engine(key_width=16, val_width=8, wal_path=wal)
+    assert eng2.get(b"a", ts=10) == b"1"
+    assert eng2.get(b"b", ts=10) is None  # torn record truncated away
+    eng2.put(b"c", b"3", ts=5)  # appending after truncation works
+    assert eng2.get(b"c", ts=10) == b"3"
+
+
+def test_wal_fsync_and_delay_faults(tmp_path):
+    """fsync error-injection surfaces (WALFailover trigger shape); delay
+    injection slows appends without corrupting them."""
+    wal = str(tmp_path / "f.wal")
+    eng = Engine(key_width=16, val_width=8, wal_path=wal, wal_fsync=True)
+    faults.arm(37, {
+        "storage.wal.fsync": FaultSpec(kind="error", p=1.0, max_fires=1),
+    })
+    with pytest.raises(InjectedFault):
+        eng.put(b"x", b"1", ts=3)
+    faults.disarm()
+    faults.arm(41, {
+        "storage.wal.append": FaultSpec(kind="delay", p=1.0,
+                                        delay_s=0.01, max_fires=2),
+    })
+    t0 = time.monotonic()
+    eng.put(b"y", b"2", ts=4)
+    assert time.monotonic() - t0 >= 0.01
+    faults.disarm()
+    assert eng.get(b"y", ts=10) == b"2"

@@ -201,6 +201,9 @@ def test_flow_server_survives_bad_clients(remote):
     RangefeedServer handshake discipline)."""
     import socket
 
+    from scripts.check_no_leaks import assert_no_leaks, snapshot
+
+    before = snapshot()
     # 1: connect and immediately close (empty handshake)
     s = socket.create_connection(tuple(remote))
     s.close()
@@ -219,6 +222,9 @@ def test_flow_server_survives_bad_clients(remote):
                                   cat.get("orders").schema)
     got = run_operator(inbox)
     assert len(got["o_orderkey"]) == cat.get("orders").num_rows
+    # all the churn above must leave no sockets behind in THIS process
+    # (the drained inbox closes its own socket; bad clients closed theirs)
+    assert_no_leaks(before)
 
 
 # ---------------------------------------------------------------------------

@@ -28,9 +28,26 @@ def mesh():
     return mesh_mod.make_mesh(8)
 
 
+# files whose directive matrices take 20s-4min of XLA compile each on the
+# CPU-emulated 8-device mesh; tier-1 skips them, `-m slow` covers them
+_COMPILE_HEAVY = {
+    "matrix_window", "matrix_agg", "setop_precedence",
+    "setops_filter_distinctfrom", "join_edges", "matrix_order_limit",
+    "setop_chains", "agg_grouping",
+}
+
+
+def _logic_id(p: str) -> str:
+    return p.rsplit("/", 1)[-1].removesuffix(".test")
+
+
 @pytest.mark.parametrize(
-    "path", runner.logic_files(),
-    ids=lambda p: p.rsplit("/", 1)[-1].removesuffix(".test"),
+    "path", [
+        pytest.param(p, marks=pytest.mark.slow)
+        if _logic_id(p) in _COMPILE_HEAVY else p
+        for p in runner.logic_files()
+    ],
+    ids=_logic_id,
 )
 def test_logic_file(path, mesh):
     n = runner.run_logic_file(path, Session(), mesh=mesh)

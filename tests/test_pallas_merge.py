@@ -68,6 +68,9 @@ def test_merge_pair_matches_sort(seed, sizes):
     assert _live_tuples(got) == _live_tuples(want)
 
 
+# ~1-2 min of pallas-interpret tracing per k on the CI box; tier-1 keeps
+# the pairwise kernels, `-m slow` covers the tournament tree
+@pytest.mark.slow
 @pytest.mark.parametrize("k", [3, 4, 5])
 def test_merge_tournament_matches_sort(k):
     rng = np.random.default_rng(7 + k)
@@ -89,6 +92,7 @@ def test_eligibility_bound():
     assert not pm.eligible((small[0],))
 
 
+@pytest.mark.slow
 def test_engine_compaction_uses_kernel_result():
     """Engine.compact with the pallas merge enabled (interpret mode)
     produces the same live content as the sort path."""

@@ -1,0 +1,146 @@
+"""Retry/backoff/deadline discipline + the dialer breaker state machine
+(util/retry.Options and rpc/peer.go reductions)."""
+
+import random
+import socket
+import time
+
+import pytest
+
+from cockroach_tpu.kv.dialer import BreakerOpenError, _Breaker
+from cockroach_tpu.storage.lsm import WriteIntentError
+from cockroach_tpu.utils import retry
+from cockroach_tpu.utils.faults import InjectedFault
+
+
+def test_backoff_attempt_count_and_determinism():
+    b = retry.Backoff(max_attempts=4, initial_s=0.0, jitter=0.0)
+    assert list(b.attempts()) == [0, 1, 2, 3]
+    # jitter draws come from the injected rng: same seed, same schedule
+    draws = [retry.Backoff(max_attempts=3, initial_s=0.001,
+                           rng=random.Random(5)).rng.random()
+             for _ in range(2)]
+    assert draws[0] == draws[1]
+
+
+def test_backoff_respects_overall_deadline():
+    b = retry.Backoff(max_attempts=50, initial_s=0.02, multiplier=1.0,
+                      jitter=0.0, deadline_s=0.1)
+    t0 = time.monotonic()
+    n = sum(1 for _ in b.attempts())
+    assert time.monotonic() - t0 < 1.0
+    assert n < 50  # the deadline cut the attempt budget
+
+
+def test_call_retries_transient_until_success():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionResetError("transient")
+        return "ok"
+
+    assert retry.call(flaky, retry.Backoff(max_attempts=5,
+                                           initial_s=0.0)) == "ok"
+    assert calls["n"] == 3
+
+
+def test_call_hard_error_surfaces_immediately():
+    calls = {"n": 0}
+
+    def hard():
+        calls["n"] += 1
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        retry.call(hard, retry.Backoff(max_attempts=5, initial_s=0.0))
+    assert calls["n"] == 1
+
+
+def test_call_exhaustion_reraises_last_transient():
+    def always():
+        raise ConnectionError("down forever")
+
+    with pytest.raises(ConnectionError):
+        retry.call(always, retry.Backoff(max_attempts=3, initial_s=0.0))
+
+
+def test_retryable_classification():
+    assert retry.is_retryable(WriteIntentError([b"k"], [1]))
+    assert retry.is_retryable(socket.timeout())
+    assert retry.is_retryable(TimeoutError())
+    assert retry.is_retryable(retry.RPCDeadlineError("deadline"))
+    assert retry.is_retryable(ConnectionResetError())
+    assert retry.is_retryable(OSError("connection refused"))
+    # an injected drop classifies exactly like a real one
+    assert retry.is_retryable(InjectedFault("kv.rpc.client.batch", "drop"))
+    # breaker-open is retryable-after-cooldown: the backoff outlasts the
+    # cooldown so a later attempt is admitted as the half-open probe
+    assert retry.is_retryable(BreakerOpenError("open"))
+    assert not retry.is_retryable(ValueError("planning bug"))
+    assert not retry.is_retryable(KeyError("missing"))
+
+
+def test_breaker_trips_at_threshold():
+    b = _Breaker(trip_threshold=3, cooldown_s=10.0)
+    b.fail()
+    b.fail()
+    b.admit()  # two failures: still closed
+    b.fail()
+    with pytest.raises(BreakerOpenError):
+        b.admit()
+
+
+def test_breaker_half_open_single_probe_then_reset():
+    b = _Breaker(trip_threshold=1, cooldown_s=0.05)
+    b.fail()
+    with pytest.raises(BreakerOpenError):
+        b.admit()
+    time.sleep(0.06)
+    b.admit()  # this caller IS the half-open probe
+    # a second caller during the probe is NOT admitted
+    with pytest.raises(BreakerOpenError):
+        b.admit()
+    b.ok()  # probe's RPC succeeded: breaker closes fully
+    b.admit()
+    b.admit()  # closed: everyone is admitted
+
+
+def test_breaker_probe_failure_reopens():
+    b = _Breaker(trip_threshold=1, cooldown_s=0.05)
+    b.fail()
+    time.sleep(0.06)
+    b.admit()  # probe admitted
+    b.fail()  # probe's RPC failed: open again, cooldown restarts
+    with pytest.raises(BreakerOpenError):
+        b.admit()
+
+
+def test_breaker_aborted_probe_frees_slot():
+    b = _Breaker(trip_threshold=1, cooldown_s=0.05)
+    b.fail()
+    time.sleep(0.06)
+    b.admit()
+    b.probe_aborted()  # the dial itself failed; slot frees immediately
+    b.admit()  # next caller becomes the probe without waiting 2x cooldown
+
+
+def test_retry_through_breaker_cooldown():
+    """The integration the classification exists for: a retry loop whose
+    backoff spans the cooldown gets admitted as the half-open probe and
+    succeeds once the peer is back."""
+    b = _Breaker(trip_threshold=1, cooldown_s=0.08)
+    b.fail()  # tripped
+
+    def guarded():
+        b.admit()
+        return "through"
+
+    got = retry.call(
+        guarded,
+        retry.Backoff(max_attempts=8, initial_s=0.04, multiplier=1.5,
+                      jitter=0.0),
+        retryable=lambda e: isinstance(e, BreakerOpenError),
+    )
+    assert got == "through"

@@ -105,6 +105,17 @@ def _cell(v):
 def engines():
     s = Session()
     lite = sqlite3.connect(":memory:")
+    try:
+        lite.execute("select mod(7, 3)")
+    except sqlite3.OperationalError:
+        # sqlite < 3.35 (or built without SQLITE_ENABLE_MATH_FUNCTIONS)
+        # lacks mod(); supply the same truncate-toward-zero semantics
+        import math
+
+        lite.create_function(
+            "mod", 2,
+            lambda x, y: None if x is None or y is None
+            else math.fmod(x, y))
     for stmt in _SETUP:
         s.execute(stmt)
         lite.execute(stmt)
