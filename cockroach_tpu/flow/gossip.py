@@ -42,11 +42,21 @@ class Info:
 
 class Gossip:
     """infoStore + push-pull exchange. add_info bumps the local version
-    counter; merge keeps the higher (version, origin) per key."""
+    counter; merge keeps the higher (version, origin) per key.
 
-    def __init__(self, node_id: int):
+    The store is BOUNDED (`max_infos`, gossip.go's infoStore limits
+    role): when full, the lowest-version foreign info is evicted — a
+    flapping peer republishing junk cannot grow memory without bound.
+    `note_epoch` expires every info a fenced origin published: once a
+    node's liveness epoch is bumped, state it gossiped under the old
+    epoch is stale by definition (its leases are fenced, its address
+    may be reused)."""
+
+    def __init__(self, node_id: int, max_infos: int = 4096):
         self.node_id = int(node_id)
+        self.max_infos = int(max_infos)
         self._infos: dict[str, Info] = {}
+        self._node_epochs: dict[int, int] = {}  # highest KNOWN epoch
         self._clock = 0
         self._lock = threading.Lock()
         self._srv: socket.socket | None = None
@@ -58,6 +68,41 @@ class Gossip:
         with self._lock:
             self._clock += 1
             self._infos[key] = Info(key, value, self._clock, self.node_id)
+            self._enforce_bound()
+
+    def note_epoch(self, node_id: int, epoch: int) -> None:
+        """A node's liveness epoch was observed at `epoch`: drop every
+        info that node originated under any earlier observation. The
+        node itself keeps gossiping after it re-heartbeats — its NEW
+        infos merge normally (higher versions win as usual)."""
+        from ..utils import metric
+
+        node_id = int(node_id)
+        with self._lock:
+            if self._node_epochs.get(node_id, 0) >= epoch:
+                return
+            self._node_epochs[node_id] = int(epoch)
+            stale = [k for k, i in self._infos.items()
+                     if i.origin == node_id]
+            for k in stale:
+                del self._infos[k]
+            if stale:
+                metric.GOSSIP_INFOS_EVICTED.inc(len(stale))
+
+    def _enforce_bound(self) -> None:
+        """Caller holds self._lock. Evict lowest-version FOREIGN infos
+        first (our own infos are authoritative here and re-publishable
+        only by us); fall back to lowest-version overall if the store is
+        somehow all-local."""
+        from ..utils import metric
+
+        while len(self._infos) > self.max_infos:
+            foreign = [i for i in self._infos.values()
+                       if i.origin != self.node_id]
+            pool = foreign if foreign else list(self._infos.values())
+            victim = min(pool, key=lambda i: (i.version, i.origin))
+            del self._infos[victim.key]
+            metric.GOSSIP_INFOS_EVICTED.inc()
 
     def get_info(self, key: str):
         with self._lock:
@@ -79,6 +124,7 @@ class Gossip:
                     self._infos[info.key] = info
                     self._clock = max(self._clock, info.version)
                     fresh += 1
+            self._enforce_bound()
         return fresh
 
     def _snapshot(self) -> list[dict]:
@@ -123,6 +169,11 @@ class Gossip:
 
     def exchange(self, addr) -> int:
         """One push-pull round with a peer; returns infos learned."""
+        from ..utils import faults
+
+        # chaos site: a dropped broadcast round models a partitioned
+        # gossip link (node-scoped so tests can isolate one node)
+        faults.fire_scoped("gossip.broadcast", self.node_id)
         sock = socket.create_connection(tuple(addr))
         try:
             _send_msg(sock, json.dumps(self._snapshot()).encode("utf-8"))

@@ -160,17 +160,28 @@ class RangefeedServer:
     (poll-driven tailer standing in for the raft-apply hook), interleaved
     with resolved-timestamp checkpoints."""
 
-    def __init__(self, db: DB, poll_interval_s: float = 0.05):
+    def __init__(self, db: DB, poll_interval_s: float = 0.05,
+                 port: int = 0):
         import socket
         import threading
 
         self.db = db
         self.poll_interval_s = poll_interval_s
-        self._srv = socket.create_server(("127.0.0.1", 0))
+        # explicit port so a restarted source rebinds the SAME address —
+        # the replication stream's reconnect contract needs a stable
+        # endpoint to re-dial (create_server sets SO_REUSEADDR on POSIX)
+        self._srv = socket.create_server(("127.0.0.1", port))
         self._srv.settimeout(0.2)
         self.addr = self._srv.getsockname()
         self._stop = threading.Event()
-        threading.Thread(target=self._serve, daemon=True).start()
+        # track accepted conns so close() severs them: a restart on the
+        # same port must not collide with a previous incarnation's
+        # still-established subscriber sockets
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._accept_thread = threading.Thread(target=self._serve,
+                                               daemon=True)
+        self._accept_thread.start()
 
     def _serve(self):
         import socket
@@ -185,6 +196,11 @@ class RangefeedServer:
                 continue
             except OSError:
                 return  # server socket closed
+            with self._conns_lock:
+                if self._stop.is_set():
+                    conn.close()
+                    return
+                self._conns.add(conn)
             threading.Thread(target=self._handshake, args=(conn,),
                              daemon=True).start()
 
@@ -203,6 +219,8 @@ class RangefeedServer:
             conn.settimeout(None)
         except (OSError, ValueError, ConnectionError):
             conn.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
             return
         self._tail(conn, req)
 
@@ -230,10 +248,29 @@ class RangefeedServer:
             pass  # subscriber went away
         finally:
             conn.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def close(self):
+        import socket
+        import threading
+
         self._stop.set()
         self._srv.close()
+        # join the accept loop: the kernel holds the listening socket
+        # open while a thread sits in accept()'s poll window, so a
+        # restart on the same port would EADDRINUSE until it exits
+        if self._accept_thread is not threading.current_thread():
+            self._accept_thread.join(timeout=5)
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            c.close()
 
 
 def subscribe_rangefeed(addr, start=None, end=None, since: int = 0,
@@ -244,7 +281,11 @@ def subscribe_rangefeed(addr, start=None, end=None, since: int = 0,
     import socket
 
     from ..flow.dcn import _recv_msg, _send_msg
+    from ..utils import faults
 
+    # chaos site: a failed (re)subscription — the rangefeed restart path
+    # consumers must retry through (kvclient/rangefeed restart-on-error)
+    faults.fire("kv.rangefeed.subscribe")
     sock = socket.create_connection(tuple(addr))
     _send_msg(sock, json.dumps({
         "start": start.decode() if isinstance(start, bytes) else start,

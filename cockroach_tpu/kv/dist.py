@@ -238,6 +238,9 @@ class DistSender:
             try:
                 cur = store.check(d, key, None)
             except RangeKeyMismatchError:
+                # retry accounting is per-RANGE, not per-client: one hot
+                # range's churn shows up in its own counter
+                metric.RPC_RETRIES_BY_RANGE.inc(d.range_id)
                 self.cache.evict(d)
                 continue
             if cur.generation != d.generation or cur.end_key != d.end_key:
@@ -504,3 +507,81 @@ class DistSender:
             log.info(log.OPS, "range moved", range=range_id,
                      to_store=to_store, rows=n)
             return n
+
+
+class LeaseRouter:
+    """Leaseholder-aware RPC routing (the networked half of DistSender's
+    per-range transport, dist_sender.go's sendToReplicas + the
+    NotLeaseHolderError redirect loop).
+
+    Resolves a range's current leaseholder from gossip (`lease/<rid>`
+    infos the lease loop publishes), dials it through the NodeDialer,
+    and sends the batch range-addressed so the server's lease guard
+    fences stale holders. Reroute triggers — EpochFencedError /
+    NotLeaseHolderError (failover finished; re-resolve), transport
+    errors on read batches (reads are idempotent), breaker fast-fails —
+    spend the per-RANGE retry budget; when it runs dry the caller gets
+    RetryBudgetExhausted and must degrade, exactly the PR-1 flow
+    discipline. AmbiguousResultError propagates untouched: re-sending a
+    mutation under a fresh stamp is the double-apply this PR exists to
+    prevent."""
+
+    def __init__(self, gossip, dialer, budget=None,
+                 resolve_timeout_s: float = 5.0):
+        from ..utils import retry
+
+        self.gossip = gossip
+        self.dialer = dialer
+        self.budget = budget if budget is not None \
+            else retry.RangeRetryBudget()
+        self.resolve_timeout_s = resolve_timeout_s
+
+    def leaseholder(self, range_id: int) -> int | None:
+        """Gossip's view of the range's holder node id (None = unknown)."""
+        v = self.gossip.get_info(f"lease/{range_id}")
+        if v is None:
+            return None
+        nid, _, _epoch = str(v).partition(":")
+        try:
+            return int(nid)
+        except ValueError:
+            return None
+
+    def batch(self, range_id: int, requests: list[dict]) -> list[dict]:
+        import time as _time
+
+        from ..kv.liveness import EpochFencedError, NotLeaseHolderError
+        from ..kv.rpc import AmbiguousResultError
+        from .dialer import BreakerOpenError
+
+        deadline = _time.monotonic() + self.resolve_timeout_s
+        hint: int | None = None
+        last: Exception = KeyError(
+            f"no leaseholder known for r{range_id}")
+        while True:
+            nid = hint if hint is not None else self.leaseholder(range_id)
+            hint = None
+            if nid is not None:
+                try:
+                    client = self.dialer.dial(nid)
+                    out = client.batch(requests, range_id=range_id)
+                    self.dialer.report_ok(nid)
+                    return out
+                except AmbiguousResultError:
+                    raise  # typed ambiguity: never silently re-sent
+                except NotLeaseHolderError as e:
+                    last = e
+                    hint = e.holder  # redirect straight to the holder
+                except EpochFencedError as e:
+                    last = e  # stale route: wait out the failover
+                except BreakerOpenError as e:
+                    last = e
+                except (ConnectionError, OSError) as e:
+                    self.dialer.report_failure(nid)
+                    last = e
+            # a reroute costs one per-range retry token
+            # (RetryBudgetExhausted propagates: budget dry = degrade)
+            self.budget.spend(range_id)
+            if _time.monotonic() > deadline:
+                raise last
+            _time.sleep(0.05)  # let gossip/failover converge

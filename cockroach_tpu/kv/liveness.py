@@ -1,4 +1,4 @@
-"""Node liveness — the kvserver/liveness analog.
+"""Node liveness + epoch leases — the kvserver/liveness analog.
 
 Reference: liveness.go:241 NodeLiveness heartbeats an epoch-stamped record
 into the KV store; a record whose expiration passed marks the node dead,
@@ -9,6 +9,13 @@ KV surface (records in a reserved system keyspace), sized for the current
 single-process topology: multiple NodeLiveness instances sharing one DB
 behave like nodes sharing the liveness range, and the DCN flow server can
 carry heartbeats when multi-host lands.
+
+LeaseManager adds the epoch-lease half (replica_range_lease.go reduced):
+a range lease names (holder node, holder's liveness epoch); it is valid
+exactly while the holder's liveness record still carries that epoch.
+Failover = expire -> a peer bumps the epoch (the fencing write) -> the
+peer writes itself in as holder. A resurrected holder fails the epoch
+equality check and must re-acquire, never serve stale.
 """
 
 from __future__ import annotations
@@ -24,6 +31,9 @@ from .txn import DB, TransactionRetryError
 _PREFIX = b"\x01liv"
 _REC = struct.Struct("<qqq")  # epoch, expiration_ts, node_id
 
+_LEASE_PREFIX = b"\x01lse"
+_LEASE_REC = struct.Struct("<qqq")  # node_id, epoch, range_id
+
 
 class StillLiveError(Exception):
     """increment_epoch refused: the target's record has not expired."""
@@ -33,6 +43,25 @@ class EpochFencedError(Exception):
     """The node's epoch was incremented by a peer (it was declared dead):
     every lease it held under the old epoch is invalid and it must not
     heartbeat the old epoch back to life."""
+
+
+class NotLeaseHolderError(Exception):
+    """The addressed node does not hold the range's lease (kvpb's
+    NotLeaseHolderError): `holder` carries the current holder's node id
+    when known, so the client can reroute instead of guessing."""
+
+    def __init__(self, msg: str, holder: int | None = None):
+        super().__init__(msg)
+        self.holder = holder
+
+
+# fencing/routing errors cross the query error boundary unwrapped so
+# callers can key on the type (colexecerror.ExpectedError discipline)
+from ..utils.errors import register_passthrough as _rp  # noqa: E402
+
+_rp(StillLiveError)
+_rp(EpochFencedError)
+_rp(NotLeaseHolderError)
 
 
 @dataclass(frozen=True)
@@ -89,6 +118,14 @@ class NodeLiveness:
         """Extend this node's expiration under the epoch it believes it
         owns. Raises EpochFencedError if a peer incremented the epoch (the
         node was declared dead; its old leases are invalid)."""
+        from ..utils import faults
+
+        # chaos site: a blackholed heartbeat models the node losing its
+        # liveness range (network partition / stalled disk). Fires the
+        # node-scoped variant too so a test can kill ONE node's
+        # heartbeats while its peers keep renewing.
+        faults.fire_scoped("liveness.heartbeat", self.node_id)
+
         def op(t):
             cur = self._read(self.node_id, t)
             now = self.db.clock.now()
@@ -124,6 +161,12 @@ class NodeLiveness:
         """Declare a non-live node dead by bumping its epoch — the fencing
         write that invalidates its epoch-based leases. Refuses while the
         record is still live (liveness.go IncrementEpoch contract)."""
+        from ..utils import faults
+
+        # chaos site, scoped by the node DOING the bump (the fencer):
+        # models IncrementEpoch's CPut losing a race / failing transport
+        faults.fire_scoped("liveness.epoch_bump", self.node_id)
+
         def op(t):
             cur = self._read(node_id, t)
             if cur is None:
@@ -150,3 +193,107 @@ class NodeLiveness:
             epoch, exp, nid = _REC.unpack(v)
             out.append(LivenessRecord(nid, epoch, exp))
         return out
+
+
+@dataclass(frozen=True)
+class LeaseRecord:
+    range_id: int
+    node_id: int
+    epoch: int  # the holder's liveness epoch when the lease was written
+
+
+class LeaseManager:
+    """Epoch-based range leases over the liveness state machine
+    (replica_range_lease.go reduced to the epoch-lease case).
+
+    Invariant: a lease (holder, epoch) is valid exactly while the
+    holder's liveness record still carries `epoch`. Nobody ever checks
+    wall-clock expiration on the LEASE — fencing the liveness epoch is
+    the single source of truth, so clock skew between nodes can't let
+    two leaseholders coexist."""
+
+    def __init__(self, liveness: NodeLiveness):
+        self.liveness = liveness
+        self.db = liveness.db
+        self.node_id = liveness.node_id
+
+    @staticmethod
+    def _key(range_id: int) -> bytes:
+        return _LEASE_PREFIX + b"%05d" % range_id
+
+    def holder(self, range_id: int) -> LeaseRecord | None:
+        from ..utils.errors import retry_past_intents
+
+        v = retry_past_intents(lambda: self.db.get(self._key(range_id)))
+        if v is None:
+            return None
+        nid, epoch, rid = _LEASE_REC.unpack(v)
+        return LeaseRecord(rid, nid, epoch)
+
+    def acquire(self, range_id: int) -> LeaseRecord:
+        """Take (or renew) the range's lease for this node.
+
+        - vacant lease: write ourselves in under our current epoch;
+        - we already hold it: renew (rewrite under our current epoch);
+        - a LIVE peer holds it: NotLeaseHolderError (reroute, don't
+          steal);
+        - a dead/fenced peer holds it: bump its liveness epoch first —
+          the fencing write, so a resurrection can't serve under the
+          old lease — then write ourselves in (kv_lease_failovers
+          counts it)."""
+        from ..utils import metric
+
+        if self.liveness._my_epoch is None:
+            self.liveness.heartbeat()  # allocates/learns our epoch
+        my_epoch = self.liveness._my_epoch
+        cur = self.holder(range_id)
+        if cur is not None and cur.node_id != self.node_id:
+            rec = self.liveness._read(cur.node_id)
+            if (rec is not None and rec.epoch == cur.epoch
+                    and rec.live_at(self.db.clock.now())):
+                raise NotLeaseHolderError(
+                    f"r{range_id} lease held by live node {cur.node_id}",
+                    holder=cur.node_id)
+            if rec is not None and rec.epoch == cur.epoch:
+                # expired but not yet fenced: the epoch bump IS the
+                # fencing write (StillLiveError surfaces if the holder
+                # heartbeated between our check and the bump — callers
+                # treat that as "lost the failover race")
+                self.liveness.increment_epoch(cur.node_id)
+            metric.LEASE_FAILOVERS.inc()
+
+        def op(t):
+            # re-validate under the txn so a racing acquirer's write
+            # invalidates our read spans and retries/loses cleanly
+            v = t.get(self._key(range_id))
+            if v is not None:
+                nid, epoch, _ = _LEASE_REC.unpack(v)
+                if nid != self.node_id:
+                    rec = self.liveness._read(nid, t)
+                    if (rec is not None and rec.epoch == epoch
+                            and rec.live_at(self.db.clock.now())):
+                        raise NotLeaseHolderError(
+                            f"r{range_id} lease held by live node {nid}",
+                            holder=nid)
+            t.put(self._key(range_id),
+                  _LEASE_REC.pack(self.node_id, my_epoch, range_id))
+            return LeaseRecord(range_id, self.node_id, my_epoch)
+
+        return self.db.txn(op)
+
+    def check(self, range_id: int) -> None:
+        """Server-side serve guard: raises unless THIS node holds the
+        lease under its CURRENT liveness epoch. A fenced node (epoch
+        bumped while it was dark) fails the equality check no matter
+        what its local state claims — the resurrect-after-fence case."""
+        cur = self.holder(range_id)
+        if cur is None or cur.node_id != self.node_id:
+            raise NotLeaseHolderError(
+                f"r{range_id} not leased to node {self.node_id}",
+                holder=None if cur is None else cur.node_id)
+        rec = self.liveness._read(self.node_id)
+        if rec is None or rec.epoch != cur.epoch:
+            raise EpochFencedError(
+                f"node {self.node_id} serving r{range_id} under epoch "
+                f"{cur.epoch} but liveness is at "
+                f"{None if rec is None else rec.epoch}")

@@ -27,12 +27,26 @@ from .txn import DB
 
 
 class ReplicationStream:
+    """Reconnect discipline: a severed stream re-subscribes from the
+    FRONTIER with exponential backoff through the shared retry policy
+    (utils/retry.py) instead of dying on the first transport error — the
+    reference's rangefeed restarts the same way. Events between the
+    frontier and the cut may re-deliver; applies are byte-exact at their
+    original (key, ts), so a re-apply lays an identical version and
+    reads are unchanged (MVCC idempotence). Only retry exhaustion or a
+    non-transport error parks in ``self.error``."""
+
     def __init__(self, src_addr, dst_db: DB,
                  start: bytes | None = None, end: bytes | None = None,
-                 since: int = 0):
+                 since: int = 0, reconnect_attempts: int = 6):
+        self.src_addr = tuple(src_addr)
+        self.start = start
+        self.end = end
         self.dst = dst_db
         self.frontier = int(since)
         self.applied = 0
+        self.reconnects = 0
+        self.reconnect_attempts = int(reconnect_attempts)
         self._stop = threading.Event()
         self._sock, self._frames = subscribe_rangefeed(
             src_addr, start=start, end=end, since=since, raw=True)
@@ -56,20 +70,58 @@ class ReplicationStream:
         self.dst.clock.update(ts)
         self.applied += 1
 
-    def run(self) -> None:
-        """Consume frames until stopped (or the source closes)."""
+    def _resubscribe(self) -> None:
         try:
-            for frame in self._frames:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock, self._frames = subscribe_rangefeed(
+            self.src_addr, start=self.start, end=self.end,
+            since=self.frontier, raw=True)
+
+    def run(self) -> None:
+        """Consume frames until stopped; reconnect through severed
+        streams (see class docstring)."""
+        from ..utils import metric, retry
+
+        try:
+            while not self._stop.is_set():
+                for frame in self._frames:
+                    if self._stop.is_set():
+                        return
+                    if "resolved" in frame:
+                        self.frontier = max(self.frontier,
+                                            int(frame["resolved"]))
+                    else:
+                        self._apply(frame)
+                # the frame iterator ended: cutover closing our socket
+                # (clean stop) or the source died mid-stream. Re-dial
+                # from the frontier under backoff; exhaustion parks the
+                # last transport error for the consumer to see.
                 if self._stop.is_set():
                     return
-                if "resolved" in frame:
-                    self.frontier = max(self.frontier,
-                                        int(frame["resolved"]))
-                else:
-                    self._apply(frame)
+                retry.call(
+                    self._resubscribe,
+                    retry.Backoff(max_attempts=self.reconnect_attempts,
+                                  initial_s=0.05),
+                    retryable=retry.is_retryable,
+                )
+                self.reconnects += 1
+                metric.REPLICATION_RECONNECTS.inc()
         except BaseException as e:
-            self.error = e
-            raise
+            if not self._stop.is_set():
+                self.error = e
+                raise
+            # stopping raced a reconnect attempt: a transport error here
+            # is teardown noise, not a stream failure
+        finally:
+            if self._stop.is_set():
+                # a resubscribe may have raced cutover's socket close and
+                # opened a fresh connection — never leak it
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
 
     def run_background(self) -> "ReplicationStream":
         self._thread = threading.Thread(target=self.run, daemon=True,

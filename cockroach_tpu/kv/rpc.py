@@ -19,13 +19,39 @@ batches (the BatchStream shape).
 from __future__ import annotations
 
 import base64
+import itertools
 import json
 import socket
 import threading
+import uuid
 
 from ..storage.lsm import WriteIntentError
+from ..utils.errors import register_passthrough
 from ..utils.faults import InjectedFault
+from .liveness import EpochFencedError, NotLeaseHolderError
 from .txn import DB
+
+# leaseholder-guard errors travel as typed codes named after the class
+_LEASE_ERRORS = (EpochFencedError, NotLeaseHolderError)
+
+
+class AmbiguousResultError(RuntimeError):
+    """A mutation batch's apply state is unknowable (kvpb's
+    AmbiguousResultError): every transport retry failed, and the last
+    attempt may or may not have been applied server-side. Deliberately
+    NOT a ConnectionError — no layer may silently retry past this; the
+    caller must read to disambiguate or surface it to the application."""
+
+    def __init__(self, msg: str, cid: str | None = None,
+                 seq: int | None = None):
+        super().__init__(msg)
+        self.cid = cid
+        self.seq = seq
+
+
+register_passthrough(AmbiguousResultError)
+
+_client_ids = itertools.count(1)
 
 
 def _b64(b: bytes | None) -> str | None:
@@ -35,12 +61,20 @@ def _b64(b: bytes | None) -> str | None:
 def _unb64(s: str | None) -> bytes | None:
     return None if s is None else base64.b64decode(s)
 
+_MUTATION_OPS = frozenset(("put", "delete"))
+
 
 class BatchServer:
     """Serve Batch RPCs against one DB (Node.Batch -> Store.Send role)."""
 
-    def __init__(self, db: DB, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, db: DB, host: str = "127.0.0.1", port: int = 0,
+                 lease_check=None):
         self.db = db
+        # optional leaseholder guard: called with the decoded request
+        # before mutation batches evaluate; raises EpochFencedError /
+        # NotLeaseHolderError (kv/liveness.py) which travel to the client
+        # as typed codes. Node wires this to its LeaseManager.
+        self.lease_check = lease_check
         # SO_REUSEADDR so a restart rebinds the port while the previous
         # incarnation's conns sit in TIME_WAIT (create_server sets it on
         # POSIX; made explicit because restart-on-same-port is contract)
@@ -87,10 +121,21 @@ class BatchServer:
                 try:
                     req = json.loads(msg.decode("utf-8"))
                     resp = self._eval_batch(req)
+                    # post-apply response loss (the ambiguous-result
+                    # window): the batch IS applied, the client never
+                    # hears back. A `drop` here severs the stream; the
+                    # retry must hit the replay cache, not re-apply.
+                    from ..utils import faults
+
+                    faults.fire("kv.rpc.server.respond")
                 except InjectedFault as e:
                     if e.kind == "drop":
                         raise  # sever the stream, like a crashed replica
                     resp = {"error": str(e), "code": "Internal"}
+                except _LEASE_ERRORS as e:
+                    resp = {"error": str(e),
+                            "code": type(e).__name__,
+                            "holder": getattr(e, "holder", None)}
                 except WriteIntentError as e:
                     # carry the REAL conflicting keys/txns: clients format
                     # them into user errors and conflict handling keys on
@@ -117,6 +162,13 @@ class BatchServer:
         # BEFORE any sub-request touches the store, so a dropped batch is
         # all-or-nothing and a retry replays it exactly
         faults.fire("kv.rpc.server.eval")
+        reqs = req.get("requests", ())
+        if self.lease_check is not None and any(
+                r["op"] in _MUTATION_OPS for r in reqs):
+            self.lease_check(req)
+        if req.get("cid") is not None and reqs and all(
+                r["op"] in _MUTATION_OPS for r in reqs):
+            return self._eval_stamped_mutations(req)
         out = []
         for r in req.get("requests", ()):
             op = r["op"]
@@ -138,6 +190,40 @@ class BatchServer:
             else:
                 raise ValueError(f"unknown batch op {op!r}")
         return {"responses": out}
+
+    def _eval_stamped_mutations(self, req: dict) -> dict:
+        """Exactly-once path for (cid, seq)-stamped mutation-only batches.
+
+        Under the engine mutex: a replay-cache hit returns the FIRST
+        attempt's response verbatim (the retry crossed a severed-response
+        or restart window — applying again would double-write); a miss
+        evaluates every mutation, then lands ops + dedup entry + response
+        in one atomic WAL record via Engine.apply_rpc_batch. Reads and
+        mixed batches take the legacy path above: reads are idempotent,
+        so only mutations need replay protection (kvserver's replay
+        protection covers writes for the same reason)."""
+        from ..utils import metric
+
+        db = self.db
+        cid, seq = req["cid"], int(req["seq"])
+        with db.engine.mu:
+            cached = db.engine.replay_cache_get(cid, seq)
+            if cached is not None:
+                metric.REPLAY_CACHE_HITS.inc()
+                return cached
+            muts, out = [], []
+            for r in req["requests"]:
+                k = _unb64(r["key"])
+                db._check_lock(k)  # WriteIntentError surfaces typed
+                ts = db.clock.now()
+                if r["op"] == "put":
+                    muts.append((k, _unb64(r["value"]), ts, 0, False))
+                else:
+                    muts.append((k, b"", ts, 0, True))
+                out.append({"ts": ts})
+            resp = {"responses": out}
+            db.engine.apply_rpc_batch(cid, seq, muts, resp)
+        return resp
 
     def close(self):
         """Idempotent full teardown: stop accepting, sever every accepted
@@ -178,9 +264,14 @@ class BatchClient:
     with exponential backoff + jitter (rpc.batch.max_retries attempts).
     Typed SERVER answers (WriteIntentError, Internal) are never retried
     here: the txn layer owns intent waits, and hard errors must surface.
-    A re-sent batch may double-apply if the failure hit after evaluation
-    (the reference's AmbiguousResultError window); sub-requests are
-    MVCC-idempotent enough for the non-txn surface this serves."""
+
+    Exactly-once writes: every mutation-only batch is stamped with this
+    client's id and a per-batch sequence number. A retry re-sends the
+    SAME stamp, so a failure after server-side evaluation (severed
+    response, server restart) dedups against the server's WAL-persisted
+    replay cache instead of double-applying. When retries exhaust with
+    the apply state still unknown, the client raises a typed
+    AmbiguousResultError — never a silent retry, never a silent drop."""
 
     def __init__(self, addr, deadline_s: float | None = None,
                  max_retries: int | None = None):
@@ -191,6 +282,11 @@ class BatchClient:
                           else settings.get("rpc.batch.deadline_s"))
         self.max_retries = (max_retries if max_retries is not None
                             else settings.get("rpc.batch.max_retries"))
+        # globally unique client id: the replay cache keys dedup entries
+        # on it, so two clients must never collide (uuid covers
+        # multi-process; the counter disambiguates within-process)
+        self.cid = f"{uuid.uuid4().hex[:12]}-{next(_client_ids)}"
+        self._seq = itertools.count(1)
         self._sock = self._dial()
         self._lock = threading.Lock()
 
@@ -212,11 +308,28 @@ class BatchClient:
         return isinstance(e, (ConnectionError, socket.timeout,
                               TimeoutError, OSError))
 
-    def batch(self, requests: list[dict]) -> list[dict]:
+    def batch(self, requests: list[dict],
+              range_id: int | None = None) -> list[dict]:
         from ..utils import faults, metric, retry
         from ..flow.dcn import _recv_msg, _send_msg
 
-        payload = json.dumps({"requests": requests}).encode("utf-8")
+        envelope: dict = {"requests": requests}
+        if range_id is not None:
+            # range-addressed batch: the server's lease guard verifies it
+            # still holds this range's epoch lease before mutating
+            envelope["range"] = int(range_id)
+        # stamp mutation-only batches: the (cid, seq) token is allocated
+        # ONCE here, so every transport retry below re-sends the same
+        # token and the server can dedup (reads stay unstamped — they
+        # are idempotent and must not occupy the one-entry window)
+        stamped = bool(requests) and all(
+            r["op"] in _MUTATION_OPS for r in requests)
+        seq = None
+        if stamped:
+            seq = next(self._seq)
+            envelope["cid"] = self.cid
+            envelope["seq"] = seq
+        payload = json.dumps(envelope).encode("utf-8")
 
         def send_once():
             with self._lock:  # one in-flight batch per connection
@@ -240,19 +353,40 @@ class BatchClient:
                 raise ConnectionError("batch server closed the stream")
             return msg
 
-        msg = retry.call(
-            send_once,
-            retry.Backoff(max_attempts=self.max_retries,
-                          deadline_s=self.deadline_s * self.max_retries),
-            retryable=self._transport_error,
-        )
+        try:
+            msg = retry.call(
+                send_once,
+                retry.Backoff(max_attempts=self.max_retries,
+                              deadline_s=self.deadline_s * self.max_retries),
+                retryable=self._transport_error,
+            )
+        except Exception as e:
+            if stamped and self._transport_error(e):
+                # retries exhausted mid-mutation: the batch may or may
+                # not have applied, and nothing below can find out.
+                # Surface a typed ambiguity instead of letting a
+                # ConnectionError tempt an outer layer into re-sending
+                # under a FRESH seq (which WOULD double-apply).
+                metric.AMBIGUOUS_RESULTS.inc()
+                raise AmbiguousResultError(
+                    f"mutation batch (cid={self.cid}, seq={seq}) against "
+                    f"{self.addr}: transport failed after "
+                    f"{self.max_retries} attempts; apply state unknown",
+                    cid=self.cid, seq=seq) from e
+            raise
         resp = json.loads(msg.decode("utf-8"))
         if "error" in resp:
-            if resp.get("code") == "WriteIntentError":
+            code = resp.get("code")
+            if code == "WriteIntentError":
                 raise WriteIntentError(
                     [_unb64(k) for k in resp.get("keys", [])],
                     resp.get("txns", []),
                 )
+            if code == "EpochFencedError":
+                raise EpochFencedError(resp["error"])
+            if code == "NotLeaseHolderError":
+                raise NotLeaseHolderError(
+                    resp["error"], holder=resp.get("holder"))
             raise RuntimeError(f"batch rpc failed: {resp['error']}")
         return resp["responses"]
 

@@ -245,7 +245,13 @@ class DB:
     def txn(self, fn, max_retries: int = 16):
         """Run fn(txn) with commit; retry on TransactionRetryError with a
         fresh timestamp (the kv.DB.Txn closure contract: fn must be
-        idempotent across retries)."""
+        idempotent across retries).
+
+        AmbiguousResultError (kv/rpc.py) is deliberately NOT retried:
+        when a remote mutation's apply state is unknowable, re-running
+        the closure could commit it twice. It rolls back local intents
+        and surfaces — the application decides whether to read-verify
+        and resume (TxnCoordSender surfaces ambiguity the same way)."""
         for _ in range(max_retries):
             t = self.new_txn()
             try:

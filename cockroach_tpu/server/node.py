@@ -29,7 +29,7 @@ import threading
 
 from ..kv import DB, Clock
 from ..kv.jobs import Registry, register_builtin_jobs
-from ..kv.liveness import NodeLiveness
+from ..kv.liveness import LeaseManager, NodeLiveness
 from ..kv.tsdb import TimeSeriesDB
 from ..storage.lsm import Engine
 from ..utils import log, metric, settings
@@ -46,6 +46,7 @@ class Node:
         metrics_interval_s: float = 0.5,
         adopt_interval_s: float = 0.5,
         gossip_peers: list | None = None,
+        lease_ranges: list[int] | None = None,
     ):
         self.node_id = int(node_id)
         self.db = db if db is not None else DB(
@@ -60,6 +61,12 @@ class Node:
             heartbeat_interval_ms=int(heartbeat_interval_s * 1000),
             ttl_ms=ttl_ms,
         )
+        # epoch leases: the node competes for every range in lease_ranges
+        # (replica_range_lease acquisition loop); a vacant or dead-holder
+        # lease is taken after fencing the holder's liveness epoch
+        self.leases = LeaseManager(self.liveness)
+        self._lease_ranges = list(lease_ranges or [])
+        self._advertised_leases: dict[int, tuple[int, int]] = {}
         self.jobs = Registry(self.db, node_id=self.node_id,
                              liveness=self.liveness)
         register_builtin_jobs(self.jobs)
@@ -119,8 +126,14 @@ class Node:
         if kv_port is not None:
             from ..kv.rpc import BatchServer
 
-            # the Internal.Batch endpoint (server/node.go Node.Batch role)
-            self.kv_rpc = BatchServer(self.db, port=kv_port)
+            # the Internal.Batch endpoint (server/node.go Node.Batch role).
+            # Range-addressed mutation batches are guarded by the lease
+            # check: a fenced node answers EpochFencedError instead of
+            # serving writes under an epoch it no longer owns.
+            self.kv_rpc = BatchServer(self.db, port=kv_port,
+                                      lease_check=self._lease_check)
+        if self._lease_ranges:
+            self._spawn(self._lease_loop, "lease-acquire")
 
         self.dialer = None
 
@@ -216,6 +229,71 @@ class Node:
                 return
             except TransactionRetryError:
                 continue  # contended heartbeat key; next tick retries
+            except (ConnectionError, OSError):
+                # blackholed heartbeat (liveness.heartbeat fault or a real
+                # partition): the record silently ages toward expiry while
+                # the node keeps trying — exactly the reference's behavior
+                # when a node loses the liveness range
+                continue
+
+    # -- leases ---------------------------------------------------------------
+
+    def _lease_check(self, req: dict) -> None:
+        """BatchServer guard for range-addressed mutation batches: raises
+        EpochFencedError / NotLeaseHolderError when this node may not
+        serve the range. Batches without a range address (plain
+        BatchClient traffic) bypass the guard — single-node topologies
+        have no lease protocol to honor."""
+        from ..kv.liveness import NotLeaseHolderError
+        from ..storage.lsm import WriteIntentError
+
+        rid = req.get("range")
+        if rid is not None:
+            try:
+                self.leases.check(int(rid))
+            except WriteIntentError as e:
+                # lease/liveness record mid-commit (a heartbeat or a
+                # failover's fencing write): lease state is UNRESOLVED,
+                # and the only safe answer is "don't serve" — typed so
+                # the router re-resolves and retries instead of
+                # surfacing a storage-level error to the application
+                raise NotLeaseHolderError(
+                    f"r{rid} lease state unresolved (record mid-commit); "
+                    f"retry") from e
+
+    def _lease_loop(self) -> None:
+        from ..kv.liveness import NotLeaseHolderError, StillLiveError
+        from ..kv.txn import TransactionRetryError
+        from ..storage.lsm import WriteIntentError
+
+        while not self._stop.wait(self._hb_interval):
+            for rid in self._lease_ranges:
+                try:
+                    prev = self.leases.holder(rid)
+                    rec = self.leases.acquire(rid)
+                except NotLeaseHolderError:
+                    continue  # a live peer holds it; that's healthy
+                except (StillLiveError, TransactionRetryError):
+                    continue  # lost a failover race; next tick re-reads
+                except WriteIntentError:
+                    continue  # a peer's lease write mid-commit; next tick
+                except (ConnectionError, OSError):
+                    continue  # injected epoch_bump/transport fault
+                except Exception as e:  # noqa: BLE001 - loop must survive
+                    log.warning(log.OPS, "lease acquire failed",
+                                range=rid, error=str(e))
+                    continue
+                if (prev is not None and prev.node_id != self.node_id
+                        and self.gossip is not None):
+                    # we just fenced the old holder: its gossiped state
+                    # is stale under the bumped epoch — expire it
+                    self.gossip.note_epoch(prev.node_id, prev.epoch + 1)
+                ad = (rec.node_id, rec.epoch)
+                if (self._advertised_leases.get(rid) != ad
+                        and self.gossip is not None):
+                    self.gossip.add_info(f"lease/{rid}",
+                                         f"{rec.node_id}:{rec.epoch}")
+                    self._advertised_leases[rid] = ad
 
     def _metrics_loop(self) -> None:
         while not self._stop.wait(self._metrics_interval):

@@ -28,7 +28,9 @@ txn's visible intent raises WriteIntentError, like the reference.
 
 from __future__ import annotations
 
+import base64
 import functools
+import json
 import os
 import struct
 import threading
@@ -61,6 +63,15 @@ _REC_INGEST = 2
 # means open-ended) — the replica-removal half
 _REC_IMPORT = 3
 _REC_CLEAR = 4
+# batch records carry an ENTIRE stamped RPC mutation batch — ops, the
+# (client id, sequence) dedup token, and the wire response — in one
+# record. The torn-tail truncation of _arm_wal makes the record
+# all-or-nothing across a crash, which is exactly the atomicity the
+# exactly-once protocol needs: either the ops AND the replay-cache
+# entry survive (a retry dedups) or neither does (a retry re-applies
+# onto a store that never saw the batch). There is no window where the
+# ops landed but the dedup entry didn't.
+_REC_BATCH = 5
 
 
 def _words_to_bytes(words) -> bytes:
@@ -322,6 +333,13 @@ class Engine:
         # blobs are reclaimed only by checkpoint+reopen (value-log GC is
         # out of scope, like pebble's is a separate subsystem).
         self._blob = bytearray()
+        # RPC replay cache (exactly-once writes): client id -> (last seq,
+        # wire response). BatchClient serializes batches per connection,
+        # so a window of ONE entry per client suffices — a retry can only
+        # ever be for the newest seq. Entries persist via _REC_BATCH WAL
+        # records and checkpoint side files; bounded at
+        # _REPLAY_CACHE_MAX_CLIENTS with oldest-client eviction.
+        self._replay_cache: dict[str, tuple[int, object]] = {}
         # durable write-ahead log
         self.wal_path = wal_path
         self.wal_fsync = wal_fsync
@@ -469,6 +487,8 @@ class Engine:
                 elif kind == _REC_CLEAR:
                     self.clear_span(key or None,
                                     value if flag else None)
+                elif kind == _REC_BATCH:
+                    self._replay_batch_record(seq, value)
                 elif seq > self._seq:
                     self._raw_append(key, value, ts, seq, txn, bool(flag))
         finally:
@@ -550,6 +570,84 @@ class Engine:
         self.mem.tomb.append(tomb)
         self.mem.value.append(v)
         self.mem.vlen.append(n)
+
+    # -- exactly-once RPC batches -------------------------------------------
+    # (kvserver's replay protection reduced: the server consults this
+    # cache before evaluating a stamped mutation batch, and the batch's
+    # ops + dedup token + response persist in ONE atomic WAL record.)
+
+    _REPLAY_CACHE_MAX_CLIENTS = 1024
+
+    @_locked
+    def replay_cache_get(self, cid: str, seq: int):
+        """The cached wire response if (cid, seq) already applied, else
+        None. A hit means the client's retry crossed a window where the
+        first attempt DID land (severed response, server restart)."""
+        ent = self._replay_cache.get(cid)
+        if ent is not None and ent[0] == seq:
+            return ent[1]
+        return None
+
+    def _set_replay_entry(self, cid: str, seq: int, resp) -> None:
+        self._replay_cache.pop(cid, None)  # reinsert = refresh LRU order
+        while len(self._replay_cache) >= self._REPLAY_CACHE_MAX_CLIENTS:
+            self._replay_cache.pop(next(iter(self._replay_cache)))
+        self._replay_cache[cid] = (int(seq), resp)
+
+    @_locked
+    def apply_rpc_batch(self, cid: str, seq: int, muts, resp) -> None:
+        """Apply a stamped mutation batch exactly once.
+
+        muts: [(key bytes, value bytes, ts, txn, tomb), ...] as evaluated
+        by the RPC server; resp: the JSON-serializable wire response to
+        replay on a dedup hit. One _REC_BATCH WAL record covers ops +
+        dedup entry + response, so crash recovery can never disagree with
+        itself about whether the batch applied (see _REC_BATCH note)."""
+        from ..utils import metric
+
+        for k, v, _ts, _txn, _tomb in muts:
+            if b"\x00" in k:
+                raise ValueError(f"key must not contain 0x00 bytes: {k!r}")
+            if len(k) > self.key_width:
+                raise ValueError(
+                    f"key too long ({len(k)} > {self.key_width})")
+            if len(v) > self.val_width and self.val_width < 8:
+                raise ValueError(
+                    f"value of {len(v)} bytes needs the overflow heap, "
+                    f"which requires val_width >= 8 (have {self.val_width})")
+        self.governor.pace_write()
+        base = self._seq + 1
+        if self._wal is not None:
+            payload = json.dumps({
+                "cid": cid, "seq": int(seq),
+                "muts": [[base64.b64encode(k).decode(),
+                          base64.b64encode(v).decode(),
+                          int(ts), int(txn), bool(tomb)]
+                         for k, v, ts, txn, tomb in muts],
+                "resp": resp,
+            }).encode()
+            # klen/vlen are uint16: struct.pack rejects a batch payload
+            # past 64 KiB, surfacing as a typed error before any byte of
+            # WAL or memtable state changes
+            self._wal_record(_REC_BATCH, b"", payload, 0, base, 0, False)
+        for i, (k, v, ts, txn, tomb) in enumerate(muts):
+            metric.ENGINE_WRITES.inc()
+            self._raw_append(k, v, int(ts), base + i, int(txn), bool(tomb))
+        self._set_replay_entry(cid, seq, resp)
+        if len(self.mem) >= self.memtable_size:
+            self.flush()
+
+    def _replay_batch_record(self, seq: int, value: bytes) -> None:
+        """WAL-replay half of apply_rpc_batch: re-apply ops above the seq
+        high-water mark and ALWAYS restore the dedup entry (last record
+        per client wins, matching log order)."""
+        ent = json.loads(value.decode())
+        if seq > self._seq:
+            for i, (k64, v64, ts, txn, tomb) in enumerate(ent["muts"]):
+                self._raw_append(
+                    base64.b64decode(k64), base64.b64decode(v64),
+                    int(ts), seq + i, int(txn), bool(tomb))
+        self._set_replay_entry(ent["cid"], int(ent["seq"]), ent["resp"])
 
     def _resolve_value(self, row: np.ndarray, n: int) -> bytes:
         """Inline slot bytes + logical length -> the stored value (follows
@@ -1454,6 +1552,15 @@ class Engine:
                 f.write(bytes(self._blob))
                 f.flush()
                 os.fsync(f.fileno())
+        if self._replay_cache:
+            # checkpoint truncates the WAL, which held the only durable
+            # copy of the dedup entries — persist them alongside the runs
+            # or a post-restore retry would double-apply
+            with open(os.path.join(path, "replay_cache.json"), "w") as f:
+                json.dump({cid: [s, r] for cid, (s, r)
+                           in self._replay_cache.items()}, f)
+                f.flush()
+                os.fsync(f.fileno())
         with open(os.path.join(path, "MANIFEST"), "w") as f:
             f.write(f"{len(self.runs)} {self.key_width} {self.val_width}\n")
             f.flush()
@@ -1489,6 +1596,11 @@ class Engine:
         if os.path.exists(blob_path):
             with open(blob_path, "rb") as f:
                 eng._blob = bytearray(f.read())
+        rc_path = os.path.join(path, "replay_cache.json")
+        if os.path.exists(rc_path):
+            with open(rc_path) as f:
+                eng._replay_cache = {
+                    cid: (int(s), r) for cid, (s, r) in json.load(f).items()}
         for i in range(nruns):
             z = np.load(os.path.join(path, f"run{i:04d}.npz"))
             eng.runs.append(

@@ -20,6 +20,21 @@ Reference mapping (each named site's CockroachDB analogue):
   crash), error models EIO.
 - ``storage.wal.fsync``     — fsync stall/failure (pebble's
   WALFailover trigger condition).
+- ``kv.rpc.server.respond`` — the server applied the batch but the
+  response never reached the client (the classic ambiguous-result
+  window: kvcoord's sendError after a successful proposal). `drop`
+  severs the stream post-apply.
+- ``liveness.heartbeat``    — node-liveness heartbeat failures
+  (liveness.go's heartbeat RPC timing out / losing the disk). Sites
+  also fire a node-scoped variant ``liveness.heartbeat.n<id>`` so a
+  test can blackhole ONE node's heartbeats while others stay live.
+- ``liveness.epoch_bump``   — the IncrementEpoch CPut failing
+  (liveness.go's IncrementEpoch contention path). Node-scoped variant
+  ``liveness.epoch_bump.n<id>`` keyed by the node DOING the bump.
+- ``gossip.broadcast``      — gossip exchange failures (gossip.go's
+  client connect/send errors). Node-scoped ``gossip.broadcast.n<id>``.
+- ``kv.rangefeed.subscribe`` — rangefeed (re)subscription failures
+  (kvclient/rangefeed's restart-on-error discipline).
 
 Discipline: everything is OFF unless ``fault.injection.enabled`` is set
 AND the test armed specs via :func:`arm`. Firing decisions come from ONE
@@ -30,6 +45,7 @@ when disarmed — production paths pay nothing.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -81,6 +97,10 @@ def arm(seed: int, specs: dict[str, FaultSpec]) -> None:
     from . import settings
 
     global _armed, _rng
+    # The chaos matrix runner (scripts/run_chaos_matrix.py) perturbs every
+    # in-test seed through the environment so one pytest invocation can be
+    # replayed across N distinct seeds without editing the tests.
+    seed += int(os.environ.get("CHAOS_SEED_OFFSET", "0"))
     with _lock:
         _rng = random.Random(seed)
         _specs.clear()
@@ -121,6 +141,17 @@ def fire(site: str) -> None:
         time.sleep(spec.delay_s)
         return
     raise InjectedFault(site, spec.kind)
+
+
+def fire_scoped(site: str, node_id: int) -> None:
+    """Fire a site that exists per-node: checks the generic name AND the
+    node-scoped ``<site>.n<id>`` variant. Tests arm whichever granularity
+    they need — the generic name hits every node, the scoped name
+    blackholes exactly one (the registry is process-global, so without
+    scoping a heartbeat fault would kill every node in a multi-node
+    test)."""
+    fire(site)
+    fire(f"{site}.n{node_id}")
 
 
 def partial_fraction(site: str) -> float | None:

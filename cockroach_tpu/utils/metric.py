@@ -71,6 +71,43 @@ class Histogram:
             self.n += 1
 
 
+class LabeledCounter:
+    """Counter family keyed by one label (metric.Counter vector reduced).
+
+    Mirrors the reference's per-range metric families: one logical name,
+    one label dimension (e.g. range), a child Counter per observed label
+    value. scrape() renders ``name{label="v"} n`` lines."""
+
+    def __init__(self, name: str, label: str, help: str = ""):
+        self.name = name
+        self.label = label
+        self.help = help
+        self._children: dict[str, Counter] = {}
+        self._lock = threading.Lock()
+
+    def child(self, label_value) -> Counter:
+        key = str(label_value)
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                c = self._children[key] = Counter(self.name)
+            return c
+
+    def inc(self, label_value, delta: float = 1.0) -> None:
+        self.child(label_value).inc(delta)
+
+    def value(self, label_value) -> float:
+        return self.child(label_value).value
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(c.value for c in self._children.values())
+
+    def items(self) -> list[tuple[str, float]]:
+        with self._lock:
+            return sorted((k, c.value) for k, c in self._children.items())
+
+
 class Registry:
     """Named metric collection (metric.Registry). Subsystems register at
     construction; scrape() renders prometheus text exposition."""
@@ -87,6 +124,11 @@ class Registry:
 
     def histogram(self, name: str, help: str = "", **kw) -> Histogram:
         return self._get_or_add(name, lambda: Histogram(name, help, **kw))
+
+    def labeled_counter(self, name: str, label: str,
+                        help: str = "") -> LabeledCounter:
+        return self._get_or_add(
+            name, lambda: LabeledCounter(name, label, help))
 
     def _get_or_add(self, name: str, make):
         with self._lock:
@@ -106,6 +148,10 @@ class Registry:
             elif isinstance(m, Gauge):
                 out.append(f"# TYPE {name} gauge")
                 out.append(f"{name} {m.value:g}")
+            elif isinstance(m, LabeledCounter):
+                out.append(f"# TYPE {name} counter")
+                for k, v in m.items():
+                    out.append(f'{name}{{{m.label}="{k}"}} {v:g}')
             elif isinstance(m, Histogram):
                 out.append(f"# TYPE {name} histogram")
                 cum = 0
@@ -165,3 +211,24 @@ BREAKER_TRIPS = DEFAULT.counter(
 RANGE_CACHE_EVICTIONS = DEFAULT.counter(
     "range_cache_evictions",
     "stale range-descriptor cache entries evicted after mismatches")
+REPLAY_CACHE_HITS = DEFAULT.counter(
+    "kv_replay_cache_hits",
+    "retried mutation batches deduplicated by the server replay cache")
+AMBIGUOUS_RESULTS = DEFAULT.counter(
+    "kv_ambiguous_results",
+    "mutation batches whose apply state was unknowable after retries")
+RPC_RETRIES_BY_RANGE = DEFAULT.labeled_counter(
+    "rpc_retries_by_range", "range",
+    "RPC retries attributed to the range being addressed")
+RPC_RETRY_BUDGET_EXHAUSTED = DEFAULT.counter(
+    "rpc_retry_budget_exhausted",
+    "RPCs abandoned because their range's retry budget ran dry")
+LEASE_FAILOVERS = DEFAULT.counter(
+    "kv_lease_failovers",
+    "range leases transferred after epoch-fencing an expired holder")
+GOSSIP_INFOS_EVICTED = DEFAULT.counter(
+    "gossip_infos_evicted",
+    "gossip infos dropped by the bound or by liveness-epoch expiry")
+REPLICATION_RECONNECTS = DEFAULT.counter(
+    "replication_stream_reconnects",
+    "replication streams re-subscribed after a transport error")

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import random
 import socket
+import threading
 import time
 
 
@@ -88,6 +89,64 @@ def is_retryable(e: BaseException) -> bool:
         # cooldown for the half-open probe to be admitted
         return True
     return False
+
+
+class RetryBudgetExhausted(RuntimeError):
+    """A range's retry budget ran dry — the caller must degrade (or
+    surface the last transport error) instead of hammering the range.
+    Deliberately NOT a ConnectionError: an exhausted budget is a hard
+    stop, never itself retried."""
+
+    def __init__(self, range_id: int, spent: int):
+        super().__init__(
+            f"retry budget exhausted for r{range_id} after {spent} retries")
+        self.range_id = range_id
+        self.spent = spent
+
+
+class RangeRetryBudget:
+    """Per-range retry accounting (moves the budget off the client).
+
+    Reference: kvcoord tracks send failures per range/replica rather than
+    per client, so one hot range cannot starve every other range's
+    retries and a single range's flapping is visible in metrics. Each
+    range gets `budget` retry tokens refilled at `refill_per_s`; spending
+    past zero raises RetryBudgetExhausted and bumps
+    `rpc_retry_budget_exhausted`. Every spend is attributed to the range
+    in the `rpc_retries_by_range` labeled counter."""
+
+    def __init__(self, budget: float = 16.0, refill_per_s: float = 4.0):
+        self.budget = float(budget)
+        self.refill_per_s = float(refill_per_s)
+        self._tokens: dict[int, float] = {}
+        self._stamp: dict[int, float] = {}
+        self._lock = threading.Lock()
+
+    def _refill(self, range_id: int, now: float) -> float:
+        tokens = self._tokens.get(range_id, self.budget)
+        last = self._stamp.get(range_id, now)
+        tokens = min(self.budget, tokens + (now - last) * self.refill_per_s)
+        self._stamp[range_id] = now
+        return tokens
+
+    def spend(self, range_id: int) -> None:
+        """Account one retry against the range. Raises when dry."""
+        from . import metric
+
+        with self._lock:
+            now = time.monotonic()
+            tokens = self._refill(range_id, now)
+            if tokens < 1.0:
+                metric.RPC_RETRY_BUDGET_EXHAUSTED.inc()
+                spent = int(self.budget)
+                self._tokens[range_id] = tokens
+                raise RetryBudgetExhausted(range_id, spent)
+            self._tokens[range_id] = tokens - 1.0
+        metric.RPC_RETRIES_BY_RANGE.inc(range_id)
+
+    def remaining(self, range_id: int) -> float:
+        with self._lock:
+            return self._refill(range_id, time.monotonic())
 
 
 def call(fn, policy: Backoff | None = None, retryable=is_retryable,

@@ -1,0 +1,72 @@
+"""Seed-matrix chaos runner — sweep the chaos suite across fault seeds.
+
+Every chaos test arms the fault registry with a FIXED per-test seed, so
+one pytest run exercises one deterministic fault schedule. This driver
+re-runs the whole suite N times with CHAOS_SEED_OFFSET=0..N-1 — the
+registry adds the offset to every armed seed (utils/faults.arm), so each
+pass fires a DIFFERENT deterministic schedule while staying replayable:
+a failing offset reproduces with the same command.
+
+Usage:
+    python scripts/run_chaos_matrix.py [--seeds N] [--offset-base K]
+
+Exit code is non-zero if ANY seed fails; the failing offsets print so
+the exact schedule can be replayed with
+    CHAOS_SEED_OFFSET=<off> pytest -m 'chaos and not slow'
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_matrix(offsets, extra_args=(), quiet: bool = False) -> list[int]:
+    """Run the fast chaos suite once per seed offset; returns the list of
+    offsets that FAILED (empty = the whole matrix converged)."""
+    failed: list[int] = []
+    for off in offsets:
+        env = dict(os.environ,
+                   CHAOS_SEED_OFFSET=str(off),
+                   JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+        # 'and not slow' keeps the matrix off its own wrapper test —
+        # recursing into the runner would fork-bomb the suite
+        cmd = [sys.executable, "-m", "pytest", "-q",
+               "-m", "chaos and not slow",
+               "-p", "no:cacheprovider", *extra_args]
+        proc = subprocess.run(
+            cmd, cwd=_REPO_ROOT,
+            stdout=subprocess.PIPE if quiet else None,
+            stderr=subprocess.STDOUT if quiet else None)
+        if proc.returncode != 0:
+            failed.append(off)
+            if quiet and proc.stdout:
+                sys.stdout.write(proc.stdout.decode("utf-8", "replace"))
+        print(f"[chaos-matrix] offset {off}: "
+              f"{'FAIL' if proc.returncode else 'ok'}")
+    return failed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=4,
+                    help="number of seed offsets to sweep (default 4)")
+    ap.add_argument("--offset-base", type=int, default=0,
+                    help="first CHAOS_SEED_OFFSET (default 0)")
+    args = ap.parse_args(argv)
+    offsets = range(args.offset_base, args.offset_base + args.seeds)
+    failed = run_matrix(offsets)
+    if failed:
+        print(f"[chaos-matrix] FAILED offsets: {failed} — replay with "
+              f"CHAOS_SEED_OFFSET=<off> pytest -m 'chaos and not slow'")
+        return 1
+    print(f"[chaos-matrix] all {args.seeds} seed offsets converged")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,211 @@
+"""Node liveness epoch state machine + epoch leases (kv/liveness.py).
+
+Every scenario runs on a ManualClock so expiry is deterministic: no
+sleeps, no wall-clock flakes. Multiple NodeLiveness instances sharing
+one DB model nodes sharing the liveness range."""
+
+import pytest
+
+from cockroach_tpu.kv import DB, Clock
+from cockroach_tpu.kv.hlc import ManualClock
+from cockroach_tpu.kv.liveness import (
+    EpochFencedError,
+    LeaseManager,
+    NodeLiveness,
+    NotLeaseHolderError,
+    StillLiveError,
+)
+from cockroach_tpu.storage.lsm import Engine
+
+
+def _db(clock=None):
+    return DB(Engine(key_width=16, val_width=32, memtable_size=64),
+              clock or ManualClock(start=1_000))
+
+
+def _node(db, node_id, ttl_ms=100):
+    return NodeLiveness(db, node_id, heartbeat_interval_ms=ttl_ms // 2,
+                        ttl_ms=ttl_ms)
+
+
+# -- heartbeat ---------------------------------------------------------------
+
+
+def test_first_heartbeat_creates_epoch_one():
+    db = _db()
+    n1 = _node(db, 1)
+    rec = n1.heartbeat()
+    assert rec.epoch == 1
+    assert rec.node_id == 1
+    assert rec.live_at(db.clock.now())
+    assert n1.is_live(1)
+
+
+def test_heartbeat_renews_expiration_same_epoch():
+    db = _db()
+    n1 = _node(db, 1, ttl_ms=100)
+    first = n1.heartbeat()
+    db.clock.advance(60)  # past half the ttl, still live
+    second = n1.heartbeat()
+    assert second.epoch == first.epoch == 1
+    assert second.expiration > first.expiration
+
+
+def test_record_expires_without_heartbeat():
+    db = _db()
+    n1 = _node(db, 1, ttl_ms=100)
+    n1.heartbeat()
+    db.clock.advance(200)  # well past the ttl
+    assert not n1.is_live(1)
+    n2 = _node(db, 2)
+    assert not n2.is_live(1)  # peers agree: shared records, shared clock
+
+
+def test_is_live_unknown_node_false():
+    db = _db()
+    assert not _node(db, 1).is_live(99)
+
+
+# -- epoch increment (fencing) ----------------------------------------------
+
+
+def test_increment_epoch_refused_while_live():
+    db = _db()
+    n1, n2 = _node(db, 1), _node(db, 2)
+    n1.heartbeat()
+    with pytest.raises(StillLiveError):
+        n2.increment_epoch(1)
+
+
+def test_increment_epoch_after_expiry_bumps():
+    db = _db()
+    n1, n2 = _node(db, 1, ttl_ms=100), _node(db, 2)
+    n1.heartbeat()
+    db.clock.advance(200)
+    rec = n2.increment_epoch(1)
+    assert rec.epoch == 2
+    assert rec.node_id == 1
+
+
+def test_increment_epoch_unknown_node_errors():
+    db = _db()
+    with pytest.raises(ValueError):
+        _node(db, 1).increment_epoch(42)
+
+
+def test_fenced_node_heartbeat_raises_epoch_fenced():
+    """The node was declared dead while dark; its next heartbeat must NOT
+    resurrect the old epoch — it surfaces EpochFencedError instead."""
+    db = _db()
+    n1, n2 = _node(db, 1, ttl_ms=100), _node(db, 2)
+    n1.heartbeat()
+    db.clock.advance(200)
+    n2.increment_epoch(1)  # the fencing write
+    with pytest.raises(EpochFencedError):
+        n1.heartbeat()
+
+
+def test_resurrect_after_fence_adopts_new_epoch():
+    """A FRESH NodeLiveness instance (process restart: no remembered
+    epoch) heartbeats under the bumped epoch and is live again — restart
+    recovers, stale in-memory epoch state does not."""
+    db = _db()
+    n1, n2 = _node(db, 1, ttl_ms=100), _node(db, 2)
+    n1.heartbeat()
+    db.clock.advance(200)
+    n2.increment_epoch(1)
+    n1b = _node(db, 1)  # restarted process: _my_epoch is None
+    rec = n1b.heartbeat()
+    assert rec.epoch == 2  # adopted the bumped epoch, didn't invent one
+    assert n2.is_live(1)
+    # and the OLD instance still cannot heartbeat its stale epoch back
+    with pytest.raises(EpochFencedError):
+        n1.heartbeat()
+
+
+def test_livenesses_lists_all_records():
+    db = _db()
+    _node(db, 3).heartbeat()
+    _node(db, 1).heartbeat()
+    n = _node(db, 2)
+    n.heartbeat()
+    recs = {r.node_id: r for r in n.livenesses()}
+    assert sorted(recs) == [1, 2, 3]
+    assert all(r.epoch == 1 for r in recs.values())
+
+
+# -- epoch leases ------------------------------------------------------------
+
+
+def test_acquire_vacant_and_renew():
+    db = _db()
+    lm = LeaseManager(_node(db, 1))
+    rec = lm.acquire(7)
+    assert (rec.range_id, rec.node_id, rec.epoch) == (7, 1, 1)
+    again = lm.acquire(7)  # renew: same holder, same epoch
+    assert (again.node_id, again.epoch) == (1, 1)
+    held = lm.holder(7)
+    assert held is not None and held.node_id == 1
+    lm.check(7)  # serve guard passes for the holder
+
+
+def test_acquire_against_live_holder_reroutes():
+    db = _db()
+    lm1 = LeaseManager(_node(db, 1))
+    lm2 = LeaseManager(_node(db, 2))
+    lm1.acquire(7)
+    lm2.liveness.heartbeat()
+    with pytest.raises(NotLeaseHolderError) as ei:
+        lm2.acquire(7)
+    assert ei.value.holder == 1  # reroute hint carried
+
+
+def test_failover_fences_dead_holder_and_takes_lease():
+    db = _db()
+    n1 = _node(db, 1, ttl_ms=100)
+    lm1 = LeaseManager(n1)
+    lm2 = LeaseManager(_node(db, 2))
+    lm1.acquire(7)
+    lm2.liveness.heartbeat()
+    db.clock.advance(200)  # n1 dark; n2's record would expire too, so:
+    lm2.liveness.heartbeat()  # n2 stays live
+    rec = lm2.acquire(7)  # fences n1 (epoch 1->2), takes the lease
+    assert rec.node_id == 2
+    # the fencing write really landed on n1's liveness record
+    assert lm2.liveness._read(1).epoch == 2
+    # old holder's serve guard now fails with the FENCED error, not a
+    # mere not-leaseholder: its epoch no longer matches anything
+    with pytest.raises((EpochFencedError, NotLeaseHolderError)):
+        lm1.check(7)
+    with pytest.raises(EpochFencedError):
+        n1.heartbeat()
+
+
+def test_check_not_holder_carries_hint():
+    db = _db()
+    lm1 = LeaseManager(_node(db, 1))
+    lm2 = LeaseManager(_node(db, 2))
+    lm1.acquire(7)
+    with pytest.raises(NotLeaseHolderError) as ei:
+        lm2.check(7)
+    assert ei.value.holder == 1
+
+
+def test_check_vacant_range_not_holder():
+    db = _db()
+    with pytest.raises(NotLeaseHolderError):
+        LeaseManager(_node(db, 1)).check(99)
+
+
+def test_check_epoch_fenced_after_bump():
+    """The holder's liveness epoch moved past the lease's epoch: check()
+    raises EpochFencedError even though the lease record still names the
+    node — the epoch-equality invariant, no wall-clock involved."""
+    db = _db()
+    n1 = _node(db, 1, ttl_ms=100)
+    lm1 = LeaseManager(n1)
+    lm1.acquire(7)
+    db.clock.advance(200)
+    _node(db, 2).increment_epoch(1)
+    with pytest.raises(EpochFencedError):
+        lm1.check(7)

@@ -144,3 +144,45 @@ def test_retry_through_breaker_cooldown():
         retryable=lambda e: isinstance(e, BreakerOpenError),
     )
     assert got == "through"
+
+
+# -- per-range retry budgets -------------------------------------------------
+
+
+def test_range_retry_budget_exhausts_then_refills():
+    from cockroach_tpu.utils import metric
+
+    b = retry.RangeRetryBudget(budget=3, refill_per_s=200.0)
+    exhausted_before = metric.RPC_RETRY_BUDGET_EXHAUSTED.value
+    by_range_before = metric.RPC_RETRIES_BY_RANGE.value(7)
+    for _ in range(3):
+        b.spend(7)
+    assert metric.RPC_RETRIES_BY_RANGE.value(7) == by_range_before + 3
+    with pytest.raises(retry.RetryBudgetExhausted) as ei:
+        b.spend(7)
+    assert ei.value.range_id == 7
+    assert metric.RPC_RETRY_BUDGET_EXHAUSTED.value > exhausted_before
+    time.sleep(0.02)  # 200 tokens/s: at least one token back
+    b.spend(7)  # flows again after the refill
+
+
+def test_range_retry_budget_isolates_ranges():
+    """One flapping range cannot starve another range's retries — the
+    whole point of moving the budget off the client."""
+    b = retry.RangeRetryBudget(budget=1, refill_per_s=0.0)
+    b.spend(1)
+    with pytest.raises(retry.RetryBudgetExhausted):
+        b.spend(1)
+    b.spend(2)  # untouched range: full budget
+
+
+def test_range_retry_budget_exhaustion_is_not_retryable():
+    """RetryBudgetExhausted is a hard stop: the shared classification
+    must never feed it back into a retry loop."""
+    b = retry.RangeRetryBudget(budget=0.5, refill_per_s=0.0)
+    try:
+        b.spend(9)
+        raise AssertionError("expected exhaustion")
+    except retry.RetryBudgetExhausted as e:
+        assert not retry.is_retryable(e)
+        assert not isinstance(e, ConnectionError)
