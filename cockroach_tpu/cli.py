@@ -125,7 +125,31 @@ def repl(sess) -> None:
                 print(execute_and_render(sess, stmt, timing))
 
 
+def hot_ranges_cmd(argv) -> int:
+    """`cockroach_tpu.cli hot-ranges [--url]` — the `cockroach node
+    status --ranges`-flavored verb: fetch /hot_ranges from a running
+    node's admin API and render it psql-style, hottest range first."""
+    import json as _json
+    from urllib.request import urlopen
+
+    ap = argparse.ArgumentParser(prog="cockroach_tpu.cli hot-ranges")
+    ap.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="admin API base URL of a running node")
+    args = ap.parse_args(argv)
+    with urlopen(args.url.rstrip("/") + "/hot_ranges", timeout=5) as r:
+        payload = _json.load(r)
+    rows = payload.get("hotRanges", [])
+    cols = ["rangeId", "startKey", "endKey", "storeId", "qps",
+            "writeBytesRate", "sizeBytes", "leaseholder"]
+    print(render_table({c: [row.get(c) for row in rows] for c in cols}))
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "hot-ranges":
+        return hot_ranges_cmd(argv[1:])
     ap = argparse.ArgumentParser(prog="cockroach_tpu.cli",
                                  description=__doc__)
     ap.add_argument("-e", "--execute", action="append", default=[],

@@ -78,6 +78,7 @@ class Meta:
         self._descs: list[RangeDescriptor] = [
             RangeDescriptor(1, b"", None, first_store)
         ]
+        self.lookups = 0  # authoritative reads (the meta-range's QPS)
 
     def snapshot(self) -> list[RangeDescriptor]:
         with self._lock:
@@ -85,6 +86,7 @@ class Meta:
 
     def lookup(self, key: bytes) -> RangeDescriptor:
         with self._lock:
+            self.lookups += 1
             i = self._find(key)
             return self._descs[i]
 
@@ -115,6 +117,36 @@ class Meta:
                      left=left.range_id, right=right.range_id)
             return left, right
 
+    def merge_at(self, key: bytes) -> RangeDescriptor | None:
+        """AdminMerge reduction: remove the boundary at `key` — the range
+        starting at key is absorbed into its left neighbor, which keeps
+        its range id (generation bumped so caches notice the wider
+        bounds). Metadata-only, so both sides must already be colocated.
+        Idempotent: no descriptor starts at key -> None (a crashed retry
+        already merged)."""
+        if not key:
+            raise ValueError("cannot merge at the minimum key")
+        with self._lock:
+            starts = [d.start_key for d in self._descs]
+            i = bisect.bisect_left(starts, key)
+            if i == 0 or i >= len(self._descs) or starts[i] != key:
+                return None  # boundary already gone
+            left, right = self._descs[i - 1], self._descs[i]
+            if left.store_id != right.store_id:
+                raise ValueError(
+                    f"merge at {key!r}: r{left.range_id}@s{left.store_id} "
+                    f"and r{right.range_id}@s{right.store_id} not colocated"
+                )
+            merged = RangeDescriptor(left.range_id, left.start_key,
+                                     right.end_key, left.store_id,
+                                     left.generation + 1)
+            self._descs = self._descs[:i - 1] + [merged] + self._descs[i + 1:]
+            metric.RANGE_MERGES.inc()
+            log.info(log.OPS, "range merged",
+                     at=key.decode(errors="replace"),
+                     keep=merged.range_id, gone=right.range_id)
+            return merged
+
     def reassign(self, range_id: int, to_store: int) -> RangeDescriptor:
         with self._lock:
             for i, d in enumerate(self._descs):
@@ -132,29 +164,71 @@ class RangeCache:
     """Per-sender descriptor cache (kvclient/rangecache role): lookups hit
     the cache; a RangeKeyMismatch evicts the stale entry and refills from
     Meta. Deliberately NOT invalidated by Meta writes — staleness is
-    detected at the store, exactly like the reference."""
+    detected at the store, exactly like the reference.
+
+    Authoritative refills are single-flight (rangecache's
+    singleflight.Group over lookup requests): when a split storm evicts a
+    hot descriptor, the first miss becomes the lookup leader and every
+    concurrent miss for the same key parks on its Event instead of
+    stampeding the meta range; followers re-check the cache once the
+    leader publishes."""
 
     def __init__(self, meta: Meta):
         self.meta = meta
+        self._mu = threading.Lock()
         self._by_start: dict[bytes, RangeDescriptor] = {}
+        self._inflight: dict[bytes, threading.Event] = {}
         self.misses = 0
         self.evictions = 0
+        self.coalesced = 0
 
-    def lookup(self, key: bytes) -> RangeDescriptor:
+    def _cached_locked(self, key: bytes) -> RangeDescriptor | None:
         for d in self._by_start.values():
             if d.contains(key):
                 return d
-        self.misses += 1
-        d = self.meta.lookup(key)
-        self._by_start[d.start_key] = d
-        return d
+        return None
+
+    def lookup(self, key: bytes) -> RangeDescriptor:
+        while True:
+            with self._mu:
+                d = self._cached_locked(key)
+                if d is not None:
+                    return d
+                ev = self._inflight.get(key)
+                if ev is None:
+                    ev = self._inflight[key] = threading.Event()
+                    leader = True
+                else:
+                    leader = False
+            if not leader:
+                self.coalesced += 1
+                metric.RANGE_CACHE_COALESCED.inc()
+                ev.wait(timeout=5.0)
+                continue  # re-check cache; leader failure -> become leader
+            try:
+                self.misses += 1
+                d = self.meta.lookup(key)
+                with self._mu:
+                    self._by_start[d.start_key] = d
+                return d
+            finally:
+                with self._mu:
+                    self._inflight.pop(key, None)
+                ev.set()
+
+    def insert(self, d: RangeDescriptor) -> None:
+        """Install a descriptor learned out-of-band (a store's
+        RangeKeyMismatch repair carries the current one)."""
+        with self._mu:
+            self._by_start[d.start_key] = d
 
     def evict(self, d: RangeDescriptor) -> None:
         from ..utils import metric
 
-        self.evictions += 1
-        metric.RANGE_CACHE_EVICTIONS.inc()
-        self._by_start.pop(d.start_key, None)
+        with self._mu:
+            self.evictions += 1
+            metric.RANGE_CACHE_EVICTIONS.inc()
+            self._by_start.pop(d.start_key, None)
 
 
 class Store:
@@ -215,7 +289,8 @@ class DistSender:
     same latch reduction Engine.mu provides single-store. Individual
     engines keep their own mutexes for direct access."""
 
-    def __init__(self, stores: list[Store], meta: Meta):
+    def __init__(self, stores: list[Store], meta: Meta, lease_check=None,
+                 load=None):
         assert stores, "need at least one store"
         self.meta = meta
         self.stores = {s.store_id: s for s in stores}
@@ -224,6 +299,23 @@ class DistSender:
         first = stores[0].engine
         self.key_width = first.key_width
         self.val_width = first.val_width
+        # lease_check(range_id) raises NotLeaseHolderError/EpochFencedError
+        # when this process may not serve the range — the (holder, epoch)
+        # guard applied to EVERY routed piece, so range-addressed stamping
+        # survives an auto-split mid-batch (ROADMAP open item)
+        self.lease_check = lease_check
+        # RangeLoadStats sampled on the routing path (split.Decider feed)
+        self.load = load
+
+    def _record_read(self, d, key: bytes) -> None:
+        # system keyspace (\x01: liveness/lease/tsdb records) never feeds
+        # the split decider — bookkeeping traffic must not look hot
+        if self.load is not None and not key.startswith(b"\x01"):
+            self.load.record_read(d.range_id, key)
+
+    def _record_write(self, d, key: bytes, nbytes: int) -> None:
+        if self.load is not None and not key.startswith(b"\x01"):
+            self.load.record_write(d.range_id, key, nbytes)
 
     # -- routing core --------------------------------------------------------
 
@@ -245,10 +337,14 @@ class DistSender:
                 continue
             if cur.generation != d.generation or cur.end_key != d.end_key:
                 self.cache.evict(d)
-                self.cache._by_start[cur.start_key] = cur
+                self.cache.insert(cur)
+            if self.lease_check is not None:
+                self.lease_check(cur.range_id)
             return store, cur
         # cache kept going stale (concurrent splits): go authoritative
         d = self.meta.lookup(key)
+        if self.lease_check is not None:
+            self.lease_check(d.range_id)
         return self.stores[d.store_id], d
 
     def _route_span(self, start: bytes | None, end: bytes | None):
@@ -258,6 +354,7 @@ class DistSender:
         cursor = start if start is not None else b""
         while True:
             store, d = self._route_point(cursor)
+            self._record_read(d, cursor)
             piece_end = d.end_key
             if end is not None and (piece_end is None or end <= piece_end):
                 yield store, cursor, end
@@ -273,19 +370,22 @@ class DistSender:
     @_sender_locked
     def put(self, key, value, ts: int, txn: int = 0):
         k = _b(key)
-        store, _ = self._route_point(k)
+        store, d = self._route_point(k)
+        self._record_write(d, k, len(_b(value)))
         return store.engine.put(k, value, ts=ts, txn=txn)
 
     @_sender_locked
     def delete(self, key, ts: int, txn: int = 0):
         k = _b(key)
-        store, _ = self._route_point(k)
+        store, d = self._route_point(k)
+        self._record_write(d, k, 0)
         return store.engine.delete(k, ts=ts, txn=txn)
 
     @_sender_locked
     def get(self, key, ts: int, txn: int = 0):
         k = _b(key)
-        store, _ = self._route_point(k)
+        store, d = self._route_point(k)
+        self._record_read(d, k)
         return store.engine.get(k, ts=ts, txn=txn)
 
     @_sender_locked
@@ -313,6 +413,7 @@ class DistSender:
         descs = []
         for i, k in enumerate(encs):
             store, d = self._route_point(k)
+            self._record_read(d, k)
             by_store.setdefault(store.store_id, []).append(i)
             descs.append(d)
         results: list[list[tuple[bytes, bytes]]] = [None] * len(encs)
@@ -349,6 +450,8 @@ class DistSender:
         descs = self.meta.snapshot()  # sorted by start_key, tiles keyspace
         ka = np.asarray(keys)
         if len(descs) == 1:
+            if self.lease_check is not None:
+                self.lease_check(descs[0].range_id)
             self.stores[descs[0].store_id].engine.ingest(
                 ka, np.asarray(values), ts, vlens=vlens, seq=seq)
             return
@@ -369,6 +472,8 @@ class DistSender:
         vl = None if vlens is None else np.asarray(vlens)
         for di in np.unique(piece_of):
             sel = piece_of == di
+            if self.lease_check is not None:
+                self.lease_check(descs[int(di)].range_id)
             self.stores[descs[int(di)].store_id].engine.ingest(
                 ka[sel], va[sel], ts,
                 vlens=None if vl is None else vl[sel],

@@ -281,6 +281,54 @@ class LeaseManager:
 
         return self.db.txn(op)
 
+    def carry(self, parent_id: int, child_id: int) -> LeaseRecord | None:
+        """Copy the parent range's (holder, epoch) onto a freshly split
+        child — the reference's split trigger derives the RHS lease from
+        the LHS so the new range is immediately servable by the same
+        holder instead of starting a lease race. No-op when the parent's
+        lease is vacant or the child already has one."""
+        cur = self.holder(parent_id)
+        if cur is None:
+            return None
+
+        def op(t):
+            if t.get(self._key(child_id)) is not None:
+                return None  # raced with an acquire; keep theirs
+            t.put(self._key(child_id),
+                  _LEASE_REC.pack(cur.node_id, cur.epoch, child_id))
+            return LeaseRecord(child_id, cur.node_id, cur.epoch)
+
+        return self.db.txn(op)
+
+    def transfer(self, range_id: int, to_node: int) -> LeaseRecord:
+        """Cooperative lease transfer (the AdminTransferLease reduction):
+        stamp the target as holder under the TARGET's current liveness
+        epoch. Only the current holder (or anyone, for a vacant lease)
+        may transfer; the target must be live — a lease named under a
+        dead node's epoch would be born fenced."""
+        target = self.liveness._read(to_node)
+        if target is None or not target.live_at(self.db.clock.now()):
+            raise ValueError(f"lease transfer target node {to_node} not live")
+        cur = self.holder(range_id)
+        if (cur is not None and cur.node_id != self.node_id
+                and to_node != self.node_id):
+            raise NotLeaseHolderError(
+                f"r{range_id}: node {self.node_id} cannot transfer a lease "
+                f"held by node {cur.node_id}", holder=cur.node_id)
+
+        def op(t):
+            t.put(self._key(range_id),
+                  _LEASE_REC.pack(to_node, target.epoch, range_id))
+            return LeaseRecord(range_id, to_node, target.epoch)
+
+        return self.db.txn(op)
+
+    def release(self, range_id: int) -> None:
+        """Drop the lease record (merge cleanup: the absorbed range id
+        stops existing, so its lease must not linger and confuse a later
+        id reuse)."""
+        self.db.delete(self._key(range_id))
+
     def check(self, range_id: int) -> None:
         """Server-side serve guard: raises unless THIS node holds the
         lease under its CURRENT liveness epoch. A fenced node (epoch

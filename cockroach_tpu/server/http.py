@@ -151,6 +151,28 @@ class AdminServer:
             name: s.get() for name, s in settings.all_settings().items()
         }}
 
+    def hot_ranges(self) -> dict:
+        """Range lifecycle report (the /_status/hotranges role): every
+        range with decayed QPS, write-bytes rate, authoritative size and
+        leaseholder, hottest first. Without a running RangeLifecycle the
+        payload degrades to the bare descriptor table."""
+        ranger = getattr(self.node, "ranger", None)
+        if ranger is not None:
+            return ranger.hot_ranges()
+        eng = self.node.db.engine
+        meta = getattr(eng, "meta", None)
+        if meta is None:
+            return {"hotRanges": []}
+        return {"hotRanges": [
+            {"rangeId": d.range_id,
+             "startKey": d.start_key.decode(errors="replace"),
+             "endKey": (d.end_key.decode(errors="replace")
+                        if d.end_key is not None else None),
+             "storeId": d.store_id, "qps": 0.0, "writeBytesRate": 0.0,
+             "sizeBytes": None, "leaseholder": None}
+            for d in meta.snapshot()
+        ]}
+
     def ts_query(self, name: str, start_ms: int, end_ms: int) -> dict:
         pts = self.node.tsdb.query(name, start_ms=start_ms, end_ms=end_ms)
         return {"name": name,
@@ -196,6 +218,8 @@ class AdminServer:
                         self._json(admin.settings_payload())
                     elif u.path == "/_status/statements":
                         self._json(admin.statements())
+                    elif u.path in ("/hot_ranges", "/_status/hot_ranges"):
+                        self._json(admin.hot_ranges())
                     elif u.path == "/_status/contention":
                         from ..kv.contention import DEFAULT as _cont
 

@@ -80,6 +80,11 @@ class Node:
         self._threads: list[threading.Thread] = []
         self._settings_cb = None
         self._applying_remote = False
+        # range lifecycle (kv/allocator.py): wired in start() when the DB
+        # is DistSender-backed and kv.allocator.enabled
+        self.ranger = None
+        self._wired_sender = None
+        self._lease_guard_local = threading.local()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -168,11 +173,46 @@ class Node:
             if self.kv_rpc is not None:
                 advertise(self.gossip, self.node_id, self.kv_rpc.addr)
             self.dialer = NodeDialer(self.gossip)
+
+        # range lifecycle: a DistSender-backed node runs the split/merge/
+        # rebalance queues and carries the (holder, epoch) guard onto
+        # EVERY routed piece — range-addressed stamping survives an
+        # auto-split mid-batch (the DistSender split-path open item)
+        from ..kv.dist import DistSender
+
+        sender = self.db.engine
+        if isinstance(sender, DistSender):
+            if sender.lease_check is None:
+                sender.lease_check = self._dist_lease_check
+                self._wired_sender = sender
+            if settings.get("kv.allocator.enabled"):
+                from ..kv.allocator import RangeLifecycle
+                from ..kv.loadstats import RangeLoadStats
+
+                if sender.load is None:
+                    sender.load = RangeLoadStats()
+                self.ranger = RangeLifecycle(
+                    sender, load=sender.load, leases=self.leases,
+                    gossip=self.gossip, node_id=self.node_id,
+                    store_nodes={sid: self.node_id
+                                 for sid in sender.stores},
+                    # scans walk span_stats over every range — pace them
+                    # well below the heartbeat cadence or the scanner's
+                    # engine passes starve foreground traffic
+                    interval_s=max(self._hb_interval * 5, 0.25),
+                )
+                self.ranger.start()
         log.info(log.OPS, "node started", node=self.node_id)
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self.ranger is not None:
+            self.ranger.stop()
+            self.ranger = None
+        if self._wired_sender is not None:
+            self._wired_sender.lease_check = None
+            self._wired_sender = None
         if self._settings_cb is not None:
             settings.remove_on_change(self._settings_cb)
             self._settings_cb = None
@@ -201,6 +241,41 @@ class Node:
             self.dialer.close()
             self.dialer = None
         log.info(log.OPS, "node stopped", node=self.node_id)
+
+    # stopper discipline: close() is the public teardown name (the
+    # reference's stopper.Stop); every queue/scanner thread is joined
+    close = stop
+
+    def _dist_lease_check(self, range_id: int) -> None:
+        """DistSender routing guard: when THIS node believes it holds the
+        range's lease, verify the (holder, epoch) pair is still valid —
+        so a fenced node fails every piece of a multi-range batch,
+        including children minted by an auto-split mid-batch. Vacant or
+        foreign leases pass through (the server-side guard owns those).
+        Reentrancy: the guard's own lease/liveness reads route through
+        this same sender; the thread-local skips the nested check.
+
+        An intent on the lease record means a transfer/carry txn is
+        mid-commit — and that txn's commit may be waiting on the sender
+        lock THIS request holds, so waiting the intent out would
+        deadlock until the retry budget expires. Serve under the
+        current terms instead: the fencing property lives in the epoch
+        equality check, which a committed transfer re-asserts on the
+        very next request."""
+        from ..kv.txn import TransactionRetryError
+        from ..storage.lsm import WriteIntentError
+
+        if getattr(self._lease_guard_local, "busy", False):
+            return
+        self._lease_guard_local.busy = True
+        try:
+            rec = self.leases.holder(range_id)
+            if rec is not None and rec.node_id == self.node_id:
+                self.leases.check(range_id)
+        except (WriteIntentError, TransactionRetryError):
+            pass
+        finally:
+            self._lease_guard_local.busy = False
 
     def _spawn(self, fn, name: str) -> None:
         t = threading.Thread(target=fn, name=f"{name}-n{self.node_id}",

@@ -1321,6 +1321,25 @@ class Engine:
     # -- range relocation (snapshot-rebalance primitives) -------------------
 
     @_locked
+    def span_stats(self, start: bytes | None, end: bytes | None) -> dict:
+        """Authoritative size accounting for [start, end) — the SpanStats
+        RPC role feeding the split/merge size decision. Counts every live
+        version's logical footprint (key width + stored value length), so
+        MVCC history weighs in exactly as it does on disk."""
+        view = self._merged_view()
+        if view is None:
+            return {"versions": 0, "logical_bytes": 0}
+        sw = K.encode_bound(start, self.key_width)
+        ew = K.encode_bound(end, self.key_width)
+        m, _ = _range_mask(view,
+                           None if sw is None else jnp.asarray(sw),
+                           None if ew is None else jnp.asarray(ew))
+        mask = np.asarray(m)
+        n = int(mask.sum())
+        vbytes = int(np.asarray(view.vlen)[mask].sum()) if n else 0
+        return {"versions": n, "logical_bytes": n * self.key_width + vbytes}
+
+    @_locked
     def export_span(self, start: bytes | None, end: bytes | None) -> dict:
         """Every VERSION in [start, end) — committed history, tombstones
         and intents included — as host arrays (the raft-snapshot payload

@@ -35,6 +35,15 @@ Reference mapping (each named site's CockroachDB analogue):
   client connect/send errors). Node-scoped ``gossip.broadcast.n<id>``.
 - ``kv.rangefeed.subscribe`` — rangefeed (re)subscription failures
   (kvclient/rangefeed's restart-on-error discipline).
+- ``ranger.split.apply``     — split-queue crash AFTER the meta write
+  but BEFORE bookkeeping (lease carry / cache repair / load handoff) —
+  the splitTrigger's partial-application window. Queue purgatory
+  retries must converge.
+- ``ranger.merge.apply``     — merge-queue crash after the boundary is
+  removed from meta but before bookkeeping (mergeTrigger window).
+- ``ranger.lease.transfer``  — the range's data moved but the lease
+  transfer write was lost (AdminTransferLease's in-flight window);
+  retry must be a no-op move + lease stamp.
 
 Discipline: everything is OFF unless ``fault.injection.enabled`` is set
 AND the test armed specs via :func:`arm`. Firing decisions come from ONE

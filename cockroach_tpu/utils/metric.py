@@ -232,3 +232,19 @@ GOSSIP_INFOS_EVICTED = DEFAULT.counter(
 REPLICATION_RECONNECTS = DEFAULT.counter(
     "replication_stream_reconnects",
     "replication streams re-subscribed after a transport error")
+KV_RANGE_SPLITS = DEFAULT.counter(
+    "kv_range_splits",
+    "load/size-driven range splits applied by the split queue "
+    "(distinct from range_splits, which counts admin splits)")
+KV_RANGE_MERGES = DEFAULT.counter(
+    "kv_range_merges",
+    "cold adjacent ranges absorbed by the merge queue")
+KV_LEASE_TRANSFERS = DEFAULT.counter(
+    "kv_lease_transfers",
+    "range leases moved to underfull stores by the rebalancer")
+RANGE_MERGES = DEFAULT.counter(
+    "range_merges", "range boundary removals (meta merge_at applications)")
+RANGE_CACHE_COALESCED = DEFAULT.counter(
+    "range_cache_coalesced_lookups",
+    "authoritative meta lookups answered by an in-flight peer lookup "
+    "instead of stampeding the meta range (single-flight)")

@@ -313,3 +313,26 @@ FLOW_DEADLINE_S = register_float(
     "degrades or errors (flowinfra timeout discipline)",
     lo=0.1, hi=3600.0,
 )
+SPLIT_QPS_THRESHOLD = register_float(
+    "kv.range.split_qps_threshold", 2500.0,
+    "decayed per-range QPS above which the split queue cuts the range at "
+    "a sampled mid-load key (kv.range_split.load_qps_threshold analog)",
+    lo=0.001, hi=1e9,
+)
+RANGE_MAX_BYTES = register_int(
+    "kv.range.max_bytes", 64 << 20,
+    "authoritative logical size above which the split queue cuts a range "
+    "regardless of load (zone-config range_max_bytes analog); ranges whose "
+    "combined size stays under half of this are merge candidates",
+    lo=256,
+)
+RANGE_MERGE_ENABLED = register_bool(
+    "kv.range.merge_enabled", True,
+    "let the merge queue absorb a cold range into its cold left neighbor "
+    "(kv.range_merge.queue_enabled analog); disable to freeze boundaries",
+)
+ALLOCATOR_ENABLED = register_bool(
+    "kv.allocator.enabled", True,
+    "run the range-lifecycle queues (split/merge/rebalance) on node start; "
+    "the queues are also constructible standalone for deterministic tests",
+)

@@ -1,0 +1,74 @@
+"""Static cluster-settings audit — every setting key must be both
+registered and read.
+
+Two failure classes, each a drift bug the type system can't catch:
+
+- **unregistered use**: `settings.get("x")` (or `.set`) with a key no
+  `register_*` call declares — a typo or a deleted setting; it raises
+  KeyError only on the code path that reads it.
+- **registered-but-unread**: a `register_*` key no code path in
+  `cockroach_tpu/` gets or sets — dead surface area that documents a
+  knob which controls nothing.
+
+Pure text pass (regexes tolerant of calls split across lines), no import
+of the package — so it runs without pulling in jax. Wired as a tier-1
+test via tests/test_settings_registered.py; also runnable directly:
+
+    python -m scripts.check_settings_registered
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+# matches settings.get("k") / _settings.set('k') with the open paren and
+# the key possibly on different lines (\s* spans newlines)
+_USE = re.compile(r"settings\.(?:get|set)\(\s*['\"]([^'\"]+)['\"]")
+_REGISTER = re.compile(
+    r"register_(?:bool|int|float|enum|string)\(\s*\n?\s*['\"]([^'\"]+)['\"]")
+
+
+def _scan(root: pathlib.Path, rx: re.Pattern,
+          skip: tuple[str, ...] = ()) -> dict[str, list[str]]:
+    found: dict[str, list[str]] = {}
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root.parent).as_posix()
+        if rel in skip:
+            continue
+        for m in rx.finditer(path.read_text()):
+            found.setdefault(m.group(1), []).append(rel)
+    return found
+
+
+def check(repo_root: str | pathlib.Path | None = None) -> list[str]:
+    """Returns a list of human-readable violations (empty = clean)."""
+    if repo_root is None:
+        repo_root = pathlib.Path(__file__).resolve().parent.parent
+    pkg = pathlib.Path(repo_root) / "cockroach_tpu"
+    # the registry module's own get()/set() bodies aren't usages
+    used = _scan(pkg, _USE, skip=("cockroach_tpu/utils/settings.py",))
+    registered = _scan(pkg, _REGISTER)
+    problems = []
+    for key in sorted(set(used) - set(registered)):
+        problems.append(
+            f"unregistered setting {key!r} used in {', '.join(used[key])}")
+    for key in sorted(set(registered) - set(used)):
+        problems.append(
+            f"setting {key!r} registered in {', '.join(registered[key])} "
+            f"but never read (settings.get) or set anywhere in the package")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"FAIL: {p}", file=sys.stderr)
+    if not problems:
+        print("settings registry clean: every key registered and read")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

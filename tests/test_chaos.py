@@ -574,3 +574,182 @@ def test_wal_fsync_and_delay_faults(tmp_path):
     assert time.monotonic() - t0 >= 0.01
     faults.disarm()
     assert eng.get(b"y", ts=10) == b"2"
+
+
+# -- range lifecycle chaos ----------------------------------------------------
+
+
+def _ranger_cluster(load_seed=3):
+    """2-store DistSender cluster with routing-path load stats installed
+    (no background threads: chaos drives the queues synchronously)."""
+    from cockroach_tpu.kv.dist import DistSender, Meta, Store
+    from cockroach_tpu.kv.loadstats import RangeLoadStats
+
+    meta = Meta(first_store=1)
+    stores = [Store(i + 1, meta, key_width=16, val_width=16,
+                    memtable_size=64) for i in range(2)]
+    ds = DistSender(stores, meta)
+    db = DB(ds, Clock())
+    load = RangeLoadStats(half_life_s=5.0, sample_size=32, seed=load_seed)
+    ds.load = load
+    return meta, ds, db, load
+
+
+def test_ranger_split_crash_between_meta_write_and_bookkeeping():
+    """ranger.split.apply fires AFTER Meta.split_at but BEFORE the lease
+    carry / cache repair / load handoff — the classic torn-split window.
+    The item parks in purgatory; the retry finds the boundary already
+    present, recovers both sides, and finishes the bookkeeping. Reads
+    converge to the no-fault oracle with zero leaks."""
+    from cockroach_tpu.kv.allocator import RangeLifecycle
+    from cockroach_tpu.utils import settings
+
+    before = snapshot()
+    meta, ds, db, load = _ranger_cluster()
+    life = RangeLifecycle(ds, load=load)
+    settings.set("kv.range.split_qps_threshold", 5.0)
+    try:
+        model = {}
+        for i in range(200):
+            k, v = b"s%04d" % i, b"v%04d" % (i * 3)
+            db.put(k, v)
+            model[k] = v
+        splits0 = metric.KV_RANGE_SPLITS.value
+        faults.arm(61, {
+            "ranger.split.apply": FaultSpec(kind="error", p=1.0,
+                                            max_fires=1),
+        })
+        life.scan_once()
+        life.split_queue.drain()
+        assert faults.fired(), "chaos run injected nothing"
+        # torn state: the meta write landed, the bookkeeping did not
+        assert len(meta.snapshot()) == 2
+        assert metric.KV_RANGE_SPLITS.value == splits0
+        assert life.split_queue.purgatory_len() == 1
+        faults.disarm()
+        # retry from purgatory: idempotent recovery completes the split
+        life.split_queue.drain(force_purgatory=True)
+        assert life.split_queue.purgatory_len() == 0
+        assert metric.KV_RANGE_SPLITS.value > splits0
+        # both children carry load history (neither looks newborn-cold)
+        assert all(load.qps(d.range_id) > 0 for d in meta.snapshot())
+        for k, v in model.items():
+            assert db.get(k) == v
+        assert dict(db.scan(b"s", b"t")) == model
+    finally:
+        faults.disarm()
+        settings.reset()
+    assert_no_leaks(before)
+
+
+def test_ranger_merge_crash_after_meta_write_converges():
+    """ranger.merge.apply fires after Meta.merge_at removed the boundary
+    but before the load fold / cache eviction. The retry sees the
+    boundary gone, repairs the cache with the current owner, and
+    converges — stale-descriptor routing self-heals, data intact."""
+    from cockroach_tpu.kv.allocator import RangeLifecycle
+
+    before = snapshot()
+    meta, ds, db, load = _ranger_cluster()
+    life = RangeLifecycle(ds, load=load)
+    model = {}
+    for i in range(120):
+        k, v = b"c%04d" % i, b"w%04d" % i
+        db.put(k, v)
+        model[k] = v
+    # admin-split a keyspace that is cold against the DEFAULT threshold,
+    # and strand the right side on the other store (the merge must
+    # colocate before it can fold the boundary)
+    ds.split_at(b"c0060")
+    right = meta.lookup(b"c0060")
+    ds.move_range(right.range_id, to_store=2)
+    merges0 = metric.KV_RANGE_MERGES.value
+    faults.arm(67, {
+        "ranger.merge.apply": FaultSpec(kind="error", p=1.0, max_fires=1),
+    })
+    try:
+        life.scan_once()
+        life.merge_queue.drain()
+        assert faults.fired(), "chaos run injected nothing"
+        # torn state: boundary gone from meta, bookkeeping lost
+        assert len(meta.snapshot()) == 1
+        assert metric.KV_RANGE_MERGES.value == merges0
+        assert life.merge_queue.purgatory_len() == 1
+        faults.disarm()
+        life.merge_queue.drain(force_purgatory=True)
+        assert life.merge_queue.purgatory_len() == 0
+        # converged: one range, every key served, scans cross cleanly
+        for k, v in model.items():
+            assert db.get(k) == v
+        assert dict(db.scan(b"c", b"d")) == model
+        assert db.get(b"c0060") == model[b"c0060"]
+    finally:
+        faults.disarm()
+    assert_no_leaks(before)
+
+
+def test_ranger_lease_transfer_dropped_completes_on_retry():
+    """ranger.lease.transfer fires after the data move but before the
+    lease write lands (the dropped-transfer window): the range lives on
+    the target store while the lease still names the old node. The
+    purgatory retry detects the mismatch and completes the handoff —
+    exactly once, converging holder == target node."""
+    from cockroach_tpu.kv.allocator import RangeLifecycle
+    from cockroach_tpu.kv.liveness import LeaseManager, NodeLiveness
+    from cockroach_tpu.utils import settings
+
+    before = snapshot()
+    meta, ds, db, load = _ranger_cluster()
+    nl1 = NodeLiveness(db, 1, ttl_ms=600_000)
+    nl2 = NodeLiveness(db, 2, ttl_ms=600_000)
+    nl1.heartbeat()
+    nl2.heartbeat()
+    lm = LeaseManager(nl1)
+    lm.acquire(1)
+    life = RangeLifecycle(ds, load=load, leases=lm, node_id=1,
+                          store_nodes={1: 1, 2: 2})
+    settings.set("kv.range.split_qps_threshold", 5.0)
+    try:
+        import random
+
+        rng = random.Random(17)
+        model = {}
+        for _ in range(300):
+            i = rng.randrange(40) if rng.random() < 0.8 \
+                else 40 + rng.randrange(160)
+            k, v = b"x%05d" % i, b"v%05d" % rng.randrange(10_000)
+            db.put(k, v)
+            model[k] = v
+        # first: a clean load split so the rebalancer has something it
+        # can move WITHOUT flipping the whole imbalance (a store's only
+        # range never rebalances — the improvement guard)
+        life.scan_once()
+        life.split_queue.drain()
+        assert len(meta.snapshot()) >= 2
+        transfers0 = metric.KV_LEASE_TRANSFERS.value
+        faults.arm(71, {
+            "ranger.lease.transfer": FaultSpec(kind="error", p=1.0,
+                                               max_fires=1),
+        })
+        life.scan_once()
+        life.rebalance_queue.drain()
+        assert faults.fired(), "chaos run injected nothing"
+        # torn state: data moved, lease write lost
+        moved = [d for d in meta.snapshot() if d.store_id == 2]
+        assert moved, "rebalance never moved the hot range"
+        assert all(lm.holder(d.range_id).node_id == 1 for d in moved)
+        assert metric.KV_LEASE_TRANSFERS.value == transfers0
+        assert life.rebalance_queue.purgatory_len() == 1
+        faults.disarm()
+        life.rebalance_queue.drain(force_purgatory=True)
+        assert life.rebalance_queue.purgatory_len() == 0
+        assert metric.KV_LEASE_TRANSFERS.value == transfers0 + 1
+        # converged: the moved range's lease names the target's node
+        moved = [d for d in meta.snapshot() if d.store_id == 2]
+        assert any(lm.holder(d.range_id).node_id == 2 for d in moved)
+        for k, v in model.items():
+            assert db.get(k) == v
+    finally:
+        faults.disarm()
+        settings.reset()
+    assert_no_leaks(before)
