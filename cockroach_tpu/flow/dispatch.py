@@ -1,0 +1,63 @@
+"""Kernel-dispatch accounting for the flow layer.
+
+Every jitted call the engine issues is one XLA executable dispatch — and on
+a remote-attached TPU each dispatch costs a tunnel round trip, so dispatch
+COUNT (not FLOP count) dominates short queries. The fusion work
+(flow/fuse.py, the _consume composition in flow/operators.py) exists to
+drive that count down to ~one per tile; this module makes the count
+observable so the win is measurable and regressions are catchable:
+
+- ``jit`` wraps ``jax.jit`` so every *call* of the compiled function bumps
+  one process-global counter (thread-safe: ParallelUnorderedSyncOp calls
+  kernels from puller threads). All flow-layer kernels are jitted through
+  it.
+- ``flow/runtime.py`` snapshots ``total()`` around a query and attributes
+  the delta to the root's ``ComponentStats.kernel_dispatches`` (surfaced
+  by EXPLAIN ANALYZE).
+- ``scripts/check_dispatch_budget.py`` turns the per-query count into a
+  tier-1 regression budget.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+
+from ..utils import metric
+
+_lock = threading.Lock()
+_total = 0
+
+
+def note(n: int = 1) -> None:
+    """Record n dispatches issued outside a ``jit`` wrapper (direct calls
+    of a shared jitted kernel, e.g. coldata.batch.compact)."""
+    global _total
+    with _lock:
+        _total += n
+    metric.KERNEL_DISPATCHES.inc(n)
+
+
+def total() -> int:
+    """Process-lifetime dispatch count (monotonic — snapshot before/after
+    a query for per-query attribution)."""
+    return _total
+
+
+def jit(fn=None, **jit_kwargs):
+    """``jax.jit`` with per-call dispatch accounting. Usable like jax.jit,
+    both directly and via ``functools.partial(jit, static_argnames=...)``
+    as a decorator."""
+    if fn is None:
+        return functools.partial(jit, **jit_kwargs)
+    jitted = jax.jit(fn, **jit_kwargs)
+
+    @functools.wraps(fn)
+    def counted(*args, **kwargs):
+        note()
+        return jitted(*args, **kwargs)
+
+    counted._jitted = jitted  # uncounted handle (AOT lowering/inspection)
+    return counted

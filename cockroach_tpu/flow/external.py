@@ -36,6 +36,7 @@ from ..ops import join as join_ops
 from ..ops import merge_join as mj_ops
 from ..ops import sort as sort_ops
 from ..ops.hashing import hash_columns
+from . import dispatch
 from .operator import OneInputOperator, Operator
 
 
@@ -146,7 +147,7 @@ def make_bucket_fn(schema: Schema, keys, tables, nparts: int):
         h = hash_columns(cols, types, tables or None)
         return (h % np.uint64(nparts)).astype(jnp.int32)
 
-    return jax.jit(fn)
+    return dispatch.jit(fn)
 
 
 # ---------------------------------------------------------------------------
@@ -346,7 +347,7 @@ class ExternalSortOp(OneInputOperator):
         rank_table = None
         if key.col in self.child.dictionaries:
             rank_table = self.child.dictionaries[key.col].ranks
-        self._u64_fn = jax.jit(
+        self._u64_fn = dispatch.jit(
             lambda b: _primary_u64(b, schema, key, rank_table)
         )
         rank_tables = {
@@ -356,7 +357,7 @@ class ExternalSortOp(OneInputOperator):
         }
         keys = self.keys
 
-        @functools.partial(jax.jit, static_argnames=())
+        @functools.partial(dispatch.jit, static_argnames=())
         def sort_fn(b):
             return sort_ops.sort_batch(b, schema, keys, rank_tables)
 

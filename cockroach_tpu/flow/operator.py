@@ -26,13 +26,17 @@ class ComponentStats:
     (execinfrapb/component_stats.proto), folded into EXPLAIN ANALYZE by
     plan/explain.py (the execstats/traceanalyzer.go role)."""
 
-    __slots__ = ("batches", "rows", "time_s", "bytes")
+    __slots__ = ("batches", "rows", "time_s", "bytes", "kernel_dispatches")
 
     def __init__(self):
         self.batches = 0
         self.rows = 0
         self.time_s = 0.0  # inclusive wall time in next_batch (incl. children)
         self.bytes = 0  # logical device bytes emitted (colmem accounting)
+        # XLA dispatches the whole query issued (flow/dispatch.py delta,
+        # attributed to the ROOT's stats by run_operator — dispatches are
+        # process-global, not attributable per operator without a sync)
+        self.kernel_dispatches = 0
 
     def exclusive(self, children: list["Operator"]) -> float:
         return self.time_s - sum(c.stats.time_s for c in children)

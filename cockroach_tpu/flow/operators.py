@@ -40,6 +40,7 @@ from ..ops.aggregation import partial_layout
 from ..ops import expr as ex
 from ..ops import join as join_ops
 from ..ops import sort as sort_ops
+from . import dispatch
 from .operator import OneInputOperator, Operator, SourceOperator
 
 
@@ -71,7 +72,7 @@ class _FusedPull:
         src, chain_fn, _ = parts
         self.src = src
         self.chain = chain_fn
-        self._fn = jax.jit(
+        self._fn = dispatch.jit(
             lambda t, *a: tile_fn(chain_fn(t, *a))
         )
 
@@ -79,6 +80,16 @@ class _FusedPull:
         _, _, args = parts
         for t in self.src.stream_tiles():
             yield self._fn(t, *args)
+
+
+def _fusion_enabled() -> bool:
+    # sql.distsql.fusion.enabled=off degrades EVERY fusion path (the
+    # plan-build pass in flow/fuse.py AND these consumer-driven spool
+    # compositions) to classic one-jit-per-operator pulls — the unfused
+    # oracle the fusion-equivalence sweep compares against
+    from ..utils import settings
+
+    return settings.get("sql.distsql.fusion.enabled")
 
 
 def _consume(op: OneInputOperator, tile_fn_name: str, tile_fn,
@@ -92,7 +103,8 @@ def _consume(op: OneInputOperator, tile_fn_name: str, tile_fn,
     Stats collection (EXPLAIN ANALYZE) forces the per-operator path so every
     operator's batch/row counts stay observable — the reference equivalently
     pays for its stats wrappers (colflow/stats.go)."""
-    parts = None if op._collect else op.child.stream_parts()
+    parts = (None if (op._collect or not _fusion_enabled())
+             else op.child.stream_parts())
     if parts is None:
         fn = fallback_fn if fallback_fn is not None else tile_fn
         while True:
@@ -107,6 +119,42 @@ def _consume(op: OneInputOperator, tile_fn_name: str, tile_fn,
         cached = _FusedPull(parts, tile_fn)
         setattr(op, attr, cached)
     yield from cached.pull(parts)
+
+
+def _fold(op: OneInputOperator, tag: str, tile_raw, tile_jit, merge_raw,
+          merge_jit):
+    """Reduce tile_raw over the child's tiles, merging into an accumulator
+    with merge_raw. The fused path composes (merge o tile o chain) into ONE
+    step kernel carrying the accumulator — folding consumers (scalar/dense
+    aggregation) then pay exactly one dispatch per tile instead of
+    tile + merge. Returns the final accumulator (None on empty input)."""
+    parts = (None if (op._collect or not _fusion_enabled())
+             else op.child.stream_parts())
+    if parts is None:
+        acc = None
+        while True:
+            b = op.child.next_batch()
+            if b is None:
+                return acc
+            st = tile_jit(b)
+            acc = st if acc is None else merge_jit(acc, st)
+    src, cfn, args = parts
+    attr = f"_fold_{tag}"
+    cached = getattr(op, attr, None)
+    if cached is None or cached[0] is not cfn:
+        nc = len(args)
+        seed = dispatch.jit(lambda t, *a: tile_raw(cfn(t, *a[:nc])))
+        step = dispatch.jit(
+            lambda acc, t, *a: merge_raw(acc, tile_raw(cfn(t, *a[:nc]))),
+            donate_argnums=0,
+        )
+        cached = (cfn, seed, step)
+        setattr(op, attr, cached)
+    _, seed, step = cached
+    acc = None
+    for t in src.stream_tiles():
+        acc = seed(t, *args) if acc is None else step(acc, t, *args)
+    return acc
 
 
 # ---------------------------------------------------------------------------
@@ -217,7 +265,7 @@ class ScanOp(SourceOperator):
         self._res_tile = min(tile, cap)
         if getattr(self, "_slice_tile", None) != self._res_tile:
             res_tile = self._res_tile
-            self._slice = jax.jit(functools.partial(_slice_tile, res_tile))
+            self._slice = dispatch.jit(functools.partial(_slice_tile, res_tile))
             self._slice_tile = res_tile
 
     # -- streaming mode -----------------------------------------------------
@@ -388,7 +436,7 @@ class HashBucketOp(OneInputOperator):
                 b.mask & (hashing.bucket(h, n_parts) == part))
 
         self._raw = raw
-        self._fn = jax.jit(raw)
+        self._fn = dispatch.jit(raw)
 
     def stream_parts(self):
         return _compose_parts(self, self.child, self._raw)
@@ -436,7 +484,7 @@ class FilterOp(OneInputOperator):
             return b.with_mask(ex.filter_mask(b, schema, predicate))
 
         self._raw = raw
-        self._fn = jax.jit(raw)
+        self._fn = dispatch.jit(raw)
 
     def stream_parts(self):
         return _compose_parts(self, self.child, self._raw)
@@ -498,7 +546,7 @@ class ProjectOp(OneInputOperator):
             return Batch(cols=tuple(cols), mask=b.mask)
 
         self._raw = raw
-        self._fn = jax.jit(raw)
+        self._fn = dispatch.jit(raw)
 
     def stream_parts(self):
         return _compose_parts(self, self.child, self._raw)
@@ -521,7 +569,7 @@ class LimitOp(OneInputOperator):
             keep = b.mask & (pos >= offset) & (pos < offset + limit)
             return b.with_mask(keep), seen + jnp.sum(b.mask, dtype=jnp.int32)
 
-        self._fn = jax.jit(fn)
+        self._fn = dispatch.jit(fn)
 
     def init(self):
         super().init()
@@ -705,7 +753,7 @@ class AggregateOp(OneInputOperator):
             )
             return part
 
-        @functools.partial(jax.jit, static_argnames=("cap",))
+        @functools.partial(dispatch.jit, static_argnames=("cap",))
         def merge_fn(tiles, cap):
             both = concat(list(tiles), capacity=cap)
             # ordered partials stay in scan order per tile, so their
@@ -717,9 +765,9 @@ class AggregateOp(OneInputOperator):
                                         presorted=ordered, compact=True)
 
         self._partial_raw = partial_fn
-        self._partial_fn = jax.jit(partial_fn)
+        self._partial_fn = dispatch.jit(partial_fn)
         self._merge_fn = merge_fn
-        self._finalize_fn = jax.jit(self._finalize)
+        self._finalize_fn = dispatch.jit(self._finalize)
 
     def _finalize(self, state: Batch) -> Batch:
         return agg_ops.finalize_states(state, self.final_map, self.num_keys)
@@ -914,10 +962,11 @@ class ScalarAggregateOp(OneInputOperator):
         self.dictionaries = {}
         self.col_stats = {}
         self._tile_raw = lambda b: agg_ops.scalar_tile_states(b, aggs, base)
-        self._tile_fn = jax.jit(self._tile_raw)
-        self._merge_fn = jax.jit(
+        self._tile_fn = dispatch.jit(self._tile_raw)
+        self._merge_raw = (
             lambda acc, new: agg_ops.scalar_merge_states(aggs, acc, new)
         )
+        self._merge_fn = dispatch.jit(self._merge_raw)
         self._emitted = False
 
     def init(self):
@@ -927,9 +976,8 @@ class ScalarAggregateOp(OneInputOperator):
     def _next(self):
         if self._emitted:
             return None
-        acc = None
-        for st in _consume(self, "scalar", self._tile_raw, self._tile_fn):
-            acc = st if acc is None else self._merge_fn(acc, st)
+        acc = _fold(self, "scalar", self._tile_raw, self._tile_fn,
+                    self._merge_raw, self._merge_fn)
         self._emitted = True
         return agg_ops.scalar_result_batch(
             self.aggs, self.base_schema, self.output_schema, acc
@@ -973,7 +1021,7 @@ class SortOp(OneInputOperator):
         keys = self.keys
         col_stats = dict(self.child.col_stats)
 
-        @functools.partial(jax.jit, static_argnames=("cap",))
+        @functools.partial(dispatch.jit, static_argnames=("cap",))
         def fn(batches, cap):
             big = concat(list(batches), capacity=cap)
             return sort_ops.sort_batch(big, schema, keys, rank_tables,
@@ -1213,7 +1261,7 @@ class HashJoinOp(OneInputOperator):
         layout = self.exact_layout
         eremaps = self.build_code_remaps or None
 
-        @functools.partial(jax.jit, static_argnames=("cap",))
+        @functools.partial(dispatch.jit, static_argnames=("cap",))
         def build_fn(tiles, cap):
             big = concat(list(tiles), capacity=cap)
             index = join_ops.build_index(big, bschema, bkeys, bht,
@@ -1223,7 +1271,7 @@ class HashJoinOp(OneInputOperator):
 
         self._build_fn = build_fn
 
-        @functools.partial(jax.jit, static_argnames=("cap",))
+        @functools.partial(dispatch.jit, static_argnames=("cap",))
         def lut_fn(tiles, cap):
             big = concat(list(tiles), capacity=cap)
             return big, join_ops.build_dense_lut(big, bkeys, layout, eremaps)
@@ -1237,7 +1285,7 @@ class HashJoinOp(OneInputOperator):
             remaps = self.build_code_remaps or None
             spec = self.spec
 
-            @functools.partial(jax.jit, static_argnames=("out_cap",))
+            @functools.partial(dispatch.jit, static_argnames=("out_cap",))
             def probe_gen_fn(p, build, index, out_cap):
                 return join_ops.hash_join_general(
                     p, pschema, pkeys, build, bschema, bkeys, spec, out_cap,
@@ -1301,7 +1349,7 @@ class HashJoinOp(OneInputOperator):
                 return out
 
         self._probe_raw = probe_raw
-        self._probe_fn = jax.jit(probe_raw)
+        self._probe_fn = dispatch.jit(probe_raw)
 
     def _ensure_built(self):
         from ..utils import settings
@@ -1455,7 +1503,7 @@ class HashJoinOp(OneInputOperator):
                 out = compact_batch(out, capacity=cap)
             return out, cnt
 
-        self._emit_kern = jax.jit(kern)
+        self._emit_kern = dispatch.jit(kern)
         self._emit_kern_key = key
         return self._emit_kern
 
@@ -1558,7 +1606,8 @@ class HashJoinOp(OneInputOperator):
 def _consume_op(op: Operator, tag: str):
     """Pull every tile from `op`, fused with its streaming chain when
     possible (build-side spools ride one jit instead of one per operator)."""
-    parts = None if op._collect else op.stream_parts()
+    parts = (None if (op._collect or not _fusion_enabled())
+             else op.stream_parts())
     if parts is None:
         while True:
             b = op.next_batch()
@@ -1570,7 +1619,7 @@ def _consume_op(op: Operator, tag: str):
     attr = f"_fused_src_{tag}"
     cached = getattr(op, attr, None)
     if cached is None or cached[0] is not cfn:
-        cached = (cfn, jax.jit(cfn))
+        cached = (cfn, dispatch.jit(cfn))
         setattr(op, attr, cached)
     fn = cached[1]
     for t in src.stream_tiles():
@@ -1634,7 +1683,7 @@ class WindowOp(OneInputOperator):
         okeys = self.order_keys
         specs = self.specs
 
-        @functools.partial(jax.jit, static_argnames=("cap",))
+        @functools.partial(dispatch.jit, static_argnames=("cap",))
         def fn(batches, cap):
             big = concat(list(batches), capacity=cap)
             return win_ops.compute_windows(
@@ -2019,7 +2068,7 @@ class MergeJoinOp(OneInputOperator):
         bkey = self.build_key
         brank = self.build_rank
 
-        @functools.partial(jax.jit, static_argnames=("cap",))
+        @functools.partial(dispatch.jit, static_argnames=("cap",))
         def build_fn(tiles, cap):
             big = concat(list(tiles), capacity=cap)
             return big, mj_ops.build_merge_index(big, bschema, bkey, brank)
@@ -2030,7 +2079,7 @@ class MergeJoinOp(OneInputOperator):
         prank = self.probe_rank
         spec = self.spec
 
-        @functools.partial(jax.jit, static_argnames=("out_cap",))
+        @functools.partial(dispatch.jit, static_argnames=("out_cap",))
         def probe_fn(p, build, index, out_cap):
             return mj_ops.merge_join(
                 p, pschema, pkey, build, bschema, bkey, spec, out_cap,
@@ -2192,16 +2241,16 @@ class SmallGroupAggregateOp(OneInputOperator):
             )
 
         self._tile_raw = tile_fn
-        self._tile_fn = jax.jit(tile_fn)
-        self._merge_fn = jax.jit(merge_fn, donate_argnums=0)
-        self._finalize_fn = jax.jit(finalize_fn)
+        self._tile_fn = dispatch.jit(tile_fn)
+        self._merge_raw = merge_fn
+        self._merge_fn = dispatch.jit(merge_fn, donate_argnums=0)
+        self._finalize_fn = dispatch.jit(finalize_fn)
 
     def _next(self):
         if self._emitted:
             return None
-        acc = None
-        for st in _consume(self, "dense", self._tile_raw, self._tile_fn):
-            acc = st if acc is None else self._merge_fn(acc, st)
+        acc = _fold(self, "dense", self._tile_raw, self._tile_fn,
+                    self._merge_raw, self._merge_fn)
         self._emitted = True
         if acc is None:
             return None

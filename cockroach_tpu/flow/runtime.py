@@ -3,7 +3,14 @@
 Reference: distsql_running.go:710 Run drives the root operator;
 colexec/materializer.go:30 converts the final columnar batches to rows for
 pgwire. Here run_plan pulls every tile from the root operator and materializes
-live rows to host numpy columns (decoding string dictionaries)."""
+live rows to host numpy columns (decoding string dictionaries).
+
+The pull loop is double-buffered (sql.distsql.readback_overlap): tile k's
+device->host copies are kicked off asynchronously as soon as the tile is
+dispatched, and the blocking materialization of tile k happens while the
+root computes tile k+1 — so the readback tunnel (tens of MB/s on
+remote-attached TPU) overlaps compute instead of serializing after it.
+"""
 
 from __future__ import annotations
 
@@ -15,24 +22,69 @@ from ..plan import builder as plan_builder
 from ..plan.spec import PlanNode
 
 
-def _shrink_for_readback(b):
-    """Compact a sparse output tile to a small pow2 capacity on-device before
-    materializing. Device->host readback over the TPU tunnel runs at tens of
-    MB/s — a top-10 result living in a 2M-row padded tile would dominate
-    query time without this."""
-    from ..coldata.batch import compact
+def _start_readback(b) -> None:
+    """Begin the device->host copy of every array in an already-dispatched
+    tile (jax.Array.copy_to_host_async); the np.asarray calls inside
+    to_host then find the bytes already landing instead of starting the
+    transfer at block time."""
+    import jax
 
-    if b.capacity < (1 << 16):
-        return b
-    import jax.numpy as jnp
+    for leaf in jax.tree_util.tree_leaves(b):
+        start = getattr(leaf, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:
+                return  # best-effort: to_host still blocks correctly
 
-    n = int(jnp.sum(b.mask, dtype=jnp.int32))
-    cap = 1024
-    while cap < n:
-        cap *= 2
-    if cap * 2 <= b.capacity:
-        b = compact(b, capacity=cap)
-    return b
+
+class _ReadbackShrink:
+    """Device-side output compaction before materialization. A top-10
+    result living in a 2M-row padded tile would dominate query time on the
+    readback tunnel, so large tiles compact to capacity/64 on-device.
+
+    The decision is SPECULATIVE — no host sync in the pull loop: each
+    compaction keeps a deferred device live-count and retains the original
+    tile; finish() fetches all counts in one stacked sync at query end and
+    re-materializes any tile the compaction truncated from its retained
+    original (no recompute, no query re-run)."""
+
+    MIN_CAP = 1 << 16
+
+    def __init__(self):
+        self._checks = []  # (output index, original tile, cap, count future)
+        self._n = 0
+
+    def shrink(self, b):
+        import jax.numpy as jnp
+
+        from ..coldata.batch import compact
+        from . import dispatch
+
+        i = self._n
+        self._n += 1
+        if b.capacity < self.MIN_CAP:
+            return b
+        cap = max(1024, b.capacity >> 6)
+        count = jnp.sum(b.mask, dtype=jnp.int32)  # deferred device scalar
+        out = compact(b, capacity=cap)
+        dispatch.note()  # compact is a shared jitted kernel
+        self._checks.append((i, b, cap, count))
+        return out
+
+    def finish(self, outs, schema, dictionaries) -> None:
+        """ONE stacked count fetch; patch truncated tiles from their
+        retained originals. Call only on the attempt whose output is kept
+        (after _post_run_updates decides no re-run)."""
+        if not self._checks:
+            return
+        import jax.numpy as jnp
+
+        counts = np.asarray(jnp.stack([c for *_, c in self._checks]))
+        for (i, orig, cap, _), n in zip(self._checks, counts):
+            if int(n) > cap:
+                outs[i] = to_host(orig, schema, dictionaries)
+        self._checks = []
 
 
 def _post_run_updates(op) -> bool:
@@ -49,11 +101,14 @@ def _post_run_updates(op) -> bool:
 def run_operator(root) -> dict[str, np.ndarray]:
     import time
 
-    from ..utils import metric
+    from ..utils import metric, settings
     from ..utils.errors import QueryError, _PASSTHROUGH
+    from . import dispatch
 
     metric.QUERIES.inc()
     t0 = time.perf_counter()
+    d0 = dispatch.total()
+    overlap = settings.get("sql.distsql.readback_overlap")
     try:
         # speculative-capacity retry loop: operators run with sticky learned
         # shapes and validate their deferred counters after the pull; an
@@ -61,14 +116,33 @@ def run_operator(root) -> dict[str, np.ndarray]:
         # with corrected capacities rather than paying a sync per tile
         for attempt in range(4):
             outs: list[dict[str, np.ndarray]] = []
+            shrink = _ReadbackShrink()
             root.init()
-            while True:
-                b = root.next_batch()
-                if b is None:
-                    break
-                b = _shrink_for_readback(b)
-                outs.append(to_host(b, root.output_schema, root.dictionaries))
+            if overlap:
+                # one-tile lag: materialize tile k (blocking host copy)
+                # while the root's async dispatches compute tile k+1
+                prev = None
+                while True:
+                    b = root.next_batch()
+                    if b is not None:
+                        b = shrink.shrink(b)
+                        _start_readback(b)
+                    if prev is not None:
+                        outs.append(to_host(prev, root.output_schema,
+                                            root.dictionaries))
+                    prev = b
+                    if b is None:
+                        break
+            else:
+                while True:
+                    b = root.next_batch()
+                    if b is None:
+                        break
+                    b = shrink.shrink(b)
+                    outs.append(to_host(b, root.output_schema,
+                                        root.dictionaries))
             if not _post_run_updates(root):
+                shrink.finish(outs, root.output_schema, root.dictionaries)
                 break
         else:
             raise RuntimeError(
@@ -86,6 +160,11 @@ def run_operator(root) -> dict[str, np.ndarray]:
         raise QueryError(f"operator {type(root).__name__}", e) from e
     finally:
         metric.QUERY_SECONDS.observe(time.perf_counter() - t0)
+        st = getattr(root, "stats", None)
+        if st is not None:
+            # per-query dispatch attribution (EXPLAIN ANALYZE header);
+            # dispatches are process-global so they land on the root
+            st.kernel_dispatches += dispatch.total() - d0
         root.close()
     if not outs:
         return {n: np.array([]) for n in root.output_schema.names}

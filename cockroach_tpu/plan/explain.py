@@ -74,12 +74,32 @@ def _children(n: S.PlanNode) -> list[S.PlanNode]:
     return []
 
 
+def _fusion_groups(plan: S.PlanNode) -> dict[int, int]:
+    """id(plan node) -> fused pipeline group (empty when fusion is off).
+    Members of one group collapse into a single per-tile kernel at
+    execution (flow/fuse.py + the spool fusion in flow/operators.py)."""
+    from ..utils import settings
+
+    if not settings.get("sql.distsql.fusion.enabled"):
+        return {}
+    from ..flow.fuse import plan_fusion_groups
+
+    return plan_fusion_groups(plan)
+
+
+def _group_tag(groups: dict[int, int], n: S.PlanNode) -> str:
+    g = groups.get(id(n))
+    return f"  [pipeline {g}]" if g is not None else ""
+
+
 def explain_plan(plan: S.PlanNode) -> str:
     """Render the plan tree (EXPLAIN)."""
     lines: list[str] = []
+    groups = _fusion_groups(plan)
 
     def walk(n: S.PlanNode, depth: int):
-        lines.append("  " * depth + "-> " + _node_label(n))
+        lines.append(
+            "  " * depth + "-> " + _node_label(n) + _group_tag(groups, n))
         for c in _children(n):
             walk(c, depth + 1)
 
@@ -90,13 +110,19 @@ def explain_plan(plan: S.PlanNode) -> str:
 def explain_analyze(plan: S.PlanNode, root_op) -> str:
     """Render the plan tree with executed ComponentStats (EXPLAIN ANALYZE).
     `root_op` must have been run with collect_stats(True)."""
+    from ..flow.fuse import unwrap
+
     lines: list[str] = []
+    groups = _fusion_groups(plan)
 
     def walk(n: S.PlanNode, op, depth: int):
         if isinstance(n, S.Exchange):
             # single-device builds elide the exchange operator
             walk(n.input, op, depth)
             return
+        # fusion-pass wrappers sit between plan nodes; see through them so
+        # the plan-node/operator walk stays one-to-one
+        op = unwrap(op)
         st = op.stats
         excl = st.exclusive(op.children())
         lines.append(
@@ -104,9 +130,14 @@ def explain_analyze(plan: S.PlanNode, root_op) -> str:
             + f"  [rows={st.rows} batches={st.batches} "
             f"bytes={st.bytes} "
             f"time={st.time_s*1e3:.1f}ms self={excl*1e3:.1f}ms]"
+            + _group_tag(groups, n)
         )
         for c, co in zip(_children(n), op.children()):
             walk(c, co, depth + 1)
 
     walk(plan, root_op, 0)
+    # trailing so the tree keeps its root on line 1 (consumers parse that)
+    kd = getattr(getattr(root_op, "stats", None), "kernel_dispatches", 0)
+    if kd:
+        lines.append(f"kernel dispatches: {kd}")
     return "\n".join(lines)

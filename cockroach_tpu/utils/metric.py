@@ -248,3 +248,12 @@ RANGE_CACHE_COALESCED = DEFAULT.counter(
     "range_cache_coalesced_lookups",
     "authoritative meta lookups answered by an in-flight peer lookup "
     "instead of stampeding the meta range (single-flight)")
+KERNEL_DISPATCHES = DEFAULT.counter(
+    "sql_kernel_dispatches",
+    "XLA executable dispatches issued by the flow layer (each jitted "
+    "kernel call is one accelerator round trip; flow/dispatch.py)")
+FUSED_PIPELINE_LENGTHS = DEFAULT.histogram(
+    "sql_fused_pipeline_lengths",
+    "operators collapsed into each FusedPipeline segment by the "
+    "plan-build fusion pass (flow/fuse.py)",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32))

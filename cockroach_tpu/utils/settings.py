@@ -336,3 +336,20 @@ ALLOCATOR_ENABLED = register_bool(
     "run the range-lifecycle queues (split/merge/rebalance) on node start; "
     "the queues are also constructible standalone for deterministic tests",
 )
+FUSION_ENABLED = register_bool(
+    "sql.distsql.fusion.enabled", True,
+    "collapse contiguous stateless per-tile operator chains (filter / "
+    "project / hash-bucket / fusable join probes) into single-kernel "
+    "FusedPipeline segments at plan build (flow/fuse.py), so XLA fuses "
+    "each chain into one dispatch and intermediate padded tiles never "
+    "materialize; off runs the classic one-jit-per-operator pull path",
+    metamorphic=True,
+)
+READBACK_OVERLAP = register_bool(
+    "sql.distsql.readback_overlap", True,
+    "double-buffer the root pull loop (flow/runtime.py): tile k's "
+    "device->host readback is issued asynchronously (copy_to_host_async) "
+    "and materialized while tile k+1 computes, overlapping the slow "
+    "readback tunnel with device work instead of serializing after it",
+    metamorphic=True,
+)

@@ -1,0 +1,98 @@
+"""Kernel-dispatch budget regression guard (tier-1, same spirit as
+check_settings_registered.py).
+
+Runs ONE representative fused query — TPC-H q1, a scan -> filter ->
+project -> group-by chain — at two tile sizes and checks two budgets
+against flow/dispatch.py's per-call accounting:
+
+- **steady total**: warm (post-adaptive-learning) dispatches for the whole
+  query must stay at or under BUDGET_STEADY. A fusion regression (a chain
+  member silently falling back to its own per-operator jit) roughly
+  doubles this.
+- **per tile**: halving the tile size doubles the input tile count; the
+  dispatch increase per extra tile must stay at or under BUDGET_PER_TILE
+  (the fused pipeline pays exactly ONE pre-aggregation dispatch per tile).
+
+Budgets are recorded constants, not ratios, so a regression shows up as a
+hard failure with the measured numbers in the message. Runnable directly:
+
+    python -m scripts.check_dispatch_budget
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# measured 8 with the fusion pass on (6 input tiles): 6 fused
+# slice+filter+project+group+merge dispatches + finalize + sort. The
+# unfused engine measures 31.
+BUDGET_STEADY = 10
+# ONE fused pre-aggregation kernel per extra input tile (acceptance
+# criterion of the fusion work; measured exactly 1.0) — the accumulator
+# merge rides inside the fold step kernel. The unfused engine pays 5.
+BUDGET_PER_TILE = 1.25
+
+_SF = 0.001
+_TILE = 1024
+
+
+def _steady_dispatches(cat, tile: int) -> int:
+    from cockroach_tpu.bench import queries as Q
+    from cockroach_tpu.flow import dispatch
+    from cockroach_tpu.flow.runtime import run_operator
+    from cockroach_tpu.plan import builder as plan_builder
+    from cockroach_tpu.utils import settings
+
+    settings.set("sql.distsql.tile_size", tile)
+    root = plan_builder.build(Q.QUERIES["q1"](cat).optimized_plan(), cat)
+    run_operator(root)  # warm: compile + adaptive capacity learning
+    d0 = dispatch.total()
+    run_operator(root)
+    return dispatch.total() - d0
+
+
+def check() -> list[str]:
+    """Returns a list of human-readable violations (empty = clean)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from cockroach_tpu.bench.tpch import gen_tpch
+    from cockroach_tpu.utils import settings
+
+    problems = []
+    try:
+        settings.set("sql.distsql.fusion.enabled", True)
+        cat = gen_tpch(sf=_SF, seed=3)
+        tiles = -(-cat.get("lineitem").num_rows // _TILE)
+        steady = _steady_dispatches(cat, _TILE)
+        if steady > BUDGET_STEADY:
+            problems.append(
+                f"q1 steady-state kernel dispatches {steady} exceed the "
+                f"recorded budget {BUDGET_STEADY} ({tiles} input tiles) — "
+                "a pipeline member stopped fusing or a new per-tile "
+                "dispatch crept into the pull loop")
+        halved = _steady_dispatches(cat, _TILE // 2)
+        per_tile = (halved - steady) / tiles
+        if per_tile > BUDGET_PER_TILE:
+            problems.append(
+                f"marginal dispatches per extra input tile {per_tile:.2f} "
+                f"({steady} -> {halved} when tiles double from {tiles}) "
+                f"exceed the budget {BUDGET_PER_TILE} — the per-tile "
+                "chain is no longer one fused kernel")
+    finally:
+        settings.reset("sql.distsql.tile_size")
+        settings.reset("sql.distsql.fusion.enabled")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"FAIL: {p}", file=sys.stderr)
+    if not problems:
+        print("dispatch budget clean: fused pipeline within "
+              f"{BUDGET_STEADY} steady / {BUDGET_PER_TILE}-per-tile")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

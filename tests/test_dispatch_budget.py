@@ -1,0 +1,11 @@
+"""Tier-1 wiring for the kernel-dispatch budget guard
+(scripts/check_dispatch_budget.py): one representative fused query must
+stay within its recorded dispatch budget, and the marginal cost of an
+extra input tile must stay one fused kernel."""
+
+from scripts.check_dispatch_budget import check
+
+
+def test_dispatch_budget():
+    problems = check()
+    assert not problems, "\n".join(problems)

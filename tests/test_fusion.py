@@ -1,0 +1,185 @@
+"""Fusion-equivalence sweep + pull-loop readback tests.
+
+`sql.distsql.fusion.enabled=off` degrades the engine to classic
+one-jit-per-operator pulls (both the plan-build pass in flow/fuse.py and
+the consumer-driven spool fusion in flow/operators.py) — the oracle every
+fused run must match bit-for-bit, including the speculative-capacity retry
+path and both readback-overlap modes.
+
+A representative subset runs tier-1; the full TPC-H + TPC-DS corpus is
+marked slow (compile-bound: each fused chain jits per query)."""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.bench import queries as Q
+from cockroach_tpu.bench import tpcds, tpch
+from cockroach_tpu.utils import settings
+
+# tier-1 representatives: dense group-by (q1), join chain + top-k (q3),
+# scalar agg (q6), 5-way join + expr group (q9), semi-join style agg (q18)
+_FAST_TPCH = {"q1", "q3", "q6", "q9", "q18"}
+_FAST_TPCDS = {"q3", "q42"}
+
+
+@pytest.fixture(scope="module")
+def hcat():
+    return tpch.gen_tpch(sf=0.005, seed=7)
+
+
+@pytest.fixture(scope="module")
+def dcat():
+    return tpcds.gen_tpcds(sf=0.01)
+
+
+def _run(rel, fusion: bool, overlap: bool = True):
+    settings.set("sql.distsql.fusion.enabled", fusion)
+    settings.set("sql.distsql.readback_overlap", overlap)
+    try:
+        return rel.run()
+    finally:
+        settings.reset("sql.distsql.fusion.enabled")
+        settings.reset("sql.distsql.readback_overlap")
+
+
+def _assert_identical(got, want):
+    assert set(got) == set(want)
+    for name in want:
+        g, w = np.asarray(got[name]), np.asarray(want[name])
+        assert g.shape == w.shape, name
+        if g.dtype == object or w.dtype == object:
+            assert list(g) == list(w), name
+        else:
+            # bit-identical, not allclose: fusion must not reassociate
+            np.testing.assert_array_equal(g, w, err_msg=name)
+
+
+@pytest.mark.parametrize(
+    "qname",
+    [pytest.param(q, marks=() if q in _FAST_TPCH else (pytest.mark.slow,))
+     for q in sorted(Q.QUERIES)],
+)
+def test_tpch_fusion_equivalence(hcat, qname):
+    rel = Q.QUERIES[qname](hcat)
+    _assert_identical(_run(rel, fusion=True), _run(rel, fusion=False))
+
+
+@pytest.mark.parametrize(
+    "qname",
+    [pytest.param(q, marks=() if q in _FAST_TPCDS else (pytest.mark.slow,))
+     for q in sorted(tpcds.QUERIES)],
+)
+def test_tpcds_fusion_equivalence(dcat, qname):
+    rel = tpcds.QUERIES[qname](dcat)
+    _assert_identical(_run(rel, fusion=True), _run(rel, fusion=False))
+
+
+def test_readback_overlap_equivalence(hcat):
+    rel = Q.QUERIES["q3"](hcat)
+    _assert_identical(_run(rel, fusion=True, overlap=True),
+                      _run(rel, fusion=True, overlap=False))
+
+
+def test_retry_path_equivalence(hcat):
+    """Speculative-capacity overflow under fusion: shrinking a learned
+    join emission capacity must trigger the post_run_update -> re-run path
+    and still produce the unfused oracle's exact results."""
+    from cockroach_tpu.flow import runtime
+    from cockroach_tpu.flow.operators import HashJoinOp
+    from cockroach_tpu.plan import builder as plan_builder
+
+    rel = Q.QUERIES["q3"](hcat)
+    oracle = _run(rel, fusion=False)
+    settings.set("sql.distsql.fusion.enabled", True)
+    try:
+        root = plan_builder.build(rel.optimized_plan(), rel.catalog)
+        runtime.run_operator(root)  # learn emission capacities
+
+        joins = []
+
+        def walk(op):
+            if isinstance(op, HashJoinOp):
+                joins.append(op)
+            for c in op.children():
+                walk(c)
+
+        walk(root)
+        assert joins, "q3 plan lost its hash joins"
+        for j in joins:
+            j._emit_mode = "compact"
+            j._emit_cap = 16  # guaranteed overflow at sf 0.005
+
+        inits = 0
+        orig_init = root.init
+
+        def counting_init():
+            nonlocal inits
+            inits += 1
+            orig_init()
+
+        root.init = counting_init
+        res = runtime.run_operator(root)
+        assert inits >= 2, "overflow did not trigger the re-run path"
+    finally:
+        settings.reset("sql.distsql.fusion.enabled")
+    _assert_identical(res, oracle)
+
+
+def test_readback_shrink_overflow_patch():
+    """_ReadbackShrink speculation: a large tile compacts to capacity/64
+    with NO host sync; when the deferred count shows the compaction
+    truncated live rows, finish() re-materializes from the retained
+    original — no rows lost."""
+    from cockroach_tpu.coldata.batch import from_host, to_host
+    from cockroach_tpu.coldata.types import INT64, Schema
+    from cockroach_tpu.flow.runtime import _ReadbackShrink
+
+    schema = Schema(("v",), (INT64,))
+    cap = _ReadbackShrink.MIN_CAP  # 64k tile
+    live = cap // 2  # far more live rows than the cap/64 shrink target
+    b = from_host(schema, {"v": np.arange(live, dtype=np.int64)},
+                  capacity=cap)
+
+    shrink = _ReadbackShrink()
+    small = shrink.shrink(b)
+    assert small.capacity == cap >> 6  # speculation actually engaged
+    outs = [to_host(small, schema, {})]
+    assert len(outs[0]["v"]) < live  # truncated pre-patch
+    shrink.finish(outs, schema, {})
+    np.testing.assert_array_equal(outs[0]["v"],
+                                  np.arange(live, dtype=np.int64))
+
+    # small tiles pass through untouched (no compact dispatch to pay)
+    tiny = from_host(schema, {"v": np.arange(10, dtype=np.int64)},
+                     capacity=1024)
+    assert shrink.shrink(tiny) is tiny
+
+
+def test_explain_shows_pipeline_groups(hcat):
+    rel = Q.QUERIES["q1"](hcat)
+    settings.set("sql.distsql.fusion.enabled", True)
+    try:
+        fused = rel.explain()
+    finally:
+        settings.reset("sql.distsql.fusion.enabled")
+    assert "[pipeline" in fused
+    settings.set("sql.distsql.fusion.enabled", False)
+    try:
+        plain = rel.explain()
+    finally:
+        settings.reset("sql.distsql.fusion.enabled")
+    assert "[pipeline" not in plain
+
+
+def test_explain_analyze_reports_dispatches(hcat):
+    rel = Q.QUERIES["q1"](hcat)
+    settings.set("sql.distsql.fusion.enabled", True)
+    try:
+        text, res = rel.explain_analyze()
+    finally:
+        settings.reset("sql.distsql.fusion.enabled")
+    last = text.splitlines()[-1]
+    assert last.startswith("kernel dispatches: ")
+    assert int(last.split(": ")[1]) > 0
+    assert "[pipeline" in text
+    assert len(res["l_returnflag"]) > 0
