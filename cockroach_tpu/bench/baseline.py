@@ -34,7 +34,6 @@ import json
 import sqlite3
 import time
 
-import numpy as np
 
 from . import tpch
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..catalog import Catalog, Table
-from ..coldata.types import DATE, DECIMAL, INT32, INT64, STRING, Schema
+from ..coldata.types import DATE, DECIMAL, INT64, STRING, Schema
 
 EPOCH = np.datetime64("1970-01-01")
 START_DATE = (np.datetime64("1992-01-01") - EPOCH).astype(int)  # 8035

@@ -15,7 +15,7 @@ from dataclasses import dataclass, field
 import jax.numpy as jnp
 import numpy as np
 
-from .coldata.batch import Batch, Column, Dictionary, from_host
+from .coldata.batch import Batch, Dictionary, from_host
 from .coldata.types import Family, Schema
 
 

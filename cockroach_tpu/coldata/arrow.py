@@ -28,7 +28,7 @@ from __future__ import annotations
 import numpy as np
 import pyarrow as pa
 
-from .batch import Batch, Dictionary, to_host
+from .batch import Batch, Dictionary
 from .types import Family, Schema, SQLType
 
 

@@ -21,13 +21,13 @@ through jit / shard_map / collectives directly. All schema information
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, replace
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .types import BYTES, Family, Schema, SQLType, zeros_like_type
+from .types import Family, Schema, zeros_like_type
 
 DEFAULT_CAPACITY = 4096  # coldata.MaxBatchSize (pkg/col/coldata/batch.go:102)
 
@@ -246,7 +246,7 @@ def to_host(
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("capacity",))
+@functools.partial(jax.jit, static_argnames=("capacity",))  # crlint: allow-raw-jit(shared helper: call sites count via dispatch.note)
 def compact(batch: Batch, capacity: int | None = None) -> Batch:
     """Pack live rows to the front of a (possibly smaller) tile.
 

@@ -30,7 +30,7 @@ over a static-capacity tile, compacted only at operator boundaries that need it.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax.numpy as jnp
 import numpy as np

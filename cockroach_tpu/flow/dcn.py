@@ -170,7 +170,7 @@ class FlowServer:
                 if make_op is None:
                     continue
                 FlowOutbox(make_op(), conn).run()
-            except Exception as e:
+            except Exception as e:  # crlint: allow-broad-except(accept loop survives any one connection/operator failure; logged below)
                 # operator/stream errors too: one connection's failure
                 # (including a flow whose operator raises mid-stream) must
                 # never take down the accept loop

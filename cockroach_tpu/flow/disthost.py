@@ -39,7 +39,7 @@ import uuid
 
 from ..coldata.types import Schema
 from ..plan import spec as S
-from ..utils import faults, metric, retry
+from ..utils import faults, locks, metric, retry
 from ..utils.faults import InjectedFault
 from . import wire
 from .dcn import FlowInbox, FlowOutbox, _recv_msg, _send_msg
@@ -60,7 +60,7 @@ class HostFlowServer:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = locks.lock("flow.host.conns")
         self._handlers: list[threading.Thread] = []
         # the flow registry: (flow_id, stream_id) -> (operator, expiry)
         # waiting for its stream connection (flow_registry.go:164); flows
@@ -69,7 +69,7 @@ class HostFlowServer:
         # flow_id -> poison expiry: cancelled flows reject late setups and
         # wake stream-waiters immediately instead of timing out
         self._cancelled: dict[str, float] = {}
-        self._reg_lock = threading.Condition()
+        self._reg_lock = locks.condition("flow.host.registry")
         self.stream_wait_s = stream_wait_s
         self.flow_ttl_s = flow_ttl_s
 
@@ -126,7 +126,7 @@ class HostFlowServer:
                     _send_msg(conn, json.dumps({
                         "error": str(e)}).encode("utf-8"))
                     return
-                except Exception as e:
+                except Exception as e:  # crlint: allow-broad-except(rejection reason is reported to the gateway over the wire)
                     # the gateway must learn WHY its fragment was rejected
                     # (unknown table, undecodable spec), not just see a
                     # closed socket
@@ -141,7 +141,7 @@ class HostFlowServer:
                 self._cancel_flow(conn, req)
             else:
                 _send_msg(conn, b'{"error": "unknown op"}')
-        except Exception as e:
+        except Exception as e:  # crlint: allow-broad-except(connection handler: error logged, socket severed below)
             log.warning(log.OPS, "host flow connection failed",
                         error=f"{type(e).__name__}: {e}")
         finally:

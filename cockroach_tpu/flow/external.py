@@ -30,10 +30,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..coldata.batch import Batch, Column, from_host
+from ..coldata.batch import Batch, from_host
 from ..coldata.types import Family, Schema
 from ..ops import join as join_ops
-from ..ops import merge_join as mj_ops
 from ..ops import sort as sort_ops
 from ..ops.hashing import hash_columns
 from . import dispatch

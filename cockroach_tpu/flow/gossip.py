@@ -16,6 +16,7 @@ import socket
 import threading
 import time
 
+from ..utils import locks
 from .dcn import _recv_msg, _send_msg
 
 
@@ -58,7 +59,7 @@ class Gossip:
         self._infos: dict[str, Info] = {}
         self._node_epochs: dict[int, int] = {}  # highest KNOWN epoch
         self._clock = 0
-        self._lock = threading.Lock()
+        self._lock = locks.lock("gossip")
         self._srv: socket.socket | None = None
         self._stop = threading.Event()
 

@@ -11,7 +11,6 @@ HBM pressure follows under static shapes)."""
 
 from __future__ import annotations
 
-import numpy as np
 
 from ..coldata.batch import Batch
 

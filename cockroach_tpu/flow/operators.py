@@ -34,7 +34,7 @@ import numpy as np
 
 from ..catalog import Table
 from ..coldata.batch import Batch, Column, Dictionary, concat
-from ..coldata.types import FLOAT64, INT64, Family, Schema, SQLType
+from ..coldata.types import FLOAT64, Family, Schema
 from ..ops import aggregation as agg_ops
 from ..ops.aggregation import partial_layout
 from ..ops import expr as ex
@@ -55,6 +55,7 @@ def _live_total(tiles: list[Batch]) -> int:
     """Total live rows across spooled tiles — ONE host sync for the spool."""
     if not tiles:
         return 0
+    # crlint: allow-host-sync(one stacked sync per spool finalize, not per tile)
     return int(sum(jnp.sum(t.mask, dtype=jnp.int64) for t in tiles))
 
 
@@ -273,6 +274,7 @@ class ScanOp(SourceOperator):
     def _init_streaming(self):
         t = self.table
         names = self.output_schema.names
+        # crlint: allow-host-sync(catalog columns are host-resident numpy)
         self._host_cols = {n: np.asarray(t.columns[n]) for n in names}
         self._host_valids = {n: t.valids[n] for n in names if n in t.valids}
         self._nrows = t.num_rows
@@ -837,6 +839,7 @@ class AggregateOp(OneInputOperator):
 
     # -- string_agg host path ------------------------------------------------
 
+    # crlint: allow-host-sync(string_agg host path: object-dtype strings cannot live on device)
     def _collect_sagg(self, b: Batch) -> None:
         """Append (group key tuple -> string values) for every live row of
         one input tile, in row order."""
@@ -858,6 +861,7 @@ class AggregateOp(OneInputOperator):
                      else str(code))
                 store.setdefault(key, []).append(v)
 
+    # crlint: allow-host-sync(string_agg host path: hashable python keys)
     def _host_group_keys(self, b: Batch, idx: np.ndarray) -> list[tuple]:
         """Hashable per-row group keys (None for NULL key columns) over the
         rows at `idx` — for SOURCE-schema batches (complete mode)."""
@@ -872,6 +876,7 @@ class AggregateOp(OneInputOperator):
             ])
         return list(zip(*parts)) if parts else [()] * len(idx)
 
+    # crlint: allow-host-sync(string_agg host path: runs once at finalize)
     def _attach_saggs(self, final: Batch) -> Batch:
         """Overwrite each string_agg placeholder column with codes into a
         runtime-built Dictionary of per-group concatenations."""
@@ -1544,6 +1549,7 @@ class HashJoinOp(OneInputOperator):
     def post_run_update(self) -> bool:
         if not self._emit_counts:
             return False
+        # crlint: allow-host-sync(post_run_update: ONE stacked sync per query)
         counts = np.asarray(jax.block_until_ready(
             jnp.stack(self._emit_counts)
         ))
@@ -1934,7 +1940,7 @@ class ParallelUnorderedSyncOp(Operator):
                 if b is None:
                     break
                 self._q.put(b)
-        except BaseException as e:  # surface in the consumer, not a log
+        except BaseException as e:  # surface in the consumer, not a log  # crlint: allow-broad-except(producer thread forwards the exception to the consumer via the queue)
             self._q.put(e)
             return
         self._q.put(self._DONE)
@@ -1956,14 +1962,16 @@ class ParallelUnorderedSyncOp(Operator):
         a producer blocked in put() always gets space to observe stop."""
         if not getattr(self, "_threads", None):
             return
+        import queue
+
         self._stop.set()
         for t in self._threads:
             while t.is_alive():
                 try:
                     while True:
                         self._q.get_nowait()
-                except Exception:
-                    pass
+                except queue.Empty:
+                    pass  # drained — producers have space to observe stop
                 t.join(timeout=0.05)
         self._threads = []
 

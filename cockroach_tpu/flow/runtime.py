@@ -34,7 +34,7 @@ def _start_readback(b) -> None:
         if start is not None:
             try:
                 start()
-            except Exception:
+            except Exception:  # crlint: allow-broad-except(best-effort async prefetch; to_host still blocks correctly)
                 return  # best-effort: to_host still blocks correctly
 
 
@@ -80,6 +80,7 @@ class _ReadbackShrink:
             return
         import jax.numpy as jnp
 
+        # crlint: allow-host-sync(deferred shrink counts: ONE stacked sync at query end by design)
         counts = np.asarray(jnp.stack([c for *_, c in self._checks]))
         for (i, orig, cap, _), n in zip(self._checks, counts):
             if int(n) > cap:

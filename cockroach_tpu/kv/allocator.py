@@ -32,7 +32,7 @@ import time
 from dataclasses import dataclass
 
 from ..storage.lsm import WriteIntentError
-from ..utils import faults, log, metric, settings
+from ..utils import faults, locks, log, metric, settings
 from .loadstats import RangeLoadStats
 from .queues import ReplicaQueue
 from .txn import TransactionRetryError
@@ -71,7 +71,7 @@ class StorePool:
 
     def __init__(self, gossip=None):
         self.gossip = gossip
-        self._mu = threading.Lock()
+        self._mu = locks.lock("kv.allocator")
         self._caps: dict[int, StoreCapacity] = {}
 
     def note(self, cap: StoreCapacity) -> None:
@@ -402,7 +402,7 @@ class RangeLifecycle:
         while not self._stop.wait(self.interval_s):
             try:
                 self.scan_once()
-            except Exception as e:  # a scan must never kill the loop
+            except Exception as e:  # a scan must never kill the loop  # crlint: allow-broad-except(background scan loop must survive; logged)
                 log.warning(log.OPS, "range lifecycle scan failed",
                             error=str(e))
 

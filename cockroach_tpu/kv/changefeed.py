@@ -24,6 +24,7 @@ import json
 import numpy as np
 
 from ..storage import keys as K
+from ..utils import locks
 from .jobs import Job, Registry
 from .txn import DB
 
@@ -178,7 +179,7 @@ class RangefeedServer:
         # same port must not collide with a previous incarnation's
         # still-established subscriber sockets
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = locks.lock("kv.changefeed.conns")
         self._accept_thread = threading.Thread(target=self._serve,
                                                daemon=True)
         self._accept_thread.start()

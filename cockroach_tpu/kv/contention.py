@@ -12,9 +12,10 @@ seen) with the same bounded-memory discipline as sqlstats, surfaced via
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
+
+from ..utils import locks
 
 
 @dataclass
@@ -28,7 +29,7 @@ class ContentionEvent:
 
 class ContentionRegistry:
     def __init__(self, max_keys: int = 2000):
-        self._lock = threading.Lock()
+        self._lock = locks.lock("kv.contention")
         self._by_key: dict[bytes, ContentionEvent] = {}
         self.max_keys = max_keys
         self.evicted = 0

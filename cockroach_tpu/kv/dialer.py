@@ -13,9 +13,9 @@ a new port)."""
 
 from __future__ import annotations
 
-import threading
 import time
 
+from ..utils import locks
 from .rpc import BatchClient
 
 _KEY = "node/%d/kv"
@@ -102,7 +102,7 @@ class NodeDialer:
         self._breakers: dict[int, _Breaker] = {}
         self._trip = trip_threshold
         self._cooldown = cooldown_s
-        self._lock = threading.Lock()
+        self._lock = locks.lock("kv.dialer")
 
     def resolve(self, node_id: int) -> tuple:
         addr = self.gossip.get_info(_KEY % node_id)

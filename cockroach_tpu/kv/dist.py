@@ -47,7 +47,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..storage.lsm import Engine
-from ..utils import log, metric
+from ..utils import locks, log, metric
 
 
 class RangeKeyMismatchError(Exception):
@@ -73,7 +73,7 @@ class Meta:
     [b"", split1), [split1, split2), ... [splitN, None)."""
 
     def __init__(self, first_store: int = 1):
-        self._lock = threading.RLock()
+        self._lock = locks.rlock("kv.rangecache")
         self._next_id = 2
         self._descs: list[RangeDescriptor] = [
             RangeDescriptor(1, b"", None, first_store)
@@ -175,7 +175,7 @@ class RangeCache:
 
     def __init__(self, meta: Meta):
         self.meta = meta
-        self._mu = threading.Lock()
+        self._mu = locks.lock("kv.singleflight")
         self._by_start: dict[bytes, RangeDescriptor] = {}
         self._inflight: dict[bytes, threading.Event] = {}
         self.misses = 0
@@ -295,7 +295,7 @@ class DistSender:
         self.meta = meta
         self.stores = {s.store_id: s for s in stores}
         self.cache = RangeCache(meta)
-        self.mu = threading.RLock()
+        self.mu = locks.rlock("kv.distsender")
         first = stores[0].engine
         self.key_width = first.key_width
         self.val_width = first.val_width

@@ -231,7 +231,7 @@ class Registry:
                     continue  # heartbeated between checks; leave it alone
             try:
                 out.append(self.adopt_and_resume(job.job_id))
-            except Exception as e:
+            except Exception as e:  # crlint: allow-broad-except(adoption failure is per-job; logged, loop continues)
                 log.warning(log.OPS, "orphan adoption failed",
                             job=job.job_id, error=str(e))
         return out

@@ -23,7 +23,7 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass
 
-from .txn import DB, TransactionRetryError
+from .txn import DB
 
 # system keyspace: table id 0's prefix byte (0x01) + a NUL-free tag — the
 # engine's zero-padded fixed-width keys reject 0x00 bytes, so node ids

@@ -19,8 +19,9 @@ The clock is injectable so tests can step time deterministically.
 from __future__ import annotations
 
 import random
-import threading
 import time
+
+from ..utils import locks
 
 
 class DecayingCounter:
@@ -75,7 +76,7 @@ class RangeLoadStats:
         self.sample_size = int(sample_size)
         self._rng = random.Random(seed)
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = locks.lock("kv.loadstats")
         self._ranges: dict[int, _RangeLoad] = {}
 
     def _load(self, range_id: int) -> _RangeLoad:

@@ -20,7 +20,7 @@ import heapq
 import threading
 import time
 
-from ..utils import log, metric
+from ..utils import locks, log, metric
 
 
 class ReplicaQueue:
@@ -49,7 +49,7 @@ class ReplicaQueue:
         self.purgatory_interval_s = float(purgatory_interval_s)
         self.max_backoff_s = float(max_backoff_s)
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = locks.lock(f"kv.queue.{name}")
         self._heap: list[tuple[float, int, object]] = []  # (-prio, seq, item)
         self._queued: dict[object, float] = {}            # item -> priority
         self._purgatory: dict[object, tuple[int, float]] = {}  # (tries, due)
@@ -116,7 +116,7 @@ class ReplicaQueue:
             log.warning(log.OPS, "queue item sent to purgatory",
                         queue=self.name, item=str(item), tries=tries,
                         error=str(e))
-        except Exception as e:
+        except Exception as e:  # crlint: allow-broad-except(queue processor drops the item with a failure metric + log)
             self.failures.inc()
             log.warning(log.OPS, "queue item dropped", queue=self.name,
                         item=str(item), error=str(e))

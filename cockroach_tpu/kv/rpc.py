@@ -26,6 +26,7 @@ import threading
 import uuid
 
 from ..storage.lsm import WriteIntentError
+from ..utils import locks
 from ..utils.errors import register_passthrough
 from ..utils.faults import InjectedFault
 from .liveness import EpochFencedError, NotLeaseHolderError
@@ -84,7 +85,7 @@ class BatchServer:
         self.addr = self._srv.getsockname()
         self._stop = threading.Event()
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = locks.lock("rpc.server.conns")
         self._threads: list[threading.Thread] = []
         self._accept_thread = threading.Thread(
             target=self._serve, daemon=True, name="kv-batch-server")
@@ -143,7 +144,7 @@ class BatchServer:
                     resp = {"error": str(e), "code": "WriteIntentError",
                             "keys": [_b64(k) for k in e.keys],
                             "txns": list(e.txns)}
-                except Exception as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001  # crlint: allow-broad-except(server loop converts the error to a wire response for the client)
                     resp = {"error": f"{type(e).__name__}: {e}",
                             "code": "Internal"}
                 _send_msg(conn, json.dumps(resp).encode("utf-8"))
@@ -288,7 +289,7 @@ class BatchClient:
         self.cid = f"{uuid.uuid4().hex[:12]}-{next(_client_ids)}"
         self._seq = itertools.count(1)
         self._sock = self._dial()
-        self._lock = threading.Lock()
+        self._lock = locks.lock("rpc.client.pool")
 
     def _dial(self) -> socket.socket:
         s = socket.create_connection(self.addr, timeout=self.deadline_s)

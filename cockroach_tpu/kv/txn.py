@@ -188,8 +188,13 @@ def _record_contention(e: WriteIntentError, waiting_txn: int) -> None:
         from .contention import DEFAULT
 
         DEFAULT.record(e.keys, e.txns, waiting_txn)
-    except Exception:  # pragma: no cover - registry must not mask errors
-        pass
+    # crlint: allow-broad-except(conflict path must not fail on observability; logged + counted)
+    except Exception as rec_err:  # pragma: no cover - registry must not mask errors
+        from ..utils import log, metric
+
+        metric.CONTENTION_RECORD_ERRORS.inc()
+        log.warning(log.OPS, "contention record failed",
+                    error=f"{type(rec_err).__name__}: {rec_err}")
 
 
 class DB:

@@ -1,0 +1,38 @@
+"""crlint — repo-specific AST static analysis (the pkg/testutils/lint analog).
+
+The reference enforces project invariants nobody can hold in their head with
+a lint package full of custom passes (pkg/testutils/lint/lint_test.go: no
+direct os.Exit, forbidden imports, timeutil discipline ...). The invariants
+this engine's last PRs established by hand are exactly that shape, so they
+are machine-checked here on every run:
+
+- **host-sync**: no implicit device->host transfer (``int()``/``float()``/
+  ``bool()`` on traced values, ``.item()``, ``np.asarray``, truth tests on
+  traced expressions) inside the hot-path tile pull loop. One stray sync
+  reintroduces the per-tile stall the overlapped-readback work removed.
+- **raw-jit**: every ``jax.jit``/``jax.pmap``/``jax.shard_map`` reference
+  outside ``flow/dispatch.py`` must route through ``dispatch.jit`` so the
+  ``sql_kernel_dispatches`` accounting and the dispatch-budget guard cannot
+  be silently bypassed.
+- **lock-order**: the cross-module lock acquisition graph (extracted from
+  lock attributes and the lock-held call graph) must be acyclic. The
+  runtime half lives in ``utils/locks.py`` (debug-mode OrderedLock).
+- **broad-except**: ``except Exception`` in ``kv/``, ``flow/``, ``server/``
+  must re-raise, raise a typed error, or carry a pragma; a bare ``pass``
+  handler is a hard error no pragma can excuse.
+- **unused-import**: imported names never referenced are dead surface area.
+
+Suppression is per-line and must carry a reason::
+
+    risky()  # crlint: allow-<rule>(why this one is fine)
+
+Run locally::
+
+    python -m cockroach_tpu.lint cockroach_tpu scripts
+
+This package imports only the stdlib (no jax) so it runs anywhere, fast.
+"""
+
+from __future__ import annotations
+
+from .core import Finding, SourceFile, load_files, run_lint  # noqa: F401

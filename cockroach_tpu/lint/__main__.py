@@ -1,0 +1,39 @@
+"""CLI: ``python -m cockroach_tpu.lint [--json] [--rule R ...] paths...``
+
+Exit 0 when clean, 1 when any unsuppressed finding survives — the same
+contract as scripts/check_lint.py, which wires this into tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import ALL_RULES, report_json, report_text, run_lint
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cockroach_tpu.lint",
+        description="crlint: repo-specific AST static analysis")
+    ap.add_argument("paths", nargs="+",
+                    help="files or directories to lint")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings")
+    ap.add_argument("--rule", action="append", choices=ALL_RULES,
+                    help="run only this rule (repeatable)")
+    args = ap.parse_args(argv)
+    findings = run_lint(args.paths,
+                        tuple(args.rule) if args.rule else None)
+    if args.as_json:
+        print(report_json(findings))
+    elif findings:
+        print(report_text(findings), file=sys.stderr)
+    else:
+        rules = ", ".join(args.rule) if args.rule else "all rules"
+        print(f"crlint clean ({rules}) over {', '.join(args.paths)}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

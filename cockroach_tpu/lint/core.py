@@ -1,0 +1,201 @@
+"""crlint framework: file loading, pragma handling, rule registry, reporters.
+
+A rule is a function ``check(file: SourceFile) -> list[Finding]`` (per-file
+rules) or ``check(files: list[SourceFile]) -> list[Finding]`` (tree rules —
+the lock-order pass needs the whole cross-module graph). Findings are
+suppressed by an inline pragma on the finding line or the line directly
+above it::
+
+    x = int(count)  # crlint: allow-host-sync(one sync at query end, by design)
+
+The reason is mandatory: a bare ``allow-<rule>()`` does not suppress (the
+pragma exists to document WHY the invariant is waived, not to mute it).
+Findings with ``suppressible=False`` (silent ``except: pass`` swallows)
+ignore pragmas entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+_PRAGMA = re.compile(r"#\s*crlint:\s*allow-([a-z0-9_-]+)\(([^()]*)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # canonical package-relative posix path
+    line: int
+    message: str
+    suppressible: bool = True
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class SourceFile:
+    path: pathlib.Path      # on-disk location
+    rel: str                # canonical key rules match on (posix)
+    text: str
+    tree: ast.AST
+    # line -> {rule: reason} pragmas (comments only — string literals that
+    # happen to contain the pattern don't suppress)
+    pragmas: dict[int, dict[str, str]] = field(default_factory=dict)
+    # (start, end, rule) ranges from def/class-line pragmas: a pragma on a
+    # function's `def` line (or the line above it) waives the rule for the
+    # whole body — for functions that are host-side by design, one
+    # documented waiver instead of one per statement
+    scoped: list[tuple[int, int, str]] = field(default_factory=list)
+
+    def __post_init__(self):
+        import ast as _ast
+        for node in _ast.walk(self.tree):
+            if not isinstance(node, (_ast.FunctionDef,
+                                     _ast.AsyncFunctionDef, _ast.ClassDef)):
+                continue
+            for ln in (node.lineno, node.lineno - 1):
+                for rule, reason in self.pragmas.get(ln, {}).items():
+                    if reason:
+                        self.scoped.append(
+                            (node.lineno, node.end_lineno or node.lineno,
+                             rule))
+
+    @property
+    def modname(self) -> str:
+        return self.rel[:-3].replace("/", ".") if self.rel.endswith(".py") \
+            else self.rel.replace("/", ".")
+
+    def allows(self, rule: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            reason = self.pragmas.get(ln, {}).get(rule)
+            if reason:  # empty reason does not suppress
+                return True
+        return any(start <= line <= end and rule == r
+                   for start, end, r in self.scoped)
+
+
+def _canonical_rel(path: pathlib.Path, root: pathlib.Path) -> str:
+    """Path key rules match on: anchored at the last ``cockroach_tpu`` or
+    ``scripts``/``tests`` component so fixture trees under tmp dirs scope
+    exactly like the real tree."""
+    parts = path.resolve().parts
+    for anchor in ("cockroach_tpu", "scripts", "tests"):
+        if anchor in parts[:-1]:
+            i = len(parts) - 2 - parts[:-1][::-1].index(anchor)
+            return "/".join(parts[i:])
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.name
+
+
+def _collect_pragmas(text: str) -> dict[int, dict[str, str]]:
+    pragmas: dict[int, dict[str, str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(iter(text.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            for m in _PRAGMA.finditer(tok.string):
+                pragmas.setdefault(tok.start[0], {})[m.group(1)] = \
+                    m.group(2).strip()
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return pragmas
+
+
+def load_files(paths: list[str | pathlib.Path]) -> list[SourceFile]:
+    """Expand files/directories into parsed SourceFiles (sorted, deduped;
+    __pycache__ skipped). Unparseable files raise — a syntax error in the
+    tree is itself a finding-worthy failure, loudly."""
+    roots = [pathlib.Path(p) for p in paths]
+    seen: dict[pathlib.Path, SourceFile] = {}
+    for root in roots:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        base = root if root.is_dir() else root.parent
+        for path in files:
+            rp = path.resolve()
+            if rp in seen or "__pycache__" in path.parts:
+                continue
+            text = path.read_text()
+            seen[rp] = SourceFile(
+                path=path,
+                rel=_canonical_rel(path, base),
+                text=text,
+                tree=ast.parse(text, filename=str(path)),
+                pragmas=_collect_pragmas(text),
+            )
+    return sorted(seen.values(), key=lambda f: f.rel)
+
+
+def attr_chain(node: ast.AST) -> tuple[str, ...] | None:
+    """('jax','jit') for ``jax.jit``; None when the base isn't a Name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _rules():
+    # late import: the rule modules import core for helpers
+    from . import errdiscipline, hostsync, lockorder, rawjit, unusedimport
+    per_file = {
+        "host-sync": hostsync.check,
+        "raw-jit": rawjit.check,
+        "broad-except": errdiscipline.check,
+        "unused-import": unusedimport.check,
+    }
+    tree = {"lock-order": lockorder.check}
+    return per_file, tree
+
+
+ALL_RULES = ("host-sync", "raw-jit", "broad-except", "unused-import",
+             "lock-order")
+
+
+def run_lint(paths: list[str | pathlib.Path],
+             rules: tuple[str, ...] | None = None) -> list[Finding]:
+    """Run the selected passes; returns unsuppressed findings sorted by
+    location."""
+    files = load_files(paths)
+    per_file, tree = _rules()
+    wanted = set(rules or ALL_RULES)
+    findings: list[Finding] = []
+    by_rel = {f.rel: f for f in files}
+    for name, check in per_file.items():
+        if name not in wanted:
+            continue
+        for f in files:
+            findings.extend(check(f))
+    for name, check in tree.items():
+        if name in wanted:
+            findings.extend(check(files))
+    live = []
+    for fd in findings:
+        src = by_rel.get(fd.path)
+        if fd.suppressible and src is not None and src.allows(fd.rule, fd.line):
+            continue
+        live.append(fd)
+    return sorted(live, key=lambda f: (f.path, f.line, f.rule))
+
+
+def report_text(findings: list[Finding]) -> str:
+    return "\n".join(f.render() for f in findings)
+
+
+def report_json(findings: list[Finding]) -> str:
+    return json.dumps(
+        [{"rule": f.rule, "path": f.path, "line": f.line,
+          "message": f.message} for f in findings],
+        indent=2,
+    )

@@ -1,0 +1,63 @@
+"""raw-jit pass: every jit entry point routes through flow/dispatch.jit.
+
+flow/dispatch.py wraps ``jax.jit`` so every call of a compiled kernel bumps
+``sql_kernel_dispatches`` — the metric the dispatch-budget guard
+(scripts/check_dispatch_budget.py) and EXPLAIN ANALYZE's
+``kernel dispatches:`` line are built on. A raw ``jax.jit`` anywhere else
+creates kernels invisible to that accounting: the budget guard keeps
+passing while real dispatch count regresses. (This is exactly how the
+SPMD plane drifted: parallel/{shuffle,dist,planner}.py jitted raw, so
+distributed kernels never counted until this pass flagged them.)
+
+Flagged: any reference (call, ``functools.partial`` argument, assignment)
+to ``jax.jit``, ``jax.pmap``, ``jax.shard_map``, or those names imported
+from jax directly. ``shard_map`` alone is a transform, not an entry point
+— it only dispatches once jitted, so it is flagged only as ``jax.shard_map``
+reference when used to build a callable outside dispatch.
+
+Exempt: cockroach_tpu/flow/dispatch.py (the wrapper itself). Kernels that
+deliberately stay outside flow accounting (storage-plane compaction/MVCC
+kernels, the coldata compact helper counted via ``dispatch.note``) carry
+``# crlint: allow-raw-jit(<why>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile, attr_chain
+
+RULE = "raw-jit"
+
+EXEMPT = ("cockroach_tpu/lint/", "cockroach_tpu/flow/dispatch.py")
+_ENTRY = {("jax", "jit"), ("jax", "pmap"), ("jax", "shard_map")}
+_FROM_JAX = {"jit", "pmap"}
+
+
+def check(src: SourceFile) -> list[Finding]:
+    if src.rel.startswith(EXEMPT[0]) or src.rel == EXEMPT[1]:
+        return []
+    # names imported straight off jax: `from jax import jit as J` binds J
+    from_jax: set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name in _FROM_JAX:
+                    from_jax.add(a.asname or a.name)
+    out: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Attribute):
+            chain = attr_chain(node)
+            if chain in _ENTRY:
+                out.append(Finding(
+                    RULE, src.rel, node.lineno,
+                    f"raw {'.'.join(chain)} bypasses flow/dispatch "
+                    "accounting — route through dispatch.jit so "
+                    "sql_kernel_dispatches and the budget guard see it"))
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            if node.func.id in from_jax:
+                out.append(Finding(
+                    RULE, src.rel, node.lineno,
+                    f"raw jax {node.func.id}() bypasses flow/dispatch "
+                    "accounting — route through dispatch.jit"))
+    return out

@@ -100,6 +100,7 @@ def rank_tables_for(probe_schema: Schema, probe_key, probe_dicts,
             code = pd.code_of(str(v))
             ranks.append(pd.ranks[code] if code >= 0
                          else len(pd.values) + i)
+        # crlint: allow-host-sync(ranks is a host python list, not a device array)
         build_ranks.append(np.array(ranks, dtype=np.int32))
     return tuple(probe_ranks), tuple(build_ranks)
 

@@ -289,6 +289,7 @@ def compute_windows(
                     table = jnp.asarray(rank_tables[spec.col])
                     data = table[jnp.clip(col.data, 0, table.shape[0] - 1)]
                     inv = np.empty(len(rank_tables[spec.col]), dtype=np.int32)
+                    # crlint: allow-host-sync(rank tables are host numpy)
                     inv[np.asarray(rank_tables[spec.col])] = np.arange(
                         len(inv), dtype=np.int32
                     )
@@ -635,6 +636,7 @@ def _framed_window(b: Batch, schema: Schema, spec: WindowSpec, seg,
         table = jnp.asarray(rank_tables[spec.col])
         data = table[jnp.clip(col.data, 0, table.shape[0] - 1)]
         inv = np.empty(len(rank_tables[spec.col]), dtype=np.int32)
+        # crlint: allow-host-sync(rank tables are host numpy)
         inv[np.asarray(rank_tables[spec.col])] = np.arange(
             len(inv), dtype=np.int32)
         inv_rank = jnp.asarray(inv)

@@ -9,7 +9,7 @@ here always use the NEW spelling and this module adapts downward.
 from __future__ import annotations
 
 try:  # jax >= 0.5: top-level export, check_vma kwarg
-    from jax import shard_map
+    from jax import shard_map  # crlint: allow-unused-import(re-export shim: callers import shard_map from here)
 except ImportError:  # older jax: experimental module, check_rep kwarg
     from jax.experimental.shard_map import shard_map as _shard_map
 

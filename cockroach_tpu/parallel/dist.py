@@ -18,13 +18,13 @@ Arrow serialization (SURVEY §2.3 TPU-native equivalent row).
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from ._compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..coldata.batch import Batch
 from ..coldata.types import Schema
+from ..flow import dispatch
 from ..ops import aggregation as agg_ops
 from ..ops import join as join_ops
 from .mesh import AXIS
@@ -94,7 +94,7 @@ def make_distributed_groupby(
         out_specs=(P(AXIS), P(AXIS)),
         check_vma=False,
     )
-    return jax.jit(fn), final_schema
+    return dispatch.jit(fn), final_schema
 
 
 def make_distributed_join(
@@ -141,4 +141,5 @@ def make_distributed_join(
         out_specs=(P(AXIS), P(AXIS)),
         check_vma=False,
     )
-    return jax.jit(fn), join_ops.join_output_schema(probe_schema, build_schema, spec)
+    return dispatch.jit(fn), join_ops.join_output_schema(
+        probe_schema, build_schema, spec)

@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 from ..catalog import Catalog
 from ..coldata.batch import Batch, Column, Dictionary, from_host, to_host
 from ..coldata.types import FLOAT64, Family, Schema
+from ..flow import dispatch
 from ..ops import aggregation as agg_ops
 from ..ops import expr as ex
 from ..ops import join as join_ops
@@ -604,7 +605,9 @@ class DistributedQuery:
 
         in_specs = tuple(P(AXIS) for _ in range(nscans))
         out_specs = (P() if root.replicated else P(AXIS), P(AXIS))
-        self._fn = jax.jit(shard_map(
+        # dispatch.jit so the whole-pipeline SPMD program counts into
+        # sql_kernel_dispatches (one dispatch per run_batch attempt)
+        self._fn = dispatch.jit(shard_map(
             local_fn, mesh=self.mesh, in_specs=in_specs,
             out_specs=out_specs, check_vma=False,
         ))

@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..coldata.batch import Batch, Column
 from ..coldata.types import Schema
+from ..flow import dispatch
 from ..ops.hashing import hash_columns
 from .mesh import AXIS
 
@@ -136,4 +137,6 @@ def make_shuffle(
         out_specs=(P(AXIS), P(AXIS)),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    # dispatch.jit, not jax.jit: an SPMD shuffle is one XLA dispatch like
+    # any flow kernel — it must count into sql_kernel_dispatches
+    return dispatch.jit(sharded)

@@ -193,7 +193,7 @@ def _rest_fields(plan):
 def schema_of(plan: S.PlanNode, catalog: Catalog):
     """Output schema of a plan subtree — a lightweight metadata walk (no
     operator construction, no dictionary bridges)."""
-    from ..coldata.types import FLOAT64, Schema
+    from ..coldata.types import Schema
     from ..ops import aggregation as agg_ops
     from ..ops import expr as ex
     from ..ops import join as join_ops

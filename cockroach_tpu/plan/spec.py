@@ -10,8 +10,7 @@ InputSyncSpec (execinfrapb/data.proto:111,149).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Optional
+from dataclasses import dataclass
 
 from ..coldata.types import Schema
 from ..ops.aggregation import AggSpec

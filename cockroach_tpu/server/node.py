@@ -354,7 +354,7 @@ class Node:
                     continue  # a peer's lease write mid-commit; next tick
                 except (ConnectionError, OSError):
                     continue  # injected epoch_bump/transport fault
-                except Exception as e:  # noqa: BLE001 - loop must survive
+                except Exception as e:  # noqa: BLE001 - loop must survive  # crlint: allow-broad-except(lease loop must survive; logged)
                     log.warning(log.OPS, "lease acquire failed",
                                 range=rid, error=str(e))
                     continue
@@ -374,7 +374,7 @@ class Node:
         while not self._stop.wait(self._metrics_interval):
             try:
                 self.tsdb.record(metric.DEFAULT)
-            except Exception as e:  # metric write must never kill the node
+            except Exception as e:  # metric write must never kill the node  # crlint: allow-broad-except(metric write must never kill the node; logged)
                 log.warning(log.OPS, "tsdb poll failed", error=str(e))
 
     def _adopt_loop(self) -> None:
@@ -384,7 +384,7 @@ class Node:
                 for j in adopted:
                     log.info(log.OPS, "re-adopted orphaned job",
                              job=j.job_id, state=j.state)
-            except Exception as e:
+            except Exception as e:  # crlint: allow-broad-except(adoption pass failure is logged, loop continues)
                 log.warning(log.OPS, "adoption pass failed", error=str(e))
 
     # -- gossip <-> settings bridge ------------------------------------------
@@ -412,7 +412,7 @@ class Node:
                     self._applying_remote = True
                     settings.set(name, info)
                     applied[name] = info
-                except Exception as e:
+                except Exception as e:  # crlint: allow-broad-except(bad gossiped value is logged and pinned to avoid a retry storm)
                     log.warning(log.OPS, "gossiped setting rejected",
                                 setting=name, error=str(e))
                     applied[name] = info  # don't retry a bad value forever

@@ -30,7 +30,6 @@ import threading
 
 import numpy as np
 
-from ..coldata.types import Family
 from ..sql import Session
 
 _SSL_REQUEST = 80877103
@@ -214,7 +213,7 @@ class _Conn:
                         self._run_query(sql_text)
                     else:
                         self._send(b"I", b"")  # EmptyQueryResponse
-                except Exception as e:
+                except Exception as e:  # crlint: allow-broad-except(query error becomes an ErrorResponse to the client)
                     self._error(f"{type(e).__name__}: {e}",
                                 code=_sqlstate_for(e))
                 self._ready()
@@ -225,7 +224,7 @@ class _Conn:
                 # Sync would desync pipeline-mode clients' result queues)
                 try:
                     self._extended(tag, body)
-                except Exception as e:
+                except Exception as e:  # crlint: allow-broad-except(extended-protocol error becomes ONE ErrorResponse then discard-until-Sync)
                     self._ext_failed = True
                     self._error(f"{type(e).__name__}: {e}",
                                 code=_sqlstate_for(e))
@@ -338,7 +337,7 @@ class _Conn:
 
         try:
             stmt = P.parse_statement(sql)
-        except Exception:
+        except Exception:  # crlint: allow-broad-except(describe-time parse failure means no row description, not an error)
             return None
         if not isinstance(stmt, P.Select):
             return None
@@ -462,7 +461,7 @@ class PgServer:
                     _Conn(c, self._factory()).serve()
                 except (ConnectionError, OSError):
                     pass  # client went away: its problem, not the server's
-                except Exception as e:
+                except Exception as e:  # crlint: allow-broad-except(connection thread: failure logged, socket closed in finally)
                     log.warning(log.OPS, "pgwire connection failed",
                                 error=f"{type(e).__name__}: {e}")
                 finally:

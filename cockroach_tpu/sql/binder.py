@@ -20,7 +20,7 @@ Turns a parsed ``Select`` AST into a ``Rel`` plan against a catalog:
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 

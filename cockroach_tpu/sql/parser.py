@@ -13,8 +13,8 @@ from __future__ import annotations
 
 import re
 import dataclasses
-from dataclasses import dataclass, field
-from typing import Any, Optional
+from dataclasses import dataclass
+from typing import Optional
 
 # ---------------------------------------------------------------------------
 # Tokens

@@ -17,14 +17,13 @@ import numpy as np
 
 from ..catalog import Catalog
 from ..coldata.batch import Dictionary
-from ..coldata.types import BOOL, Schema, SQLType, Family
+from ..coldata.types import Schema, SQLType, Family
 from ..flow.runtime import run_plan
 from ..ops import aggregation as agg_ops
 from ..ops import expr as ex
 from ..ops import join as join_ops
 from ..ops import sort as sort_ops
 from ..plan import spec as S
-from ..flow import operators as flow_ops
 
 
 @dataclass

@@ -18,7 +18,6 @@ delete-only/write-only states are the non-reduced version of this).
 
 from __future__ import annotations
 
-import numpy as np
 
 from ..coldata import types as T
 from ..storage import rowcodec

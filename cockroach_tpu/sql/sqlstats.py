@@ -15,8 +15,9 @@ reference's constants-removed shape."""
 from __future__ import annotations
 
 import re
-import threading
 from dataclasses import dataclass, field
+
+from ..utils import locks
 
 _NUM = re.compile(r"\b\d+(?:\.\d+)?\b")
 _STR = re.compile(r"'(?:[^']|'')*'")
@@ -57,7 +58,7 @@ class StatsRegistry:
     unbounded junk SQL over pgwire must not leak memory forever."""
 
     def __init__(self, max_fingerprints: int = 5000):
-        self._lock = threading.Lock()
+        self._lock = locks.lock("sql.stats")
         self._stats: dict[str, StmtStats] = {}
         self.max_fingerprints = max_fingerprints
         self.evicted = 0

@@ -20,7 +20,7 @@ import threading
 import time
 import weakref
 
-from ..utils import log, metric, settings
+from ..utils import locks, log, metric, settings
 
 settings.register_float(
     "storage.disk.slow_threshold_ms", 100.0,
@@ -55,7 +55,7 @@ class DiskMonitor:
         self.dir = dir_path
         self.samples: collections.deque[float] = collections.deque(
             maxlen=window)
-        self._lock = threading.Lock()
+        self._lock = locks.lock("storage.disk_health")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._since_publish = 0

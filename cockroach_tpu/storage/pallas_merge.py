@@ -142,7 +142,7 @@ def _next_pow2(n: int) -> int:
     return p
 
 
-@functools.partial(jax.jit, static_argnames=("n_a", "n_b", "interpret"))
+@functools.partial(jax.jit, static_argnames=("n_a", "n_b", "interpret"))  # crlint: allow-raw-jit(storage-plane kernel: dispatch budget scopes the SQL flow layer)
 def _merge_perm(a_planes, b_planes, n_a: int, n_b: int,
                 interpret: bool = False) -> jax.Array:
     """Permutation that merges two sorted operand-plane sets. Returned

@@ -26,7 +26,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from . import mvcc as mvcc_mod
 
@@ -138,7 +137,7 @@ def _scan_filter_kernel(kh0, kl0, kh1, kl1, tshi, tslo, txhi, txlo,
     conf_ref[:] = conflict.astype(jnp.int8)
 
 
-@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+@functools.partial(jax.jit, static_argnames=("window", "interpret"))  # crlint: allow-raw-jit(storage-plane kernel: dispatch budget scopes the SQL flow layer)
 def pallas_scan_filter(block, read_ts, reader_txn, window: int,
                        interpret: bool = False):
     """Drop-in for mvcc.mvcc_scan_filter over the window-packed layout:

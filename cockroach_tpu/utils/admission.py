@@ -19,6 +19,8 @@ import itertools
 import threading
 import time
 
+from . import locks
+
 # work priorities (admissionpb ordering)
 LOW = 0
 NORMAL = 10
@@ -32,7 +34,7 @@ class WorkQueue:
     def __init__(self, slots: int = 4):
         self._slots = slots
         self._used = 0
-        self._lock = threading.Lock()
+        self._lock = locks.lock("admission")
         self._waiters: list = []  # heap of (-priority, seq, event)
         self._seq = itertools.count()
         self.admitted = 0

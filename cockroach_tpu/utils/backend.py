@@ -65,7 +65,7 @@ def float_bitcast_ok() -> bool:
             back = jax.lax.bitcast_convert_type(parts, jnp.float64)
             return u, back
 
-        u, back = jax.jit(roundtrip)(jnp.asarray(vals))
+        u, back = jax.jit(roundtrip)(jnp.asarray(vals))  # crlint: allow-raw-jit(one-shot import-time backend probe, not a query kernel)
         ok = (np.array_equal(np.asarray(u), want)
               and np.array_equal(np.asarray(back).view(np.uint64), want))
     except Exception:
@@ -116,7 +116,7 @@ def force_cpu_backend(n_devices: int | None = None) -> None:
         # any LATER pallas import raises. Import them now, while "tpu" is
         # still a known platform (interpret-mode tests need pallas on CPU).
         import jax.experimental.pallas  # noqa: F401
-        from jax._src import checkify  # noqa: F401
+        from jax._src import checkify  # noqa: F401  # crlint: allow-unused-import(presence probe: import success is the signal)
     except Exception:  # pragma: no cover - pallas absent/changed
         pass
     try:

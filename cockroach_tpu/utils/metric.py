@@ -248,6 +248,10 @@ RANGE_CACHE_COALESCED = DEFAULT.counter(
     "range_cache_coalesced_lookups",
     "authoritative meta lookups answered by an in-flight peer lookup "
     "instead of stampeding the meta range (single-flight)")
+CONTENTION_RECORD_ERRORS = DEFAULT.counter(
+    "contention_record_errors",
+    "failures recording a contention event into the registry (the "
+    "conflict path continues; the event is lost to observability)")
 KERNEL_DISPATCHES = DEFAULT.counter(
     "sql_kernel_dispatches",
     "XLA executable dispatches issued by the flow layer (each jitted "

@@ -24,8 +24,9 @@ from __future__ import annotations
 
 import random
 import socket
-import threading
 import time
+
+from . import locks
 
 
 class RPCDeadlineError(ConnectionError):
@@ -120,7 +121,7 @@ class RangeRetryBudget:
         self.refill_per_s = float(refill_per_s)
         self._tokens: dict[int, float] = {}
         self._stamp: dict[int, float] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.lock("retry.budget")
 
     def _refill(self, range_id: int, now: float) -> float:
         tokens = self._tokens.get(range_id, self.budget)

@@ -11,9 +11,8 @@ metamorphically randomizable for tests.
 
 from __future__ import annotations
 
-import os
-from dataclasses import dataclass, field
-from typing import Any, Callable
+from dataclasses import dataclass
+from typing import Any
 
 
 @dataclass
@@ -344,6 +343,14 @@ FUSION_ENABLED = register_bool(
     "each chain into one dispatch and intermediate padded tiles never "
     "materialize; off runs the classic one-jit-per-operator pull path",
     metamorphic=True,
+)
+LOCK_ORDER_CHECKS = register_bool(
+    "debug.lock_order.enabled", False,
+    "make every utils/locks.OrderedLock acquisition verify the global "
+    "lock-acquisition order (deadlock_detection analog): acquiring B "
+    "while holding A records edge A->B, and an acquisition that would "
+    "close a cycle raises LockOrderError instead of deadlocking; off "
+    "(default) the wrappers are plain locks with no per-acquire overhead",
 )
 READBACK_OVERLAP = register_bool(
     "sql.distsql.readback_overlap", True,

@@ -32,6 +32,12 @@ BUDGET_STEADY = 10
 # criterion of the fusion work; measured exactly 1.0) — the accumulator
 # merge rides inside the fold step kernel. The unfused engine pays 5.
 BUDGET_PER_TILE = 1.25
+# a distributed plan (partial agg -> all_to_all shuffle -> merge agg ->
+# finalize over the 8-way mesh) is ONE SPMD program = ONE dispatch; the
+# lower bound of 1 proves parallel/* kernels route through dispatch.jit
+# and count at all (they used to call jax.jit directly and were invisible
+# to this accounting).
+BUDGET_SPMD = 2
 
 _SF = 0.001
 _TILE = 1024
@@ -52,12 +58,48 @@ def _steady_dispatches(cat, tile: int) -> int:
     return dispatch.total() - d0
 
 
+def _spmd_dispatches() -> int:
+    """Warm dispatches for one distributed groupby over an 8-way mesh."""
+    import numpy as np
+
+    from cockroach_tpu import coldata as cd
+    from cockroach_tpu.flow import dispatch
+    from cockroach_tpu.ops import aggregation as agg
+    from cockroach_tpu.parallel import dist, mesh as mesh_mod
+
+    mesh = mesh_mod.make_mesh(8)
+    schema = cd.Schema.of(g=cd.INT64, v=cd.INT64)
+    rng = np.random.default_rng(11)
+    n = 2000
+    b = cd.from_host(
+        schema,
+        {"g": rng.integers(0, 32, n), "v": rng.integers(0, 100, n)},
+        capacity=512 * 8,
+    )
+    b = dist.shard_batch(b, mesh)
+    fn, _ = dist.make_distributed_groupby(
+        mesh, schema, (0,),
+        (agg.AggSpec("sum", 1, "s"), agg.AggSpec("count_rows", None, "n")),
+        local_capacity=512,
+    )
+    fn(b)  # warm: compile
+    d0 = dispatch.total()
+    fn(b)
+    return dispatch.total() - d0
+
+
 def check() -> list[str]:
     """Returns a list of human-readable violations (empty = clean)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from cockroach_tpu.bench.tpch import gen_tpch
     from cockroach_tpu.utils import settings
 
+    import jax
+
+    if len(jax.devices()) < 8:  # standalone run: conftest hasn't forced
+        from cockroach_tpu.utils.backend import force_cpu_backend
+
+        force_cpu_backend(8)  # the SPMD case needs the full virtual mesh
     problems = []
     try:
         settings.set("sql.distsql.fusion.enabled", True)
@@ -78,6 +120,17 @@ def check() -> list[str]:
                 f"({steady} -> {halved} when tiles double from {tiles}) "
                 f"exceed the budget {BUDGET_PER_TILE} — the per-tile "
                 "chain is no longer one fused kernel")
+        spmd = _spmd_dispatches()
+        if spmd < 1:
+            problems.append(
+                "distributed groupby registered 0 kernel dispatches — the "
+                "SPMD plan no longer routes through flow/dispatch.jit and "
+                "is invisible to dispatch accounting")
+        elif spmd > BUDGET_SPMD:
+            problems.append(
+                f"distributed groupby dispatches {spmd} exceed the budget "
+                f"{BUDGET_SPMD} — the partial-agg/shuffle/merge pipeline "
+                "is no longer one SPMD program")
     finally:
         settings.reset("sql.distsql.tile_size")
         settings.reset("sql.distsql.fusion.enabled")
@@ -90,7 +143,8 @@ def main() -> int:
         print(f"FAIL: {p}", file=sys.stderr)
     if not problems:
         print("dispatch budget clean: fused pipeline within "
-              f"{BUDGET_STEADY} steady / {BUDGET_PER_TILE}-per-tile")
+              f"{BUDGET_STEADY} steady / {BUDGET_PER_TILE}-per-tile, "
+              f"distributed plan within {BUDGET_SPMD}")
     return 1 if problems else 0
 
 

@@ -1,0 +1,51 @@
+"""crlint tree gate — the static-analysis suite must be clean at HEAD.
+
+Runs every crlint pass (cockroach_tpu/lint/: host-sync, raw-jit,
+broad-except, unused-import, lock-order) over the package and the
+scripts/ directory and fails on any unsuppressed finding. This is the
+nogo/roachvet analog: the lint rules are only worth having if the tree
+is kept at zero findings, so the gate rides in tier-1 next to the
+settings and dispatch-budget audits. Pure AST pass — nothing is
+imported, so it runs without pulling in jax.
+
+Deliberate exceptions carry an inline pragma with a mandatory reason:
+
+    # crlint: allow-<rule>(<why this site is exempt>)
+
+(same line, the line above, or on a `def` line to cover the function).
+Silent `except Exception: pass` handlers in kv/, flow/ and server/ are
+hard errors the pragma cannot suppress. Wired as a tier-1 test via
+tests/test_lint.py; also runnable directly:
+
+    python -m scripts.check_lint
+    python -m cockroach_tpu.lint --rule host-sync cockroach_tpu scripts
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+
+def check(repo_root: str | pathlib.Path | None = None) -> list[str]:
+    """Returns a list of human-readable violations (empty = clean)."""
+    from cockroach_tpu.lint import run_lint
+
+    if repo_root is None:
+        repo_root = pathlib.Path(__file__).resolve().parent.parent
+    root = pathlib.Path(repo_root)
+    return [f.render() for f in
+            run_lint([root / "cockroach_tpu", root / "scripts"])]
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"FAIL: {p}", file=sys.stderr)
+    if not problems:
+        print("crlint clean: all passes over cockroach_tpu/ and scripts/")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,7 +31,7 @@ from cockroach_tpu.plan import builder as plan_builder
 from cockroach_tpu.plan import spec as S
 from cockroach_tpu.flow.runtime import run_operator
 from cockroach_tpu.storage.lsm import Engine
-from cockroach_tpu.utils import faults, metric
+from cockroach_tpu.utils import faults, locks, metric, settings
 from cockroach_tpu.utils.faults import FaultSpec, InjectedFault
 
 pytestmark = pytest.mark.chaos
@@ -41,6 +41,20 @@ pytestmark = pytest.mark.chaos
 def _always_disarm():
     yield
     faults.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_detector():
+    """Run every chaos scenario with the runtime deadlock detector armed:
+    an inverted acquisition anywhere under fault injection raises
+    LockOrderError instead of hanging the suite (the deadlock-build-tag
+    discipline; see utils/locks.py)."""
+    locks.reset()
+    prev = settings.get("debug.lock_order.enabled")
+    settings.set("debug.lock_order.enabled", True)
+    yield
+    settings.set("debug.lock_order.enabled", prev)
+    locks.reset()
 
 
 def _mini_catalog(n=600, c=16, seed=7) -> Catalog:

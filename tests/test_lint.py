@@ -1,0 +1,312 @@
+"""Tier-1 wiring for the crlint static-analysis suite (cockroach_tpu/lint/
++ scripts/check_lint.py) and the runtime lock-order detector
+(cockroach_tpu/utils/locks.py).
+
+Two halves:
+
+- each lint pass is proven LIVE against a fixture tree that trips it
+  (a gate that silently stopped finding anything is worse than no gate),
+  plus pragma-suppression semantics;
+- the real tree is held at zero findings and an acyclic static lock
+  graph, and OrderedLock turns an A->B/B->A inversion into an immediate
+  LockOrderError instead of a deadlock.
+"""
+
+import threading
+
+import pytest
+
+from cockroach_tpu.lint import run_lint
+from cockroach_tpu.lint.core import load_files
+from cockroach_tpu.lint import lockorder
+from cockroach_tpu.utils import locks, settings
+from scripts.check_lint import check
+
+
+# ---------------------------------------------------------------- fixtures
+
+def _tree(tmp_path, files: dict[str, str]):
+    """Materialize {relpath: source} under tmp_path and return the root.
+    Paths start with cockroach_tpu/... so the passes scope exactly like
+    the real tree (core._canonical_rel anchors on that component)."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return tmp_path / "cockroach_tpu"
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------- real tree
+
+def test_real_tree_is_clean():
+    problems = check()
+    assert not problems, "\n".join(problems)
+
+
+def test_real_tree_lock_graph_acyclic():
+    files = load_files(["cockroach_tpu"])
+    lock_names, edges = lockorder.build_lock_graph(files)
+    assert lock_names, "lock indexing broke: no locks found in the tree"
+    assert not lockorder.check(files)
+
+
+# ------------------------------------------------------------- host-sync
+
+_HOT = "cockroach_tpu/flow/runtime.py"
+
+def test_host_sync_flags_implicit_transfers(tmp_path):
+    root = _tree(tmp_path, {_HOT: (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(x):\n"
+        "    n = int(jnp.sum(x))\n"          # int() on traced value
+        "    v = x.item()\n"                 # .item()
+        "    h = np.asarray(jnp.abs(x))\n"   # device -> host copy
+        "    if jnp.any(x):\n"               # truth test forces sync
+        "        pass\n"
+        "    return n, v, h\n")})
+    found = run_lint([root], rules=("host-sync",))
+    assert len(found) == 4, [f.render() for f in found]
+    assert _rules(found) == ["host-sync"]
+
+
+def test_host_sync_scoped_to_hot_modules(tmp_path):
+    # same code outside the hot path (and in the allowlisted wire module)
+    # is not a finding
+    src = "import jax.numpy as jnp\ndef f(x):\n    return int(jnp.sum(x))\n"
+    root = _tree(tmp_path, {
+        "cockroach_tpu/bench/baseline.py": src,
+        "cockroach_tpu/flow/wire.py": src,
+    })
+    assert not run_lint([root], rules=("host-sync",))
+
+
+def test_host_sync_pragma_and_host_literals(tmp_path):
+    root = _tree(tmp_path, {_HOT: (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(x, rows):\n"
+        "    # crlint: allow-host-sync(one sync per query by design)\n"
+        "    n = int(jnp.sum(x))\n"
+        "    a = np.asarray([1, 2, 3])\n"     # host literal: no readback
+        "    if jnp.issubdtype(x.dtype, jnp.integer):\n"  # host predicate
+        "        pass\n"
+        "    return n, a\n")})
+    assert not run_lint([root], rules=("host-sync",))
+
+
+# --------------------------------------------------------------- raw-jit
+
+def test_raw_jit_flagged_outside_dispatch(tmp_path):
+    root = _tree(tmp_path, {
+        "cockroach_tpu/ops/thing.py": (
+            "import jax\n"
+            "import functools\n"
+            "f = jax.jit(lambda x: x)\n"
+            "g = functools.partial(jax.pmap, axis_name='d')\n"),
+        "cockroach_tpu/flow/dispatch.py": (
+            "import jax\n"
+            "def jit(fn, **kw):\n"
+            "    return jax.jit(fn, **kw)\n"),
+    })
+    found = run_lint([root], rules=("raw-jit",))
+    # both sites in ops/thing.py (incl. the partial arg), none in dispatch
+    assert len(found) == 2, [f.render() for f in found]
+    assert all(f.path == "cockroach_tpu/ops/thing.py" for f in found)
+
+
+# ----------------------------------------------------------- broad-except
+
+def test_silent_swallow_is_unsuppressible(tmp_path):
+    root = _tree(tmp_path, {"cockroach_tpu/kv/thing.py": (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    # crlint: allow-broad-except(pragma must NOT mute this)\n"
+        "    except Exception:\n"
+        "        pass\n")})
+    found = run_lint([root], rules=("broad-except",))
+    assert len(found) == 1
+    assert not found[0].suppressible
+
+
+def test_broad_except_pragma_and_reraise(tmp_path):
+    root = _tree(tmp_path, {"cockroach_tpu/flow/thing.py": (
+        "def ok_reraise():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        raise\n"
+        "def ok_pragma():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as e:  # crlint: allow-broad-except(logged)\n"
+        "        log(e)\n"
+        "def bad():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as e:\n"
+        "        log(e)\n")})
+    found = run_lint([root], rules=("broad-except",))
+    assert len(found) == 1
+    assert found[0].line > 10  # the finding is in bad(), not the first two
+
+
+def test_broad_except_scoped_outside_kv_flow_server(tmp_path):
+    root = _tree(tmp_path, {"cockroach_tpu/bench/thing.py": (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n")})
+    assert not run_lint([root], rules=("broad-except",))
+
+
+# ---------------------------------------------------------- unused-import
+
+def test_unused_import_flagged_and_pragma(tmp_path):
+    root = _tree(tmp_path, {"cockroach_tpu/util.py": (
+        "import os\n"
+        "import sys  # crlint: allow-unused-import(re-export shim)\n"
+        "import json\n"
+        "print(json.dumps({}))\n")})
+    found = run_lint([root], rules=("unused-import",))
+    assert len(found) == 1
+    assert "'os'" in found[0].message
+
+
+def test_empty_pragma_reason_does_not_suppress(tmp_path):
+    root = _tree(tmp_path, {"cockroach_tpu/util.py": (
+        "import os  # crlint: allow-unused-import()\n")})
+    assert len(run_lint([root], rules=("unused-import",))) == 1
+
+
+# ------------------------------------------------------------- lock-order
+
+def test_lock_order_cycle_through_call_graph(tmp_path):
+    root = _tree(tmp_path, {"cockroach_tpu/mod.py": (
+        "import threading\n"
+        "LOCK_A = threading.Lock()\n"
+        "LOCK_B = threading.Lock()\n"
+        "def path_one():\n"
+        "    with LOCK_A:\n"
+        "        with LOCK_B:\n"
+        "            pass\n"
+        "def path_two():\n"
+        "    with LOCK_B:\n"
+        "        helper()\n"       # inversion is one call deep
+        "def helper():\n"
+        "    with LOCK_A:\n"
+        "        pass\n")})
+    found = run_lint([root], rules=("lock-order",))
+    assert len(found) == 1
+    assert "cycle" in found[0].message
+
+
+def test_lock_order_consistent_nesting_is_clean(tmp_path):
+    root = _tree(tmp_path, {"cockroach_tpu/mod.py": (
+        "import threading\n"
+        "LOCK_A = threading.Lock()\n"
+        "LOCK_B = threading.Lock()\n"
+        "def one():\n"
+        "    with LOCK_A:\n"
+        "        with LOCK_B:\n"
+        "            pass\n"
+        "def two():\n"
+        "    with LOCK_A:\n"
+        "        with LOCK_B:\n"
+        "            pass\n")})
+    assert not run_lint([root], rules=("lock-order",))
+
+
+# ------------------------------------------------ runtime OrderedLock
+
+@pytest.fixture
+def lock_order_on():
+    locks.reset()
+    prev = settings.get("debug.lock_order.enabled")
+    settings.set("debug.lock_order.enabled", True)
+    yield
+    settings.set("debug.lock_order.enabled", prev)
+    locks.reset()
+
+
+def test_ordered_lock_inversion_raises(lock_order_on):
+    a, b = locks.lock("t.A"), locks.lock("t.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(locks.LockOrderError):
+            a.acquire()
+
+
+def test_ordered_lock_transitive_cycle(lock_order_on):
+    x, y, z = locks.lock("t.X"), locks.lock("t.Y"), locks.lock("t.Z")
+    with x:
+        with y:
+            pass
+    with y:
+        with z:
+            pass
+    with z:
+        with pytest.raises(locks.LockOrderError):
+            x.acquire()
+
+
+def test_ordered_lock_cross_thread(lock_order_on):
+    # the order graph is global: thread 1 records A->B, thread 2's B->A
+    # trips even though the two never contend
+    a, b = locks.lock("t2.A"), locks.lock("t2.B")
+    def t1():
+        with a:
+            with b:
+                pass
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+    with b:
+        with pytest.raises(locks.LockOrderError):
+            a.acquire()
+
+
+def test_ordered_lock_disabled_is_noop():
+    locks.reset()
+    assert settings.get("debug.lock_order.enabled") is False
+    a, b = locks.lock("t3.A"), locks.lock("t3.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # inverted, but checking is off
+            pass
+
+
+def test_ordered_rlock_reentry_ok(lock_order_on):
+    r = locks.rlock("t.R")
+    with r:
+        with r:
+            pass
+    assert not r.locked()
+
+
+def test_ordered_condition_wait_notify(lock_order_on):
+    c = locks.condition("t.C")
+    hits = []
+    def waiter():
+        with c:
+            c.wait_for(lambda: hits, timeout=5)
+            hits.append("woke")
+    th = threading.Thread(target=waiter)
+    th.start()
+    import time
+    time.sleep(0.05)
+    with c:
+        hits.append("set")
+        c.notify_all()
+    th.join(timeout=5)
+    assert hits == ["set", "woke"]
