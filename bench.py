@@ -207,7 +207,7 @@ def _pandas_baseline(qname, cat, res) -> float:
 
 def _bench_query(qname, cat, nrows, runs):
     """Median engine time + pandas baseline time for one query.
-    Returns (rows_per_sec, ratio_vs_pandas, warmup_s)."""
+    Returns (rows_per_sec, ratio_vs_pandas, cold_s, warmup_s)."""
     from cockroach_tpu.bench import queries as Q
     from cockroach_tpu.flow.runtime import run_operator
     from cockroach_tpu.plan import builder as plan_builder
@@ -216,15 +216,21 @@ def _bench_query(qname, cat, nrows, runs):
     # one operator tree, re-initialized per run: its jitted kernels compile
     # during the warm-up runs and are reused by every timed run (compiles
     # also land in the persistent cache, so future processes skip them).
-    # TWO warmups: the first also LEARNS adaptive execution choices (join
-    # emission capacities); the second compiles the kernels those choices
-    # select, so timed runs measure the steady state.
+    # TWO warmups, timed separately: the FIRST (cold_s) pays the compile
+    # wall and also LEARNS adaptive execution choices (join emission
+    # capacities); the SECOND compiles the handful of kernels those
+    # choices select. warmup_s is the total until steady state — the
+    # number the plan/kernel cache hierarchy exists to drive to ~0 on
+    # repeat statements (scripts/check_recompiles.py holds the repeat to
+    # zero new compiles).
     root = plan_builder.build(rel.plan, cat)
     t0 = time.time()
     run_operator(root)
+    cold_s = time.time() - t0
     run_operator(root)
     warmup_s = time.time() - t0
-    print(f"# {qname} warmup (compile+learn): {warmup_s:.1f}s",
+    print(f"# {qname} warmup: cold {cold_s:.1f}s (compile), "
+          f"settle {warmup_s - cold_s:.1f}s (learn+respecialize)",
           file=sys.stderr, flush=True)
 
     times = []
@@ -240,7 +246,7 @@ def _bench_query(qname, cat, nrows, runs):
     print(f"# {qname}: engine {med*1e3:.0f}ms "
           f"({rows_per_sec/1e6:.1f}M rows/s); pandas {pandas_s*1e3:.0f}ms",
           file=sys.stderr, flush=True)
-    return rows_per_sec, pandas_s / med, warmup_s
+    return rows_per_sec, pandas_s / med, cold_s, warmup_s
 
 
 _partial = {"detail": {}, "errors": [], "sf": 1.0, "platform": "unknown"}
@@ -288,6 +294,14 @@ def _emit(final: bool) -> None:
         "vs_colexec_est": round(geomean_ratio / 8.0, 4),
         "detail": detail,
     }
+    # cold/warm split (compile wall vs steady serving): cold is the sum of
+    # first-run times; warm is the sum of steady-state medians
+    colds = [d["cold_s"] for d in queries if "cold_s" in d]
+    warms = [d["warm_ms"] for d in queries if "warm_ms" in d]
+    if colds:
+        out["cold_total_s"] = round(sum(colds), 1)
+    if warms:
+        out["warm_total_ms"] = round(sum(warms), 1)
     if errors:
         out["error"] = "; ".join(errors)
     if not final:
@@ -337,12 +351,14 @@ def _worker(job: str) -> None:
     nrows = cat.get("lineitem").num_rows
     print(f"# gen/load sf={sf}: {nrows} lineitems in {time.time()-t0:.1f}s "
           f"on {platform}", file=sys.stderr, flush=True)
-    rps, ratio, warm = _bench_query(job, cat, nrows, runs)
+    rps, ratio, cold, warm = _bench_query(job, cat, nrows, runs)
     print("RESULT " + json.dumps({
         "job": job, "platform": platform,
         "rows_per_sec": round(rps),
         "vs_pandas": round(ratio, 3),
+        "cold_s": round(cold, 1),
         "warmup_s": round(warm, 1),
+        "warm_ms": round(nrows / rps * 1e3, 1),
     }), flush=True)
 
 

@@ -22,11 +22,39 @@ from .coldata.types import Family, Schema
 
 TILE_ALIGN = 1024  # pad device tables to a multiple of this (8x128 lanes)
 
+# canonical tile-shape menu (L0 of the cache hierarchy — see README):
+# sub-tile tables pad UP to the next rung instead of to their own 1024-
+# aligned cardinality, so every kernel over a small table compiles at one
+# of ~5 shapes shared process-wide rather than one shape per table size.
+# Tables larger than a rung keep tile-multiple padding: their downstream
+# kernels already see tile-shaped slices, and padding further would add
+# tiles (= dispatches) for zero compile benefit.
+SHAPE_BUCKETS = (1 << 10, 1 << 13, 1 << 16, 1 << 19, 1 << 21)
+
+
+def _bucket_cap(n: int) -> int:
+    for b in SHAPE_BUCKETS:
+        if n <= b:
+            return b
+    top = SHAPE_BUCKETS[-1]
+    return ((n + top - 1) // top) * top
+
 
 def _pad_cap(n: int, tile: int | None = None) -> int:
     """Padded device capacity: a multiple of the scan tile (so bounded-tile
     resident scans slice evenly — no full-table kernel shapes), min one tile.
-    Small tables align to 1024 lanes only."""
+    With shape bucketing (default), sub-tile tables round up the pow2 rung
+    ladder; with it off, they align to 1024 lanes only (the pre-bucketing
+    behavior the bit-identity sweep compares against)."""
+    from .utils import settings
+
+    if settings.get("sql.distsql.shape_buckets.enabled"):
+        cap = _bucket_cap(n)
+        if tile is None or tile <= 0 or cap <= tile:
+            return cap
+        # above one tile: tile-multiple padding (never MORE tiles than the
+        # unbucketed shape — the dispatch budget must hold with padding on)
+        return max(tile, ((n + tile - 1) // tile) * tile)
     align = TILE_ALIGN
     if tile is not None and n > tile:
         align = tile
@@ -204,11 +232,24 @@ class Table:
 
 
 class Catalog:
+    """Table namespace plus a monotonically increasing schema version.
+
+    Every DDL that can invalidate a compiled plan — CREATE/DROP TABLE,
+    CREATE/DROP INDEX, ALTER — bumps ``version``; the prepared-plan cache
+    (sql/plancache.py) keys entries on it, so a stale plan (e.g. one built
+    against a since-dropped index) can never serve another statement."""
+
     def __init__(self):
         self.tables: dict[str, Table] = {}
+        self.version = 0
+
+    def bump_version(self) -> int:
+        self.version += 1
+        return self.version
 
     def add(self, table: Table) -> Table:
         self.tables[table.name] = table
+        self.bump_version()
         return table
 
     def get(self, name: str) -> Table:

@@ -1,4 +1,4 @@
-"""Kernel-dispatch accounting for the flow layer.
+"""Kernel-dispatch accounting and the process-global kernel cache.
 
 Every jitted call the engine issues is one XLA executable dispatch — and on
 a remote-attached TPU each dispatch costs a tunnel round trip, so dispatch
@@ -16,6 +16,24 @@ observable so the win is measurable and regressions are catchable:
   by EXPLAIN ANALYZE).
 - ``scripts/check_dispatch_budget.py`` turns the per-query count into a
   tier-1 regression budget.
+
+Compile-wall accounting (the L1 cache of the plan/kernel cache hierarchy —
+see README "Cache hierarchy"):
+
+- every trace bumps ``compiles()``: the wrapped function body is plain
+  Python, so it executes exactly once per jax trace — and a trace is a new
+  executable specialization (one XLA compile, or one persistent-cache
+  deserialize). ``scripts/check_recompiles.py`` holds repeat queries to a
+  ZERO delta on this counter.
+- ``jit(fn, key=...)`` routes through a process-global kernel cache: two
+  structurally identical kernels (same ``key``) share ONE jitted wrapper,
+  so the second query's filter/project/slice reuses the first's traced
+  executables instead of re-tracing an identical closure. jax.jit itself
+  keys on shapes/dtypes/static args beneath each wrapper, so the composite
+  key is (function identity via ``key``) x (canonical shapes) — the T5X
+  PjittedFnWithContext shape. Keys must be hashable and must fully
+  determine the traced computation; ``kernel_key`` returns None (= no
+  sharing) for unhashable parts.
 """
 
 from __future__ import annotations
@@ -29,6 +47,9 @@ from ..utils import metric
 
 _lock = threading.Lock()
 _total = 0
+_compiles = 0
+_cache_hits = 0
+_kernel_cache: dict = {}
 
 
 def note(n: int = 1) -> None:
@@ -46,13 +67,73 @@ def total() -> int:
     return _total
 
 
-def jit(fn=None, **jit_kwargs):
-    """``jax.jit`` with per-call dispatch accounting. Usable like jax.jit,
-    both directly and via ``functools.partial(jit, static_argnames=...)``
-    as a decorator."""
+def note_compile(n: int = 1) -> None:
+    """Record n new traces/compiles (called from inside the traced body)."""
+    global _compiles
+    with _lock:
+        _compiles += n
+    metric.KERNEL_COMPILES.inc(n)
+
+
+def compiles() -> int:
+    """Process-lifetime trace/compile count (monotonic — snapshot around a
+    query to assert the zero-recompile serving path)."""
+    return _compiles
+
+
+def kernel_cache_hits() -> int:
+    """Process-lifetime kernel-cache hits (jit(key=...) lookups answered
+    by an already-built wrapper)."""
+    return _cache_hits
+
+
+def kernel_cache_size() -> int:
+    return len(_kernel_cache)
+
+
+def clear_kernel_cache() -> None:
+    """Drop all shared wrappers (tests; frees the underlying executables
+    only once operator trees release their references)."""
+    with _lock:
+        _kernel_cache.clear()
+
+
+def kernel_key(*parts):
+    """Build a kernel-cache key from hashable parts, or None (no sharing)
+    when any part is unhashable. The key must fully determine the traced
+    computation: callers put the op kind, schema, and the full expression
+    tree in — and keep runtime-varying values (params, row counts) OUT."""
+    try:
+        hash(parts)
+    except TypeError:
+        return None
+    return parts
+
+
+def jit(fn=None, key=None, **jit_kwargs):
+    """``jax.jit`` with per-call dispatch accounting, per-trace compile
+    accounting, and optional process-global sharing under ``key``. Usable
+    like jax.jit, both directly and via ``functools.partial(jit, ...)`` as
+    a decorator."""
     if fn is None:
-        return functools.partial(jit, **jit_kwargs)
-    jitted = jax.jit(fn, **jit_kwargs)
+        return functools.partial(jit, key=key, **jit_kwargs)
+    if key is not None:
+        global _cache_hits
+        with _lock:
+            cached = _kernel_cache.get(key)
+        if cached is not None:
+            with _lock:
+                _cache_hits += 1
+            metric.KERNEL_CACHE_HITS.inc()
+            return cached
+
+    @functools.wraps(fn)
+    def traced(*args, **kwargs):
+        # plain-Python body: runs once per jax trace == one new compile
+        note_compile()
+        return fn(*args, **kwargs)
+
+    jitted = jax.jit(traced, **jit_kwargs)
 
     @functools.wraps(fn)
     def counted(*args, **kwargs):
@@ -60,4 +141,9 @@ def jit(fn=None, **jit_kwargs):
         return jitted(*args, **kwargs)
 
     counted._jitted = jitted  # uncounted handle (AOT lowering/inspection)
+    counted._kernel_key = key
+    if key is not None:
+        with _lock:
+            # racing builders: first insert wins so every caller shares it
+            counted = _kernel_cache.setdefault(key, counted)
     return counted

@@ -24,7 +24,6 @@ colcontainer's disk queues — an optional spill_dir persists partitions as
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -40,10 +39,12 @@ from .operator import OneInputOperator, Operator
 
 
 def _pow2(n: int) -> int:
-    p = 1024
-    while p < n:
-        p *= 2
-    return p
+    # partition reload / join output capacities are data-dependent: snap
+    # them to the canonical shape ladder so a repeat run with different
+    # literals (≈ different partition sizes) reuses the spill kernels
+    from .operators import _canonical_cap
+
+    return _canonical_cap(max(1, n))
 
 
 class HostPartitions:
@@ -136,6 +137,18 @@ class ChainOp(ReplayOp):
 
 
 
+def _array_key(a):
+    """Content key for a small baked-in table (dictionary ranks/hashes) so
+    spill kernels can share through the process-global kernel cache. Spill
+    operators are constructed at RUNTIME (SortOp/AggregateOp/HashJoinOp
+    hand off mid-query), so without a content key every spilling run of a
+    cached plan would re-trace identical kernels."""
+    if a is None:
+        return None
+    a = np.asarray(a)
+    return (str(a.dtype), a.shape, a.tobytes())
+
+
 def make_bucket_fn(schema: Schema, keys, tables, nparts: int):
     """Jitted per-row partition id from the key columns' 64-bit hash —
     THE Grace partition function, shared by the external join and
@@ -146,7 +159,11 @@ def make_bucket_fn(schema: Schema, keys, tables, nparts: int):
         h = hash_columns(cols, types, tables or None)
         return (h % np.uint64(nparts)).astype(jnp.int32)
 
-    return dispatch.jit(fn)
+    key = dispatch.kernel_key(
+        "grace_bucket", schema, tuple(keys), nparts,
+        tuple(sorted((i, _array_key(t)) for i, t in (tables or {}).items())),
+    )
+    return dispatch.jit(fn, key=key)
 
 
 # ---------------------------------------------------------------------------
@@ -347,7 +364,9 @@ class ExternalSortOp(OneInputOperator):
         if key.col in self.child.dictionaries:
             rank_table = self.child.dictionaries[key.col].ranks
         self._u64_fn = dispatch.jit(
-            lambda b: _primary_u64(b, schema, key, rank_table)
+            lambda b: _primary_u64(b, schema, key, rank_table),
+            key=dispatch.kernel_key("extsort_u64", schema, key,
+                                    _array_key(rank_table)),
         )
         rank_tables = {
             k.col: self.child.dictionaries[k.col].ranks
@@ -356,11 +375,14 @@ class ExternalSortOp(OneInputOperator):
         }
         keys = self.keys
 
-        @functools.partial(dispatch.jit, static_argnames=())
         def sort_fn(b):
             return sort_ops.sort_batch(b, schema, keys, rank_tables)
 
-        self._sort_fn = sort_fn
+        self._sort_fn = dispatch.jit(sort_fn, key=dispatch.kernel_key(
+            "extsort_sort", schema, keys,
+            tuple(sorted((c, _array_key(t))
+                         for c, t in rank_tables.items())),
+        ))
 
     def _stage_all(self):
         # pass 1: stage all rows + their primary u64 on the host

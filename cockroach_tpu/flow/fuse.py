@@ -162,7 +162,12 @@ class FusedPipeline(Operator):
             return
         cached = getattr(self, "_pipe_fn", None)
         if cached is None or cached[0] is not cfn:
-            cached = (cfn, dispatch.jit(cfn))
+            # chains with structural keys (set by _compose_parts during the
+            # stream_parts call above) share one jitted pipeline globally:
+            # a repeat query's fused chain reuses the first's executables
+            pkey = getattr(self.top, "_parts_key", None)
+            cached = (cfn, dispatch.jit(
+                cfn, key=None if pkey is None else ("pipe", pkey)))
             self._pipe_fn = cached
         fn = cached[1]
         for t in src.stream_tiles():

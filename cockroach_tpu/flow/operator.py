@@ -26,7 +26,8 @@ class ComponentStats:
     (execinfrapb/component_stats.proto), folded into EXPLAIN ANALYZE by
     plan/explain.py (the execstats/traceanalyzer.go role)."""
 
-    __slots__ = ("batches", "rows", "time_s", "bytes", "kernel_dispatches")
+    __slots__ = ("batches", "rows", "time_s", "bytes", "kernel_dispatches",
+                 "kernel_compiles")
 
     def __init__(self):
         self.batches = 0
@@ -37,6 +38,9 @@ class ComponentStats:
         # attributed to the ROOT's stats by run_operator — dispatches are
         # process-global, not attributable per operator without a sync)
         self.kernel_dispatches = 0
+        # fresh XLA traces/compiles the query triggered (same root-level
+        # attribution; 0 on the zero-recompile serving path)
+        self.kernel_compiles = 0
 
     def exclusive(self, children: list["Operator"]) -> float:
         return self.time_s - sum(c.stats.time_s for c in children)

@@ -51,6 +51,25 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _canonical_cap(n: int) -> int:
+    """Canonical capacity for DATA-DEPENDENT intermediates (spools, learned
+    emission caps, join output growth). With shape bucketing on, snaps to
+    the catalog.SHAPE_BUCKETS rung ladder so a repeat run whose literals
+    select a somewhat different row count still lands on the kernel shapes
+    the first run compiled — pure pow2 would mint a fresh specialization at
+    every doubling boundary. Stays pow2 (spool consumers assume it): rungs
+    are pow2, and above the top rung pow2 growth IS the coarse ladder.
+    Falls back to plain pow2 with bucketing off."""
+    from ..catalog import SHAPE_BUCKETS
+    from ..utils import settings
+
+    if settings.get("sql.distsql.shape_buckets.enabled"):
+        for b in SHAPE_BUCKETS:
+            if n <= b:
+                return b
+    return _next_pow2(n)
+
+
 def _live_total(tiles: list[Batch]) -> int:
     """Total live rows across spooled tiles — ONE host sync for the spool."""
     if not tiles:
@@ -60,8 +79,8 @@ def _live_total(tiles: list[Batch]) -> int:
 
 
 def _spool_cap(tiles: list[Batch]) -> int:
-    """Pow2 capacity fitting the spool's LIVE rows (concat compacts)."""
-    return _next_pow2(max(1, _live_total(tiles)))
+    """Canonical capacity fitting the spool's LIVE rows (concat compacts)."""
+    return _canonical_cap(max(1, _live_total(tiles)))
 
 
 class _FusedPull:
@@ -266,7 +285,11 @@ class ScanOp(SourceOperator):
         self._res_tile = min(tile, cap)
         if getattr(self, "_slice_tile", None) != self._res_tile:
             res_tile = self._res_tile
-            self._slice = dispatch.jit(functools.partial(_slice_tile, res_tile))
+            # the slice kernel takes (batch, offset) as arguments, so one
+            # wrapper per tile size serves EVERY resident table
+            self._slice = dispatch.jit(
+                functools.partial(_slice_tile, res_tile),
+                key=("slice_tile", res_tile))
             self._slice_tile = res_tile
 
     # -- streaming mode -----------------------------------------------------
@@ -308,14 +331,14 @@ class ScanOp(SourceOperator):
         if not self._initialized:
             self.init()
         if self.streaming:
+            self._parts_key = ("scan_stream",)
             return self, _identity_fn, ()
-        if not hasattr(self, "_slice_parts_fn"):
-            self._slice_parts_fn = self._slice_traced  # stable identity
-        return self, self._slice_parts_fn, ()
-
-    def _slice_traced(self, token):
-        b, off = token
-        return _slice_tile(self._res_tile, b, off)
+        self._parts_key = ("scan_slice", self._res_tile)
+        # one chain head per tile size, shared by every resident scan:
+        # stable identity keeps consumer compositions cached across runs
+        # AND across queries (the closure is immutable, so a re-init with
+        # a different tile gets a different fn, never a stale one)
+        return self, _slice_parts_for(self._res_tile), ()
 
     def stream_tiles(self):
         """Yield raw tile tokens for the fused path (reset scan position)."""
@@ -397,6 +420,20 @@ def _identity_fn(b):
     return b
 
 
+_slice_parts_fns: dict[int, object] = {}
+
+
+def _slice_parts_for(res_tile: int):
+    fn = _slice_parts_fns.get(res_tile)
+    if fn is None:
+        def fn(token):
+            b, off = token
+            return _slice_tile(res_tile, b, off)
+
+        fn = _slice_parts_fns.setdefault(res_tile, fn)
+    return fn
+
+
 def _slice_tile(tile: int, b: Batch, off) -> Batch:
     return jax.tree_util.tree_map(
         lambda x: jax.lax.dynamic_slice_in_dim(x, off, tile, axis=0), b
@@ -437,11 +474,13 @@ class HashBucketOp(OneInputOperator):
             return b.with_mask(
                 b.mask & (hashing.bucket(h, n_parts) == part))
 
+        self._key = dispatch.kernel_key(
+            "hashbucket", schema, keys, n_parts, part)
         self._raw = raw
-        self._fn = dispatch.jit(raw)
+        self._fn = dispatch.jit(raw, key=self._key)
 
     def stream_parts(self):
-        return _compose_parts(self, self.child, self._raw)
+        return _compose_parts(self, self.child, self._raw, key=self._key)
 
     def _next(self):
         b = self.child.next_batch()
@@ -477,42 +516,81 @@ class RemoteStreamOp(SourceOperator):
 
 
 class FilterOp(OneInputOperator):
-    def __init__(self, child: Operator, predicate: ex.Expr):
+    """Predicate mask. With ``params`` (a plancache.ParamStore), the
+    predicate's ex.Param leaves read their values from jit ARGUMENTS
+    instead of baked constants, so a cached plan rebinds literals with
+    zero new traces (the prepared-plan fast path)."""
+
+    def __init__(self, child: Operator, predicate: ex.Expr, params=None):
         super().__init__(child)
         self.output_schema = child.output_schema
         schema = child.output_schema
+        self.predicate = predicate
+        self._params = params
+        if params is None:
+            def raw(b: Batch) -> Batch:
+                return b.with_mask(ex.filter_mask(b, schema, predicate))
+        else:
+            def raw(b: Batch, *pv) -> Batch:
+                with ex.param_scope(pv):
+                    return b.with_mask(ex.filter_mask(b, schema, predicate))
 
-        def raw(b: Batch) -> Batch:
-            return b.with_mask(ex.filter_mask(b, schema, predicate))
-
+        self._key = dispatch.kernel_key(
+            "filter", schema, predicate, params is not None)
         self._raw = raw
-        self._fn = dispatch.jit(raw)
+        self._fn = dispatch.jit(raw, key=self._key)
 
     def stream_parts(self):
-        return _compose_parts(self, self.child, self._raw)
+        extra = () if self._params is None else self._params.args()
+        return _compose_parts(self, self.child, self._raw, key=self._key,
+                              extra=extra)
 
     def _next(self):
         b = self.child.next_batch()
-        return None if b is None else self._fn(b)
+        if b is None:
+            return None
+        if self._params is None:
+            return self._fn(b)
+        return self._fn(b, *self._params.args())
 
 
-def _compose_parts(op, child, raw_fn):
+_chain_cache: dict = {}
+
+
+def _compose_parts(op, child, raw_fn, key=None, extra=()):
     """Chain raw_fn onto the child's fused streaming function (args
-    pass-through; composition cached per operator instance)."""
+    pass-through; composition cached per operator instance).
+
+    When both the child's chain and this op carry structural kernel keys,
+    the composed chain function is ALSO shared process-globally (keyed on
+    the key pair), so two queries with identical fused prefixes reuse one
+    traced chain — the cross-query half of the kernel cache. ``extra``
+    appends this op's runtime arguments (param values) after the child's;
+    the chain splits them back out positionally, so values stay jit
+    ARGUMENTS (re-read every run) rather than baked constants."""
     parts = child.stream_parts()
     if parts is None:
         return None
     src, cfn, cargs = parts
+    ckey = getattr(child, "_parts_key", None)
+    chain_key = (("chain", ckey, key, len(cargs))
+                 if ckey is not None and key is not None else None)
     chain = getattr(op, "_chain_fn", None)
     if chain is None or getattr(op, "_chain_base", None) is not cfn:
-        nc = len(cargs)
+        chain = (_chain_cache.get(chain_key)
+                 if chain_key is not None else None)
+        if chain is None:
+            nc = len(cargs)
 
-        def chain(t, *a):
-            return raw_fn(cfn(t, *a[:nc]))
+            def chain(t, *a):
+                return raw_fn(cfn(t, *a[:nc]), *a[nc:])
 
+            if chain_key is not None:
+                chain = _chain_cache.setdefault(chain_key, chain)
         op._chain_fn = chain
         op._chain_base = cfn
-    return src, op._chain_fn, cargs
+    op._parts_key = chain_key
+    return src, op._chain_fn, tuple(cargs) + tuple(extra)
 
 
 class ProjectOp(OneInputOperator):
@@ -547,11 +625,12 @@ class ProjectOp(OneInputOperator):
                 cols.append(Column(data=d, valid=v))
             return Batch(cols=tuple(cols), mask=b.mask)
 
+        self._key = dispatch.kernel_key("project", schema, exprs)
         self._raw = raw
-        self._fn = dispatch.jit(raw)
+        self._fn = dispatch.jit(raw, key=self._key)
 
     def stream_parts(self):
-        return _compose_parts(self, self.child, self._raw)
+        return _compose_parts(self, self.child, self._raw, key=self._key)
 
     def _next(self):
         b = self.child.next_batch()
@@ -571,7 +650,8 @@ class LimitOp(OneInputOperator):
             keep = b.mask & (pos >= offset) & (pos < offset + limit)
             return b.with_mask(keep), seen + jnp.sum(b.mask, dtype=jnp.int32)
 
-        self._fn = dispatch.jit(fn)
+        self._fn = dispatch.jit(
+            fn, key=dispatch.kernel_key("limit", offset, limit))
 
     def init(self):
         super().init()
@@ -917,7 +997,7 @@ class AggregateOp(OneInputOperator):
         merged, ng = self._merge_fn(tuple(self._tiles), cap=cap)
         # one bounded retry loop per merge-down, not per tile
         while int(ng) > cap:
-            cap = _next_pow2(int(ng))
+            cap = _canonical_cap(int(ng))
             merged, ng = self._merge_fn(tuple(self._tiles), cap=cap)
         return merged
 
@@ -1561,7 +1641,7 @@ class HashJoinOp(OneInputOperator):
         )
         tile = self._emit_tilecap
         if tile and mx * 4 <= tile:
-            self._emit_cap = max(1024, _next_pow2(2 * mx))
+            self._emit_cap = max(1024, _canonical_cap(2 * mx))
             self._emit_mode = "compact"
         else:
             self._emit_mode = "transparent"
@@ -1595,14 +1675,14 @@ class HashJoinOp(OneInputOperator):
             # initial capacity: assume FK-ish fanout <= 1 per probe row
             # (planner estimate), double on overflow — the retry recompiles,
             # so the estimate errs large
-            self._out_cap = max(4096, _next_pow2(p.capacity))
+            self._out_cap = max(4096, _canonical_cap(p.capacity))
         while True:
             out, total = self._probe_gen_fn(
                 p, self._build_batch, self._index, out_cap=self._out_cap
             )
             if int(total) <= self._out_cap:
                 return out
-            self._out_cap = _next_pow2(int(total))
+            self._out_cap = _canonical_cap(int(total))
 
     def close(self):
         super().close()
@@ -2127,7 +2207,7 @@ class MergeJoinOp(OneInputOperator):
             )
             if int(total) <= self._out_cap:
                 return out
-            self._out_cap = _next_pow2(int(total))
+            self._out_cap = _canonical_cap(int(total))
 
     def close(self):
         super().close()

@@ -109,6 +109,7 @@ def run_operator(root) -> dict[str, np.ndarray]:
     metric.QUERIES.inc()
     t0 = time.perf_counter()
     d0 = dispatch.total()
+    c0 = dispatch.compiles()
     overlap = settings.get("sql.distsql.readback_overlap")
     try:
         # speculative-capacity retry loop: operators run with sticky learned
@@ -166,6 +167,7 @@ def run_operator(root) -> dict[str, np.ndarray]:
             # per-query dispatch attribution (EXPLAIN ANALYZE header);
             # dispatches are process-global so they land on the root
             st.kernel_dispatches += dispatch.total() - d0
+            st.kernel_compiles += dispatch.compiles() - c0
         root.close()
     if not outs:
         return {n: np.array([]) for n in root.output_schema.names}

@@ -13,7 +13,12 @@ keeps that class of regression out of the hot-path modules:
   (``jnp.asarray`` — host->device — is NOT flagged);
 - ``jax.device_get``/``jax.block_until_ready`` are explicit syncs;
 - a truth test (``if``/``while``/``assert``/``and``/``or``/``not``) over a
-  ``jnp.*`` call forces __bool__ on a traced value.
+  ``jnp.*`` call forces __bool__ on a traced value;
+- ``jax.debug.print``/``jax.debug.callback`` (and ``pure_callback``/
+  ``io_callback``) stage a host callback into the traced kernel — one
+  host round trip per launch, and ``ordered=True`` serializes the whole
+  stream behind it. Debug prints belong OUTSIDE the jit or behind a
+  pragma while actively debugging.
 
 Scope: the hot-path modules only (flow/runtime.py, flow/fuse.py,
 flow/operators.py, ops/*). Host-boundary modules whose whole JOB is the
@@ -49,6 +54,14 @@ _CASTS = {"int", "float", "bool"}
 _NP_SYNCS = {("np", "asarray"), ("np", "array"),
              ("numpy", "asarray"), ("numpy", "array")}
 _JAX_SYNCS = {("jax", "device_get"), ("jax", "block_until_ready")}
+# host callbacks staged INTO traced code: each kernel launch round-trips
+# through the host (jax.debug.print/debug.callback ride the same effect
+# machinery as io_callback; ordered=True additionally serializes the
+# stream). One per tile re-creates exactly the per-tile sync this pass
+# exists to keep out of the pull loop.
+_HOST_CALLBACKS = {("jax", "debug", "print"), ("jax", "debug", "callback"),
+                   ("jax", "pure_callback"),
+                   ("jax", "experimental", "io_callback")}
 _DEVICE_ROOTS = {"jnp", "jax"}
 # jnp attributes that are host-side metadata, not traced computation
 _HOST_SAFE_ATTRS = {"issubdtype", "iinfo", "finfo", "dtype", "result_type",
@@ -114,6 +127,11 @@ def check(src: SourceFile) -> list[Finding]:
             elif chain in _JAX_SYNCS:
                 flag(node, f"{'.'.join(chain)}() is an explicit device "
                            "sync in a hot-path module")
+            elif chain in _HOST_CALLBACKS:
+                flag(node, f"{'.'.join(chain)}() stages a host callback "
+                           "into traced code (one host round trip per "
+                           "kernel launch; ordered=True serializes the "
+                           "stream)")
         elif isinstance(node, (ast.If, ast.While, ast.Assert)):
             if _device_call(node.test):
                 flag(node, "truth test over a jnp/jax call forces __bool__ "

@@ -18,6 +18,7 @@ CodeLookup gather on device (see coldata.Dictionary).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -43,6 +44,20 @@ class ColRef(Expr):
 @dataclass(frozen=True)
 class Const(Expr):
     value: Any
+    type: SQLType
+
+
+@dataclass(frozen=True)
+class Param(Expr):
+    """A runtime-bound literal slot (the prepared-statement placeholder).
+
+    The prepared-plan cache (sql/plancache.py) rewrites numeric Consts in
+    filter predicates into Params so the literal becomes a jit ARGUMENT
+    read from the active ``param_scope`` at trace time — a repeat query
+    with different literals reuses the cached executables with zero new
+    traces. Values arrive pre-scaled for DECIMAL (host-side, at bind)."""
+
+    slot: int
     type: SQLType
 
 
@@ -203,6 +218,40 @@ def between(e: Expr, lo: Expr, hi: Expr) -> Expr:
 
 
 # ---------------------------------------------------------------------------
+# Parameter scope (prepared-plan literal rebinding)
+
+_PARAM_SCOPE = threading.local()
+
+
+class param_scope:
+    """Context manager installing the positional parameter values a traced
+    predicate's Param leaves read. Thread-local (concurrent sessions trace
+    on their own threads) and re-entrant (inner scope shadows outer)."""
+
+    def __init__(self, values):
+        self._values = tuple(values)
+
+    def __enter__(self):
+        self._prev = getattr(_PARAM_SCOPE, "values", None)
+        _PARAM_SCOPE.values = self._values
+        return self
+
+    def __exit__(self, *exc):
+        _PARAM_SCOPE.values = self._prev
+        return False
+
+
+def param_value(slot: int):
+    values = getattr(_PARAM_SCOPE, "values", None)
+    if values is None:
+        raise RuntimeError(
+            "Param evaluated outside a param_scope — parameterized "
+            "predicates only run through operators built with a ParamStore"
+        )
+    return values[slot]
+
+
+# ---------------------------------------------------------------------------
 # Type inference
 
 
@@ -210,6 +259,8 @@ def expr_type(e: Expr, schema: Schema) -> SQLType:
     if isinstance(e, ColRef):
         return schema.types[e.idx]
     if isinstance(e, Const):
+        return e.type
+    if isinstance(e, Param):
         return e.type
     if isinstance(e, (Cmp, BoolOp, Not, IsNull)):
         return BOOL
@@ -371,6 +422,15 @@ def eval_expr(e: Expr, cols, schema: Schema):
             jnp.full((n,), v, dtype=e.type.dtype),
             jnp.ones((n,), jnp.bool_),
         )
+
+    if isinstance(e, Param):
+        # the value is a traced argument (see param_scope), NOT a baked
+        # constant — rebinding it later never invalidates the executable
+        n = cols[0].data.shape[0]
+        v = param_value(e.slot)
+        data = jnp.broadcast_to(
+            jnp.asarray(v).astype(e.type.dtype), (n,))
+        return data, jnp.ones((n,), jnp.bool_)
 
     if isinstance(e, CodeLookup):
         c = cols[e.col]

@@ -109,11 +109,15 @@ def _clustered_input(plan: S.PlanNode, group_cols, catalog: Catalog):
             return False, False
 
 
-def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
+def build(plan: S.PlanNode, catalog: Catalog, params=None) -> Operator:
     """Instantiate the operator tree for `plan`, then collapse contiguous
     stateless per-tile chains into single-kernel FusedPipeline segments
-    (flow/fuse.py) unless sql.distsql.fusion.enabled is off."""
-    op = _build(plan, catalog)
+    (flow/fuse.py) unless sql.distsql.fusion.enabled is off.
+
+    ``params`` (a sql/plancache.ParamStore) reaches FilterOps whose
+    predicates carry ex.Param leaves, so cached plans rebind literals as
+    jit arguments instead of retracing (the prepared-plan fast path)."""
+    op = _build(plan, catalog, params)
     if settings.get("sql.distsql.fusion.enabled"):
         from ..flow import fuse
 
@@ -121,7 +125,7 @@ def build(plan: S.PlanNode, catalog: Catalog) -> Operator:
     return op
 
 
-def _build(plan: S.PlanNode, catalog: Catalog) -> Operator:
+def _build(plan: S.PlanNode, catalog: Catalog, params=None) -> Operator:
     if isinstance(plan, S.TableScan):
         return ops.ScanOp(
             catalog.get(plan.table), plan.columns,
@@ -134,21 +138,22 @@ def _build(plan: S.PlanNode, catalog: Catalog) -> Operator:
             plan.columns,
         )
     if isinstance(plan, S.HashBucket):
-        return ops.HashBucketOp(_build(plan.input, catalog), plan.keys,
+        return ops.HashBucketOp(_build(plan.input, catalog, params), plan.keys,
                                 plan.n_parts, plan.part)
     if isinstance(plan, S.RemoteStream):
         return ops.RemoteStreamOp(plan.addr, plan.flow_id, plan.stream_id,
                                   plan.schema)
     if isinstance(plan, S.StreamUnion):
         return ops.ParallelUnorderedSyncOp(
-            tuple(_build(p, catalog) for p in plan.inputs))
+            tuple(_build(p, catalog, params) for p in plan.inputs))
     if isinstance(plan, S.Filter):
-        return ops.FilterOp(_build(plan.input, catalog), plan.predicate)
+        return ops.FilterOp(_build(plan.input, catalog, params),
+                            plan.predicate, params=params)
     if isinstance(plan, S.Project):
-        return ops.ProjectOp(_build(plan.input, catalog), plan.exprs,
+        return ops.ProjectOp(_build(plan.input, catalog, params), plan.exprs,
                              plan.names, plan.dict_overrides)
     if isinstance(plan, S.Aggregate):
-        child = _build(plan.input, catalog)
+        child = _build(plan.input, catalog, params)
         if plan.key_sizes is not None and plan.mode == "complete":
             return ops.SmallGroupAggregateOp(
                 child, plan.group_cols, plan.aggs, plan.key_sizes
@@ -167,38 +172,38 @@ def _build(plan: S.PlanNode, catalog: Catalog) -> Operator:
         return ops.AggregateOp(child, plan.group_cols, plan.aggs, plan.mode,
                                ordered=ordered, prefix_live=prefix_live)
     if isinstance(plan, S.ScalarAggregate):
-        return ops.ScalarAggregateOp(_build(plan.input, catalog), plan.aggs)
+        return ops.ScalarAggregateOp(_build(plan.input, catalog, params), plan.aggs)
     if isinstance(plan, S.Sort):
-        return ops.SortOp(_build(plan.input, catalog), plan.keys)
+        return ops.SortOp(_build(plan.input, catalog, params), plan.keys)
     if isinstance(plan, S.Limit):
-        return ops.LimitOp(_build(plan.input, catalog), plan.limit, plan.offset)
+        return ops.LimitOp(_build(plan.input, catalog, params), plan.limit, plan.offset)
     if isinstance(plan, S.Distinct):
-        return ops.DistinctOp(_build(plan.input, catalog), plan.cols)
+        return ops.DistinctOp(_build(plan.input, catalog, params), plan.cols)
     if isinstance(plan, S.Window):
         return ops.WindowOp(
-            _build(plan.input, catalog), plan.partition_cols,
+            _build(plan.input, catalog, params), plan.partition_cols,
             plan.order_keys, plan.specs,
         )
     if isinstance(plan, S.MergeJoin):
         return ops.MergeJoinOp(
-            _build(plan.probe, catalog),
-            _build(plan.build, catalog),
+            _build(plan.probe, catalog, params),
+            _build(plan.build, catalog, params),
             plan.probe_key,
             plan.build_key,
             plan.spec,
         )
     if isinstance(plan, S.HashJoin):
         return ops.HashJoinOp(
-            _build(plan.probe, catalog),
-            _build(plan.build, catalog),
+            _build(plan.probe, catalog, params),
+            _build(plan.build, catalog, params),
             plan.probe_keys,
             plan.build_keys,
             plan.spec,
         )
     if isinstance(plan, S.Union):
-        return ops.UnionOp(tuple(_build(p, catalog) for p in plan.inputs))
+        return ops.UnionOp(tuple(_build(p, catalog, params) for p in plan.inputs))
     if isinstance(plan, S.Exchange):
         # single-device build: the shuffle is the identity; the multi-device
         # path lives in parallel/shuffle.py and is planned by parallel/dist.py
-        return _build(plan.input, catalog)
+        return _build(plan.input, catalog, params)
     raise TypeError(f"unknown plan node {plan}")

@@ -140,4 +140,6 @@ def explain_analyze(plan: S.PlanNode, root_op) -> str:
     kd = getattr(getattr(root_op, "stats", None), "kernel_dispatches", 0)
     if kd:
         lines.append(f"kernel dispatches: {kd}")
+        kc = getattr(root_op.stats, "kernel_compiles", 0)
+        lines.append(f"kernel compiles: {kc} (cached: {kd - kc})")
     return "\n".join(lines)

@@ -321,7 +321,11 @@ class _Conn:
             if sql is None:
                 raise ValueError(f"unknown portal {portal!r}")
             # extended-protocol Execute sends DataRows WITHOUT a
-            # RowDescription (clients got it from Describe)
+            # RowDescription (clients got it from Describe). The inlined
+            # text reaches Session.execute, where sql/plancache.py
+            # re-parameterizes it — so Parse-once/Bind-many clients hit
+            # the prepared-plan cache on every rebind: no re-plan, no new
+            # XLA compiles (the inlined literals rebind as jit arguments).
             self._run_query(sql, send_row_desc=False)
         elif tag == b"C":  # Close 'S'|'P' + name
             kind, name = body[:1], body[1:].rstrip(b"\x00")

@@ -26,8 +26,12 @@ def explain(catalog, text: str) -> str:
     if distsql:
         return rel.explain_distributed()
     if analyze:
+        from . import plancache
+
         rendered, _ = rel.explain_analyze()
-        return rendered
+        # status a NORMAL execution of this statement would see (analyze
+        # itself always runs a fresh instrumented tree)
+        return rendered + f"\nplan cache: {plancache.probe(rel)}"
     return rel.explain()
 
 

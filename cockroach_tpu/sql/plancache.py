@@ -1,0 +1,546 @@
+"""Prepared-plan cache — the zero-recompile serving path (L2 of the cache
+hierarchy; see README "Compile-avoidance cache hierarchy").
+
+Reference shape: pkg/sql's query cache (plan_opt.go / querycache) keys
+memoized plans on statement + placeholder types + catalog descriptor
+versions, so the conn executor skips optbuild on repeat statements. Here
+the expensive phase is not optimization but the build->fuse->XLA-compile
+pipeline, so the cache holds the BUILT operator tree:
+
+- ``parameterize`` rewrites numeric literals in Filter predicates into
+  ``ex.Param`` slots, so a repeat statement with different literals maps
+  to the same structural plan; the values are rebound per execution as
+  jit ARGUMENTS (ops/expr.param_scope), never retraced.
+- ``plan_key`` derives a stable structural key from the parameterized
+  plan (frozen dataclasses all the way down). Anything it cannot key
+  byte-stably (runtime-filled dictionaries, unknown objects) raises
+  ``_Unkeyable`` and the statement simply is not cached — conservative
+  misses, never wrong hits.
+- Entries are LRU-bounded (``sql.plan_cache.size``) and keyed on the
+  catalog schema version + the settings signature, so DDL (CREATE/DROP
+  INDEX, ALTER) and tuning changes can never serve a stale plan; the
+  session's DDL handlers additionally sweep dead-version entries out
+  eagerly (``invalidate``).
+- A per-entry lock serializes concurrent sessions through one entry:
+  operator trees hold mutable pull state, so two sessions never drive
+  the same tree at once (they queue; distinct statements run in
+  parallel).
+
+Execution-stats collection (EXPLAIN ANALYZE / the cluster setting)
+bypasses the cache: stats need a fresh per-operator tree, and cached
+trees deliberately skip the instrumented path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..coldata.batch import Dictionary
+from ..coldata.types import Family
+from ..ops import expr as ex
+from ..plan import builder as plan_builder
+from ..plan import spec as S
+from ..utils import metric, settings
+
+# literal families rewritten into Param slots: everything whose device
+# representation is a plain numeric scalar. STRING stays literal (string
+# predicates lower to host-built CodeLookup tables — content-keyed), BOOL
+# stays literal (structural TRUE/FALSE branches), NULL stays literal (its
+# valid-mask shape differs from any bound value)
+_PARAM_FAMILIES = (Family.INT, Family.FLOAT, Family.DECIMAL, Family.DATE,
+                   Family.TIMESTAMP, Family.INTERVAL)
+
+
+class _Unkeyable(Exception):
+    """The plan holds an object with no stable structural key; the
+    statement runs uncached (conservative — a miss is always correct)."""
+
+
+class ParamStore:
+    """Positional parameter values for one cached plan, shared by every
+    operator the plan's builder created with ``params=``.
+
+    ``args()`` is re-read at each run's ``stream_parts`` fetch, so
+    rebinding values between runs flows into the jitted kernels as fresh
+    arguments — dtypes are pinned per slot at parameterize time, so no
+    value change can force a retrace."""
+
+    def __init__(self, types):
+        self._types = tuple(types)
+        self._values: tuple | None = None
+
+    def set_values(self, values) -> None:
+        if len(values) != len(self._types):
+            raise ValueError(
+                f"expected {len(self._types)} parameter values, "
+                f"got {len(values)}")
+        out = []
+        for v, t in zip(values, self._types):
+            if t.family is Family.DECIMAL:
+                # the same host-side fixed-point scaling Const evaluation
+                # applies (ops/expr.py) — device kernels see scaled ints
+                v = int(round(float(v) * 10 ** t.scale))
+            out.append(np.asarray(v, dtype=t.dtype))
+        self._values = tuple(out)
+
+    def args(self) -> tuple:
+        if self._values is None:
+            raise RuntimeError("ParamStore.args() before set_values()")
+        return self._values
+
+
+def parameterize(plan):
+    """Rewrite numeric Filter-predicate literals into Param slots.
+
+    Returns ``(pplan, values, types)``: the parameterized plan (shared
+    across every statement with the same shape), the extracted literal
+    values in slot order, and their SQL types. Runs AFTER index
+    selection (plan/indexopt.py), so IndexScan lo/hi bounds stay
+    literal — different index bounds are different plans by design."""
+    values: list = []
+    types: list = []
+
+    def walk_expr(e):
+        if isinstance(e, ex.Const):
+            if (e.value is not None
+                    and e.type.family in _PARAM_FAMILIES
+                    and not isinstance(e.value, (tuple, list, np.ndarray))):
+                p = ex.Param(len(values), e.type)
+                values.append(e.value)
+                types.append(e.type)
+                return p
+            return e
+        if isinstance(e, ex.CodeLookup) or not isinstance(e, ex.Expr):
+            return e
+        if isinstance(e, ex.Func2) and e.func == "round2":
+            # round2's digit count is read with .value at trace time
+            # ("binder guarantees a literal") — it must stay a Const
+            left = walk_expr(e.left)
+            return (e if left is e.left
+                    else dataclasses.replace(e, left=left))
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            nv = walk_field(v)
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(e, **changes) if changes else e
+
+    def walk_field(v):
+        if isinstance(v, ex.Expr):
+            return walk_expr(v)
+        if isinstance(v, tuple):
+            nv = tuple(walk_field(i) for i in v)
+            return nv if any(a is not b for a, b in zip(nv, v)) else v
+        return v
+
+    def walk_plan(n):
+        if not dataclasses.is_dataclass(n):
+            return n
+        changes = {}
+        for f in dataclasses.fields(n):
+            v = getattr(n, f.name)
+            if isinstance(n, S.Filter) and f.name == "predicate":
+                nv = walk_expr(v)
+            elif isinstance(v, S.PlanNode):
+                nv = walk_plan(v)
+            elif (isinstance(v, tuple) and v
+                    and isinstance(v[0], S.PlanNode)):
+                nv = tuple(walk_plan(i) for i in v)
+                if not any(a is not b for a, b in zip(nv, v)):
+                    nv = v
+            else:
+                nv = v
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(n, **changes) if changes else n
+
+    return walk_plan(plan), tuple(values), tuple(types)
+
+
+def plan_key(pplan):
+    """Stable structural key of a (parameterized) plan tree. Raises
+    ``_Unkeyable`` for objects without byte-stable content."""
+    return _key_of(pplan)
+
+
+def _key_of(x):
+    if x is None or isinstance(x, (bool, int, float, str, bytes)):
+        return x
+    if isinstance(x, enum.Enum):
+        return ("enum", type(x).__name__, x.name)
+    if isinstance(x, np.generic):
+        return ("np", str(x.dtype), x.item())
+    if isinstance(x, np.ndarray):
+        return ("nd", str(x.dtype), x.shape, x.tobytes())
+    if isinstance(x, ex.CodeLookup):
+        # eq=False dataclass (identity semantics for jit keys); the plan
+        # key compares the host table's CONTENT so two binds of the same
+        # string predicate share an entry
+        t = np.asarray(x.table)
+        return ("codelookup", x.col, _key_of(x.out_type), str(t.dtype),
+                t.shape, t.tobytes())
+    if isinstance(x, Dictionary):
+        if getattr(x, "_runtime", False):
+            raise _Unkeyable("runtime-filled dictionary")
+        return ("dict", tuple(str(v) for v in x.values))
+    if isinstance(x, (tuple, list)):
+        return ("seq", tuple(_key_of(i) for i in x))
+    if dataclasses.is_dataclass(x):
+        return ((type(x).__name__,)
+                + tuple(_key_of(getattr(x, f.name))
+                        for f in dataclasses.fields(x)))
+    raise _Unkeyable(type(x).__name__)
+
+
+def _table_names(plan) -> list[str]:
+    names: set[str] = set()
+
+    def walk(n):
+        if isinstance(n, (S.TableScan, S.IndexScan)):
+            names.add(n.table)
+        for f in ("input", "probe", "build"):
+            c = getattr(n, f, None)
+            if c is not None:
+                walk(c)
+        for c in getattr(n, "inputs", ()) or ():
+            walk(c)
+
+    walk(plan)
+    return sorted(names)
+
+
+def _dict_gen(catalog, plan) -> tuple:
+    """Per-table string-dictionary generations (column -> value count).
+    Built operators capture dictionary SNAPSHOTS (flow/operators.py
+    _wire_source_metadata), so an INSERT that mints a new string value
+    must re-key the plan — decoding through the stale snapshot would
+    mislabel the new codes. Row-count changes alone keep hitting."""
+    return _dict_gen_for(catalog, _table_names(plan))
+
+
+def _dict_gen_for(catalog, names) -> tuple:
+    out = []
+    for name in names:
+        t = catalog.tables.get(name)
+        if t is None:
+            continue
+        d = t.dictionaries  # KVTable property returns fresh snapshots
+        out.append((name, tuple(sorted(
+            (c, len(dd.values)) for c, dd in d.items()))))
+    return tuple(out)
+
+
+def _settings_sig() -> tuple:
+    """Current values of every registered setting. Conservative: ANY
+    settings change re-keys the cache (a stale tile size or fusion mode
+    must never serve), at the cost of misses on unrelated toggles."""
+    reg = settings.all_settings()
+    return tuple((n, str(reg[n].get())) for n in sorted(reg))
+
+
+class _Entry:
+    __slots__ = ("root", "store", "version", "fingerprint", "lock", "hits")
+
+    def __init__(self, root, store, version, fingerprint):
+        self.root = root
+        self.store = store
+        self.version = version
+        self.fingerprint = fingerprint
+        self.lock = threading.Lock()
+        self.hits = 0
+
+
+class PlanCache:
+    """Size-capped LRU of built plans, one per Catalog (``cache_for``).
+    ``hits``/``misses`` counters are per-cache (tests); the process
+    metrics (sql_plan_cache_*) aggregate across catalogs."""
+
+    def __init__(self):
+        self._entries: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self._texts: OrderedDict = OrderedDict()  # fingerprint -> last text
+        self._memo: OrderedDict = OrderedDict()   # exact text -> (key, values)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def lookup(self, key):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                metric.PLAN_CACHE_MISSES.inc()
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            e.hits += 1
+            metric.PLAN_CACHE_HITS.inc()
+            return e
+
+    def peek(self, key):
+        with self._lock:
+            return self._entries.get(key)
+
+    def insert(self, key, entry) -> "_Entry":
+        cap = int(settings.get("sql.plan_cache.size"))
+        with self._lock:
+            cur = self._entries.get(key)
+            if cur is not None:
+                return cur  # concurrent first executions: first wins
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > cap:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+                metric.PLAN_CACHE_EVICTIONS.inc()
+            return entry
+
+    def invalidate(self, version: int) -> int:
+        """Eagerly drop entries built against a dead catalog version
+        (DDL). Version is part of the key, so stale entries could never
+        HIT again — this sweep just frees them immediately."""
+        with self._lock:
+            dead = [k for k, e in self._entries.items()
+                    if e.version != version]
+            for k in dead:
+                del self._entries[k]
+                self.evictions += 1
+                metric.PLAN_CACHE_EVICTIONS.inc()
+            self._memo.clear()
+            return len(dead)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._memo.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- exact-text memo (skips parse/bind/optimize on verbatim repeats) --
+
+    _MEMO_CAP = 512
+
+    def memo_get(self, text):
+        with self._lock:
+            v = self._memo.get(text)
+            if v is not None:
+                self._memo.move_to_end(text)
+            return v
+
+    def memo_put(self, text, key, values, tables) -> None:
+        with self._lock:
+            self._memo[text] = (key, values, tables)
+            self._memo.move_to_end(text)
+            while len(self._memo) > self._MEMO_CAP:
+                self._memo.popitem(last=False)
+
+    # -- warmup bookkeeping ----------------------------------------------
+
+    _TEXT_CAP = 256
+
+    def note_text(self, fingerprint: str, text: str) -> None:
+        with self._lock:
+            self._texts[fingerprint] = text
+            self._texts.move_to_end(fingerprint)
+            while len(self._texts) > self._TEXT_CAP:
+                self._texts.popitem(last=False)
+
+    def hot_texts(self, limit: int = 32) -> list[str]:
+        """Recorded statement texts for the hottest fingerprints, by the
+        sqlstats execution counts (sql/sqlstats.py)."""
+        from . import sqlstats
+
+        with self._lock:
+            texts = dict(self._texts)
+        counts = {s.fingerprint: s.count for s in sqlstats.DEFAULT.all()}
+        order = sorted(texts, key=lambda fp: -counts.get(fp, 0))
+        return [texts[fp] for fp in order[:limit]]
+
+
+def cache_for(catalog) -> PlanCache:
+    pc = getattr(catalog, "_plan_cache", None)
+    if pc is None:
+        pc = catalog._plan_cache = PlanCache()
+    return pc
+
+
+# -- the serving path --------------------------------------------------------
+
+
+def _cacheable() -> bool:
+    return (settings.get("sql.plan_cache.enabled")
+            and not settings.get("sql.stats.collect_execution_stats"))
+
+
+_VOLATILE = ("now(", "current_date", "current_timestamp")
+
+
+def run_cached(rel, text: str | None = None):
+    """Execute a bound Rel through the plan cache.
+
+    Returns ``(results, status)`` with status one of ``hit`` (literals
+    rebound into a cached tree, zero new builds), ``miss`` (built fresh
+    and cached), ``uncacheable`` (no stable key), ``bypass`` (cache off
+    or stats collection on)."""
+    from ..flow import runtime
+
+    if not _cacheable():
+        return rel.run(), "bypass"
+    maybe_enable_compile_cache()
+    cache = cache_for(rel.catalog)
+    plan = rel.optimized_plan()
+    try:
+        pplan, values, types = parameterize(plan)
+        key = (plan_key(pplan), rel.catalog.version, _settings_sig(),
+               _dict_gen(rel.catalog, pplan))
+    except _Unkeyable:
+        return runtime.run_plan(plan, rel.catalog), "uncacheable"
+    entry = cache.lookup(key)
+    status = "hit"
+    if entry is None:
+        status = "miss"
+        store = ParamStore(types)
+        store.set_values(values)
+        root = plan_builder.build(pplan, rel.catalog, params=store)
+        entry = _Entry(root, store, rel.catalog.version, _fingerprint(text))
+        # run BEFORE publishing: a plan whose first execution fails never
+        # enters the cache (concurrent first executions may both build;
+        # insert keeps whichever published first)
+        with entry.lock:
+            entry.store.set_values(values)
+            res = runtime.run_operator(entry.root)
+        entry = cache.insert(key, entry)
+    else:
+        with entry.lock:
+            entry.store.set_values(values)
+            res = runtime.run_operator(entry.root)
+    if text is not None:
+        if entry.fingerprint:
+            cache.note_text(entry.fingerprint, text)
+        low = text.lower()
+        if not any(tok in low for tok in _VOLATILE):
+            # verbatim repeats can skip parse/bind next time; statements
+            # with per-bind folded volatiles (now()) must re-bind
+            cache.memo_put(text, key, values, tuple(_table_names(pplan)))
+    return res, status
+
+
+def run_memoized(catalog, text: str):
+    """Exact-text fast path: if this verbatim statement ran before and
+    its entry is still live (same catalog version + settings), execute it
+    without parsing or binding. Returns results or None (fall through to
+    the normal path)."""
+    from ..flow import runtime
+
+    if not _cacheable():
+        return None
+    cache = cache_for(catalog)
+    m = cache.memo_get(text)
+    if m is None:
+        return None
+    key, values, tables = m
+    # key embeds (version, settings sig, dict gens); ALL must still hold
+    # — the entry itself may still live under the old key, so a stale
+    # dictionary generation has to be rejected here, not left to lookup
+    if (key[1] != catalog.version or key[2] != _settings_sig()
+            or key[3] != _dict_gen_for(catalog, tables)):
+        return None
+    entry = cache.lookup(key)
+    if entry is None:
+        return None
+    with entry.lock:
+        entry.store.set_values(values)
+        return runtime.run_operator(entry.root)
+
+
+def probe(rel) -> str:
+    """Cache status a statement WOULD see, without executing — the
+    EXPLAIN ANALYZE "plan cache:" line (stats collection itself always
+    runs the instrumented fresh tree)."""
+    if not settings.get("sql.plan_cache.enabled"):
+        return "disabled"
+    try:
+        pplan, _, _ = parameterize(rel.optimized_plan())
+        key = (plan_key(pplan), rel.catalog.version, _settings_sig(),
+               _dict_gen(rel.catalog, pplan))
+    except _Unkeyable:
+        return "uncacheable"
+    hit = cache_for(rel.catalog).peek(key) is not None
+    return "hit" if hit else "miss"
+
+
+def _fingerprint(text: str | None) -> str:
+    if text is None:
+        return ""
+    from . import sqlstats
+
+    return sqlstats.fingerprint(text)
+
+
+# -- L3: on-disk XLA compilation cache ---------------------------------------
+
+_compile_cache_on = False
+
+
+def maybe_enable_compile_cache() -> None:
+    """Idempotently turn on JAX's persistent compilation cache when
+    ``sql.compile_cache.enabled`` is set — process restarts then reload
+    executables from disk instead of recompiling the kernel fleet."""
+    global _compile_cache_on
+    if _compile_cache_on or not settings.get("sql.compile_cache.enabled"):
+        return
+    from ..utils.backend import enable_compile_cache
+
+    enable_compile_cache(settings.get("sql.compile_cache.dir") or None)
+    _compile_cache_on = True
+
+
+# -- background pre-warming --------------------------------------------------
+
+
+def start_warmup(session, statements=None) -> threading.Thread | None:
+    """Re-execute hot statements on a background session so their plans
+    and kernel specializations are compiled OFF the serving path (after
+    process start or a DDL invalidation). Gated on
+    ``sql.plan_cache.warmup.enabled``; returns the daemon thread (join it
+    in tests) or None when disabled / nothing to warm.
+
+    Replaying the hottest recorded statement texts warms every level at
+    once: the plan cache entry, each kernel at its current canonical
+    tile shape (catalog.SHAPE_BUCKETS keeps that menu small), and — when
+    enabled — the on-disk XLA cache."""
+    if not settings.get("sql.plan_cache.warmup.enabled"):
+        return None
+    texts = (list(statements) if statements is not None
+             else cache_for(session.catalog).hot_texts())
+    if not texts:
+        return None
+    from .session import Session
+
+    # a PRIVATE session over the shared catalog/store: the warmup thread
+    # must never touch the serving session's transaction state
+    bg = Session(catalog=session.catalog, db=session.db, bootstrap=False)
+
+    def _run():
+        for t in texts:
+            try:
+                # twice: the first execution compiles; the second settles
+                # adaptive capacities (join emission caps learn from run 1
+                # and re-specialize once), so the SERVING repeat is pure
+                # dispatch — scripts/check_recompiles.py holds it to zero
+                bg.execute(t)
+                bg.execute(t)
+            except Exception:  # noqa: BLE001 — warmup is best-effort
+                continue
+
+    th = threading.Thread(target=_run, name="plan-warmup", daemon=True)
+    th.start()
+    return th

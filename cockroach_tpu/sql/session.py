@@ -171,9 +171,17 @@ class Session:
             handled = self._maybe_session_var_stmt(text)
         if handled is not None:
             return handled
+        if self._txn is None:
+            # exact-text fast path: a verbatim repeat SELECT skips even
+            # parse/bind and runs its cached prepared plan directly
+            from . import plancache
+
+            res = plancache.run_memoized(self.catalog, text)
+            if res is not None:
+                return res
         stmt = P.parse_statement(text)
         if isinstance(stmt, P.Select):
-            return self._select(stmt)
+            return self._select(stmt, text)
         if isinstance(stmt, (P.CreateTable, P.AlterTable, P.CreateIndex,
                              P.DropIndex)) and self._txn is not None:
             raise BindError(
@@ -326,9 +334,17 @@ class Session:
 
         return ctx()
 
-    def _select(self, stmt: P.Select):
+    def _select(self, stmt: P.Select, text: str | None = None):
         if self._txn is None:
-            return Binder(self.catalog).bind(stmt).run()
+            # the prepared-plan cache path: repeat statements (identical
+            # structure, any numeric literals — the pgwire extended
+            # protocol's Parse/Bind/Execute shape after literal inlining)
+            # rebind into a cached operator tree with zero new compiles
+            from . import plancache
+
+            res, _ = plancache.run_cached(
+                Binder(self.catalog).bind(stmt), text=text)
+            return res
         # in-txn SELECT: scans read at the txn snapshot, and every scanned
         # table's span lands in the txn's read set for commit-time refresh
         txn = self._txn
@@ -516,6 +532,7 @@ class Session:
                          if isinstance(tbl, KVTable)]:
                 del self.catalog.tables[name]
             load_catalog_from_engine(self.catalog, self.db)
+            self._invalidate_plans()
             return {"restored": m.group(1)}
         if _re.match(r"(?is)^show\s+tables$", t):
             import numpy as _np
@@ -551,6 +568,9 @@ class Session:
             tbl.set_stats(st)
             if isinstance(tbl, KVTable):
                 stats_mod.save_kv_stats(self.db, tbl.table_id, st)
+            # cached plans baked the OLD stats into kernel shapes
+            # (bit-packed sort keys, broadcast choices) — re-key them
+            self._invalidate_plans()
             return {"analyzed": name, "rows": st.row_count}
         m = _re.match(r"(?is)^show\s+statistics\s+for\s+table\s+"
                       r"([a-z0-9_]+)$", t)
@@ -648,6 +668,17 @@ class Session:
 
     # -- DDL -----------------------------------------------------------------
 
+    def _invalidate_plans(self) -> None:
+        """Schema-change barrier: bump the catalog version (re-keying every
+        cached plan), eagerly sweep the dead entries, and — when
+        ``sql.plan_cache.warmup.enabled`` — kick the background warmup
+        thread so hot statements recompile off the serving path."""
+        from . import plancache
+
+        self.catalog.bump_version()
+        plancache.cache_for(self.catalog).invalidate(self.catalog.version)
+        plancache.start_warmup(self)
+
     def _create_table(self, stmt: P.CreateTable):
         if stmt.name.startswith("__"):
             raise BindError(
@@ -685,6 +716,7 @@ class Session:
             id_range = (self.tenant.id_lo, self.tenant.id_hi)
         create_kv_table(self.catalog, self.db, stmt.name, schema,
                         pk=pks[0], id_range=id_range)
+        self._invalidate_plans()
         return {"created": stmt.name}
 
     def _alter_table(self, stmt: P.AlterTable):
@@ -702,6 +734,7 @@ class Session:
             raise BindError(
                 f"schema change failed: {done.error or done.state}"
             )
+        self._invalidate_plans()
         return {"altered": stmt.name, "job_id": done.job_id}
 
     def _create_index(self, stmt: P.CreateIndex):
@@ -722,6 +755,7 @@ class Session:
             raise BindError(
                 f"CREATE INDEX failed: {done.error or done.state}"
             )
+        self._invalidate_plans()
         return {"created_index": stmt.name, "job_id": done.job_id}
 
     def _drop_index(self, stmt: P.DropIndex):
@@ -729,6 +763,9 @@ class Session:
 
         t = self._kv_table(stmt.table)
         drop_index(self.catalog, self.db, t.name, stmt.name)
+        # a plan cached against the dropped index (IndexScan) must never
+        # serve again — the version bump re-keys it out of existence
+        self._invalidate_plans()
         return {"dropped_index": stmt.name}
 
     # -- DML -----------------------------------------------------------------

@@ -261,3 +261,24 @@ FUSED_PIPELINE_LENGTHS = DEFAULT.histogram(
     "operators collapsed into each FusedPipeline segment by the "
     "plan-build fusion pass (flow/fuse.py)",
     buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32))
+KERNEL_COMPILES = DEFAULT.counter(
+    "sql_kernel_compiles",
+    "new XLA traces/compiles issued through flow/dispatch.jit (each is a "
+    "fresh executable specialization; the zero-recompile serving path "
+    "holds this flat on repeat queries)")
+KERNEL_CACHE_HITS = DEFAULT.counter(
+    "sql_kernel_cache_hits",
+    "kernel constructions answered by the process-global dispatch.jit "
+    "key= cache (structurally identical kernels share one wrapper)")
+PLAN_CACHE_HITS = DEFAULT.counter(
+    "sql_plan_cache_hits",
+    "statements served by a cached prepared plan (build->fuse->compile "
+    "skipped; literals rebound into the cached operator tree)")
+PLAN_CACHE_MISSES = DEFAULT.counter(
+    "sql_plan_cache_misses",
+    "cacheable statements that built a fresh plan (first sight, schema "
+    "change, or settings change)")
+PLAN_CACHE_EVICTIONS = DEFAULT.counter(
+    "sql_plan_cache_evictions",
+    "prepared plans dropped by LRU capacity or catalog-version bumps "
+    "(DDL invalidation)")

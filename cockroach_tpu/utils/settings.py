@@ -360,3 +360,43 @@ READBACK_OVERLAP = register_bool(
     "readback tunnel with device work instead of serializing after it",
     metamorphic=True,
 )
+SHAPE_BUCKETS_ENABLED = register_bool(
+    "sql.distsql.shape_buckets.enabled", True,
+    "pad sub-tile resident tables up the canonical pow2 shape ladder "
+    "(catalog.SHAPE_BUCKETS: 1k/8k/64k/512k/2M) instead of to their own "
+    "1024-aligned cardinality, so kernels over small tables compile at a "
+    "handful of process-shared shapes; masks keep padded rows dead, so "
+    "results are bit-identical either way (tested)",
+    metamorphic=True,
+)
+PLAN_CACHE_ENABLED = register_bool(
+    "sql.plan_cache.enabled", True,
+    "serve repeat statements (same structure, any numeric literals) from "
+    "the prepared-plan LRU (sql/plancache.py): the cached operator tree "
+    "rebinds literals as jit arguments, so the second execution performs "
+    "zero new XLA compiles — the pgwire extended-protocol fast path",
+)
+PLAN_CACHE_SIZE = register_int(
+    "sql.plan_cache.size", 128,
+    "maximum prepared plans held by the per-catalog plan cache before "
+    "LRU eviction (each entry pins a built operator tree and its "
+    "compiled kernels)",
+    lo=1, hi=1 << 16,
+)
+COMPILE_CACHE_ENABLED = register_bool(
+    "sql.compile_cache.enabled", False,
+    "persist XLA compilations to disk (jax compilation cache, L3 of the "
+    "cache hierarchy) so process restarts reuse executables instead of "
+    "recompiling the fleet; directory from sql.compile_cache.dir",
+)
+COMPILE_CACHE_DIR = register_string(
+    "sql.compile_cache.dir", "",
+    "on-disk XLA compilation cache directory; empty uses "
+    "JAX_COMPILE_CACHE_DIR or <repo>/.jax_cache (utils/backend.py)",
+)
+PLAN_WARMUP_ENABLED = register_bool(
+    "sql.plan_cache.warmup.enabled", False,
+    "background warmup thread: speculatively re-trace hot cached plans "
+    "(by sqlstats fingerprint) off the serving path after DDL or process "
+    "start, so the first foreground execution finds warm kernels",
+)

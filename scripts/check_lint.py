@@ -1,8 +1,9 @@
 """crlint tree gate — the static-analysis suite must be clean at HEAD.
 
 Runs every crlint pass (cockroach_tpu/lint/: host-sync, raw-jit,
-broad-except, unused-import, lock-order) over the package and the
-scripts/ directory and fails on any unsuppressed finding. This is the
+broad-except, unused-import, lock-order) over the package, the
+scripts/ directory, and the tests/ tree and fails on any unsuppressed
+finding. This is the
 nogo/roachvet analog: the lint rules are only worth having if the tree
 is kept at zero findings, so the gate rides in tier-1 next to the
 settings and dispatch-budget audits. Pure AST pass — nothing is
@@ -35,7 +36,8 @@ def check(repo_root: str | pathlib.Path | None = None) -> list[str]:
         repo_root = pathlib.Path(__file__).resolve().parent.parent
     root = pathlib.Path(repo_root)
     return [f.render() for f in
-            run_lint([root / "cockroach_tpu", root / "scripts"])]
+            run_lint([root / "cockroach_tpu", root / "scripts",
+                      root / "tests"])]
 
 
 def main() -> int:
@@ -43,7 +45,8 @@ def main() -> int:
     for p in problems:
         print(f"FAIL: {p}", file=sys.stderr)
     if not problems:
-        print("crlint clean: all passes over cockroach_tpu/ and scripts/")
+        print("crlint clean: all passes over cockroach_tpu/, scripts/ "
+              "and tests/")
     return 1 if problems else 0
 
 

@@ -25,7 +25,6 @@ must match the expectation (the local/fakedist config pairing).
 from __future__ import annotations
 
 import os
-import re
 from dataclasses import dataclass, field
 
 
@@ -110,7 +109,6 @@ def _render(val, t: str) -> str:
 
 
 def _cells(res: dict, types: str, sort: str) -> list[str]:
-    import numpy as np
 
     names = list(res.keys())
     assert len(names) == len(types), (

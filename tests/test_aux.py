@@ -7,7 +7,7 @@ import pytest
 
 from cockroach_tpu.bench import queries as Q
 from cockroach_tpu.bench import tpch
-from cockroach_tpu.sql import explain, sql
+from cockroach_tpu.sql import explain
 from cockroach_tpu.utils import settings, tracing
 
 
@@ -159,7 +159,6 @@ def test_query_error_boundary(cat):
     """Engine/kernel failures surface as typed QueryError at the flow
     boundary, never a raw backend traceback (colexecerror/error.go:45);
     expected domain errors pass through unwrapped."""
-    import jax.numpy as jnp
 
     from cockroach_tpu.flow.runtime import run_operator
     from cockroach_tpu.plan import builder as plan_builder
@@ -216,7 +215,6 @@ def test_memory_accounting_drives_spills(cat):
     from cockroach_tpu.flow.runtime import run_operator
     from cockroach_tpu.plan import builder as plan_builder
     from cockroach_tpu.sql.rel import Rel
-    from cockroach_tpu.ops import expr as ex
 
     rel = Q.q3(cat)
     want = rel.run()

@@ -156,7 +156,7 @@ def test_random_expression_fuzz():
     import numpy as np
 
     from cockroach_tpu.bench import tpch
-    from cockroach_tpu.coldata.types import FLOAT64, Family
+    from cockroach_tpu.coldata.types import FLOAT64
     from cockroach_tpu.flow.runtime import run_operator
     from cockroach_tpu.ops import expr as ex
     from cockroach_tpu.plan import builder as plan_builder

@@ -391,7 +391,6 @@ def test_kvnemesis_with_ingest_and_limited_scans():
     """kvnemesis extension over the round-3 paths: bulk INGEST runs
     interleave with transactional RMWs and LIMITED scans (iterator seeks +
     pagination boundaries); every read must match a sequential dict model."""
-    from cockroach_tpu.storage.lsm import Engine as Eng
 
     db = DB(Engine(key_width=16, val_width=16, memtable_size=32),
             ManualClock())

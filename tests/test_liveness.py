@@ -6,7 +6,7 @@ one DB model nodes sharing the liveness range."""
 
 import pytest
 
-from cockroach_tpu.kv import DB, Clock
+from cockroach_tpu.kv import DB
 from cockroach_tpu.kv.hlc import ManualClock
 from cockroach_tpu.kv.liveness import (
     EpochFencedError,

@@ -7,7 +7,6 @@ checkpoint fails); gossip propagates a cluster setting between nodes."""
 
 import time
 
-import numpy as np
 import pytest
 
 from cockroach_tpu.kv import DB, Clock

@@ -7,7 +7,6 @@ import pytest
 import jax.numpy as jnp
 
 from cockroach_tpu.storage import mvcc
-from cockroach_tpu.storage import keys as K
 from cockroach_tpu.storage.pallas_scan import pallas_scan_filter
 
 

@@ -309,3 +309,35 @@ def test_placeholder_inside_string_literal_is_text(server):
         assert not any(t == b"E" for t, _ in msgs)
     finally:
         c.close()
+
+
+def test_pgwire_extended_rebind_rides_plan_cache():
+    """Parse-once/Bind-many through the extended protocol must hit the
+    prepared-plan cache on every rebind: the inlined literals reach
+    Session.execute, sql/plancache.py re-parameterizes them back out, and
+    the repeat serves with zero new XLA compiles."""
+    from cockroach_tpu.flow import dispatch
+    from cockroach_tpu.sql import plancache
+
+    sess = Session()
+    srv = PgServer(catalog=sess.catalog, db=sess.db).serve_background()
+    try:
+        c = MiniPgExt(srv.addr)
+        c.query("create table pc (id int primary key, v int)")
+        c.query("insert into pc values (1, 10), (2, 20), (3, 30)")
+        c.prepare("sel", "select v from pc where id = $1")
+        c.bind("", "sel", ["1"])
+        c.execute("")
+        c.sync()
+        cache = plancache.cache_for(sess.catalog)
+        h0, c0 = cache.hits, dispatch.compiles()
+        c.bind("", "sel", ["2"])
+        c.execute("")
+        msgs = c.sync()
+        rows = [b for t, b in msgs if t == b"D"]
+        assert len(rows) == 1 and rows[0].endswith(b"20")
+        assert cache.hits == h0 + 1
+        assert dispatch.compiles() == c0  # zero-recompile serving path
+        c.close()
+    finally:
+        srv.close()

@@ -1,0 +1,253 @@
+"""Prepared-plan cache + canonical-shape tests (sql/plancache.py).
+
+Covers the PR-6 acceptance sweep: shape bucketing must be bit-identical
+to the unbucketed engine across the fusion matrix, the plan cache must
+LRU-evict at its size cap, concurrent sessions must share one cache
+safely, and DDL invalidation must never serve a stale plan (the
+dropped-index case)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import cockroach_tpu.catalog as catalog_mod
+from cockroach_tpu import coldata as cd
+from cockroach_tpu.bench import queries as Q
+from cockroach_tpu.bench import tpcds, tpch
+from cockroach_tpu.kv import DB, ManualClock
+from cockroach_tpu.sql import Session, plancache
+from cockroach_tpu.storage import rowcodec
+from cockroach_tpu.storage.lsm import Engine
+from cockroach_tpu.utils import settings
+
+_FAST_TPCH = {"q1", "q3", "q6", "q9", "q18"}
+_FAST_TPCDS = {"q3", "q42"}
+
+
+# --------------------------------------------------------------------------
+# shape bucketing on/off bit-identity across the fusion matrix
+
+
+@pytest.fixture(scope="module")
+def hcat():
+    return tpch.gen_tpch(sf=0.005, seed=7)
+
+
+@pytest.fixture(scope="module")
+def dcat():
+    return tpcds.gen_tpcds(sf=0.01)
+
+
+def _run_bucketed(cat, rel, buckets: bool):
+    # the padded device image is pinned per table (__cap__), so a toggle
+    # needs the device cache dropped to take effect
+    for t in cat.tables.values():
+        t._device = None
+    settings.set("sql.distsql.fusion.enabled", True)
+    settings.set("sql.distsql.shape_buckets.enabled", buckets)
+    try:
+        return rel.run()
+    finally:
+        settings.reset("sql.distsql.fusion.enabled")
+        settings.reset("sql.distsql.shape_buckets.enabled")
+        for t in cat.tables.values():
+            t._device = None
+
+
+def _assert_identical(got, want):
+    assert set(got) == set(want)
+    for name in want:
+        g, w = np.asarray(got[name]), np.asarray(want[name])
+        assert g.shape == w.shape, name
+        if g.dtype == object or w.dtype == object:
+            assert list(g) == list(w), name
+        else:
+            # bit-identical, not allclose: padding must not leak into
+            # results (masked rows only)
+            np.testing.assert_array_equal(g, w, err_msg=name)
+
+
+@pytest.mark.parametrize(
+    "qname",
+    [pytest.param(q, marks=() if q in _FAST_TPCH else (pytest.mark.slow,))
+     for q in sorted(Q.QUERIES)],
+)
+def test_tpch_bucketing_equivalence(hcat, qname):
+    rel = Q.QUERIES[qname](hcat)
+    _assert_identical(_run_bucketed(hcat, rel, True),
+                      _run_bucketed(hcat, rel, False))
+
+
+@pytest.mark.parametrize(
+    "qname",
+    [pytest.param(q, marks=() if q in _FAST_TPCDS else (pytest.mark.slow,))
+     for q in sorted(tpcds.QUERIES)],
+)
+def test_tpcds_bucketing_equivalence(dcat, qname):
+    rel = tpcds.QUERIES[qname](dcat)
+    _assert_identical(_run_bucketed(dcat, rel, True),
+                      _run_bucketed(dcat, rel, False))
+
+
+# --------------------------------------------------------------------------
+# plan cache behavior through the Session
+
+
+SCHEMA = cd.Schema.of(id=cd.INT64, qty=cd.INT64, grp=cd.INT64)
+
+
+def _session(n=40):
+    db = DB(
+        Engine(key_width=24, val_width=rowcodec.value_width(SCHEMA) + 64,
+               memtable_size=256),
+        ManualClock(),
+    )
+    cat = catalog_mod.Catalog()
+    s = Session(catalog=cat, db=db)
+    s.execute("CREATE TABLE items (id INT PRIMARY KEY, qty INT, grp INT)")
+    for i in range(n):
+        s.execute(
+            f"INSERT INTO items VALUES ({i}, {i % 7}, {i % 3})")
+    return s
+
+
+def _cache(sess):
+    return plancache.cache_for(sess.catalog)
+
+
+def test_plan_cache_hit_and_memo():
+    s = _session()
+    c = _cache(s)
+    h0, m0 = c.hits, c.misses
+    r1 = s.execute("SELECT qty FROM items WHERE id = 7")
+    assert c.misses == m0 + 1
+    # different literal, same fingerprint: plan-cache hit, rebind only
+    r2 = s.execute("SELECT qty FROM items WHERE id = 8")
+    assert c.hits == h0 + 1
+    assert list(np.asarray(r1["qty"])) == [0]
+    assert list(np.asarray(r2["qty"])) == [1]
+    # verbatim repeat: the exact-text memo answers without a parse
+    r3 = s.execute("SELECT qty FROM items WHERE id = 8")
+    assert list(np.asarray(r3["qty"])) == list(np.asarray(r2["qty"]))
+
+
+def test_plan_cache_lru_eviction():
+    s = _session()
+    c = _cache(s)
+    c.clear()
+    settings.set("sql.plan_cache.size", 2)
+    try:
+        s.execute("SELECT qty FROM items WHERE id = 1")
+        s.execute("SELECT grp FROM items WHERE id = 1")
+        assert len(c) == 2
+        ev0 = c.evictions
+        s.execute("SELECT qty, grp FROM items WHERE id = 1")
+        assert len(c) == 2
+        assert c.evictions == ev0 + 1
+        # the first (least recently used) statement now misses again
+        m0 = c.misses
+        s.execute("SELECT qty FROM items WHERE id = 2")
+        assert c.misses == m0 + 1
+    finally:
+        settings.reset("sql.plan_cache.size")
+
+
+def test_plan_cache_concurrent_sessions():
+    s1 = _session()
+    s2 = Session(catalog=s1.catalog, db=s1.db, bootstrap=False)
+    errs = []
+    results = {}
+
+    def work(name, sess):
+        try:
+            out = []
+            for i in range(8):
+                r = sess.execute(f"SELECT qty FROM items WHERE grp = {i % 3}")
+                out.append(sorted(np.asarray(r["qty"]).tolist()))
+            results[name] = out
+        except Exception as e:  # pragma: no cover - surfaced via errs
+            errs.append(e)
+
+    ts = [threading.Thread(target=work, args=("a", s1)),
+          threading.Thread(target=work, args=("b", s2))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    assert results["a"] == results["b"]
+    # both sessions share ONE cache on the catalog
+    assert len(_cache(s1)) >= 1
+    assert _cache(s1) is _cache(s2)
+
+
+def test_plan_cache_sees_dml():
+    """A cached plan must serve rows written AFTER it was cached (the
+    operator tree re-snapshots the table on every run)."""
+    s = _session(n=5)
+    r1 = s.execute("SELECT qty FROM items WHERE grp = 0")
+    n1 = len(np.asarray(r1["qty"]))
+    s.execute("INSERT INTO items VALUES (100, 42, 0)")
+    r2 = s.execute("SELECT qty FROM items WHERE grp = 0")
+    got = sorted(np.asarray(r2["qty"]).tolist())
+    assert len(got) == n1 + 1
+    assert 42 in got
+
+
+def test_plan_cache_invalidated_by_ddl_and_never_serves_dropped_index():
+    s = _session()
+    c = _cache(s)
+    r_before = sorted(
+        np.asarray(s.execute(
+            "SELECT id FROM items WHERE qty = 3")["id"]).tolist())
+    v0 = s.catalog.version
+    s.execute("CREATE INDEX qty_idx ON items (qty)")
+    assert s.catalog.version > v0
+    assert len(c) == 0  # DDL evicts every cached plan
+    # plan through the index, then drop it: the cached index-scan plan
+    # must never serve again
+    r_idx = sorted(
+        np.asarray(s.execute(
+            "SELECT id FROM items WHERE qty = 3")["id"]).tolist())
+    assert r_idx == r_before
+    s.execute("DROP INDEX qty_idx ON items")
+    assert len(c) == 0
+    # a row inserted after the drop is invisible to the dropped index's
+    # frozen data — a stale plan would miss it
+    s.execute("INSERT INTO items VALUES (200, 3, 1)")
+    r_after = sorted(
+        np.asarray(s.execute(
+            "SELECT id FROM items WHERE qty = 3")["id"]).tolist())
+    assert r_after == sorted(r_before + [200])
+
+
+def test_plan_cache_disabled_setting():
+    s = _session()
+    c = _cache(s)
+    c.clear()
+    settings.set("sql.plan_cache.enabled", False)
+    try:
+        s.execute("SELECT qty FROM items WHERE id = 3")
+        assert len(c) == 0
+    finally:
+        settings.reset("sql.plan_cache.enabled")
+
+
+def test_warmup_thread_precompiles():
+    s = _session()
+    settings.set("sql.plan_cache.warmup.enabled", True)
+    try:
+        th = plancache.start_warmup(
+            s, statements=["SELECT qty FROM items WHERE id = 5"])
+        assert th is not None
+        th.join(timeout=120)
+        assert not th.is_alive()
+        from cockroach_tpu.flow import dispatch
+
+        c0 = dispatch.compiles()
+        r = s.execute("SELECT qty FROM items WHERE id = 6")
+        assert list(np.asarray(r["qty"])) == [6 % 7]
+        assert dispatch.compiles() == c0  # warmed entirely off-path
+    finally:
+        settings.reset("sql.plan_cache.warmup.enabled")

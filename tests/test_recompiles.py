@@ -1,0 +1,11 @@
+"""Tier-1 wiring for the zero-recompile serving-path guard
+(scripts/check_recompiles.py): cold compiles stay within recorded
+per-query budgets, adaptation settles in one run, and a warmed repeat
+with different literals triggers zero new XLA traces."""
+
+from scripts.check_recompiles import check
+
+
+def test_recompiles():
+    problems = check()
+    assert not problems, "\n".join(problems)

@@ -1,6 +1,5 @@
 """ALTER TABLE schema changes: backfill job, checkpointed resume, swap."""
 
-import numpy as np
 
 from cockroach_tpu.sql.session import Session
 

@@ -6,7 +6,7 @@ each shown to CHANGE PLANS when the statistics are perturbed."""
 import numpy as np
 
 import cockroach_tpu.catalog as catalog_mod
-from cockroach_tpu.coldata.types import INT64, STRING, Schema
+from cockroach_tpu.coldata.types import INT64, Schema
 from cockroach_tpu.sql import Session, sql
 from cockroach_tpu.sql import stats as stats_mod
 

@@ -3,7 +3,6 @@ mix (NewOrder/Payment/OrderStatus/Delivery/StockLevel) as multi-statement
 SQL transactions with the 3.3.2-style consistency invariants
 (reference: pkg/workload/tpcc + roachtest's tpcc check)."""
 
-import numpy as np
 import pytest
 
 from cockroach_tpu.bench import tpcc

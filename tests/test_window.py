@@ -179,7 +179,7 @@ def test_merge_join_matches_hash_join(cat):
 
 def test_merge_join_duplicates_and_types():
     import cockroach_tpu.catalog as catalog_mod
-    from cockroach_tpu.coldata.types import FLOAT64, INT64, Schema
+    from cockroach_tpu.coldata.types import INT64, Schema
 
     cat = catalog_mod.Catalog()
     cat.add(catalog_mod.Table.from_strings(
@@ -235,7 +235,6 @@ def test_merge_join_int64_extremes():
 def test_window_order_by_bytes_column():
     """ORDER BY over a BYTES (2-D) column: peers must compare all lanes
     (regression: _order_peers lacked the 2-D branch and crashed)."""
-    import jax.numpy as jnp
 
     from cockroach_tpu.coldata import batch as cb
     from cockroach_tpu.coldata.types import BYTES, INT64, Schema
