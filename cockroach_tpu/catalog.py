@@ -253,4 +253,14 @@ class Catalog:
         return table
 
     def get(self, name: str) -> Table:
-        return self.tables[name]
+        t = self.tables.get(name)
+        if t is None and name.startswith("crdb_internal."):
+            # virtual introspection tables materialize on read from the
+            # process registries (sql/crdb_internal.py); lazy import — the
+            # sql layer imports this module
+            from .sql import crdb_internal as _ci
+
+            return _ci.build(self, name)
+        if t is None:
+            return self.tables[name]  # KeyError with the usual shape
+        return t

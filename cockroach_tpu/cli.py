@@ -145,11 +145,34 @@ def hot_ranges_cmd(argv) -> int:
     return 0
 
 
+def debug_zip_cmd(argv) -> int:
+    """`cockroach_tpu.cli debug zip [out.zip] [--url]` — the `cockroach
+    debug zip` verb: pack metrics, settings, statement stats, hot ranges,
+    in-flight spans, and statement diagnostics bundles into one archive.
+    With --url the endpoints of a running node are pulled over HTTP;
+    without it the current process's registries are snapshotted."""
+    ap = argparse.ArgumentParser(prog="cockroach_tpu.cli debug zip")
+    ap.add_argument("output", nargs="?", default="debug.zip",
+                    help="archive path (default debug.zip)")
+    ap.add_argument("--url", default=None,
+                    help="admin API base URL of a running node; omitted "
+                         "collects from the current process")
+    args = ap.parse_args(argv)
+    from .server import debugzip
+
+    files = debugzip.collect(url=args.url)
+    path = debugzip.write_zip(args.output, files)
+    print(f"wrote {path} ({len(files)} files)")
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "hot-ranges":
         return hot_ranges_cmd(argv[1:])
+    if argv[:2] == ["debug", "zip"]:
+        return debug_zip_cmd(argv[2:])
     ap = argparse.ArgumentParser(prog="cockroach_tpu.cli",
                                  description=__doc__)
     ap.add_argument("-e", "--execute", action="append", default=[],

@@ -27,6 +27,7 @@ dictionaries travel with the data (colserde's RecordBatchSerializer role).
 from __future__ import annotations
 
 import io
+import json
 import socket
 import struct
 import threading
@@ -36,6 +37,7 @@ import pyarrow as pa
 from ..coldata import arrow as arrow_mod
 from ..coldata.batch import Batch, Dictionary
 from ..coldata.types import Schema
+from ..utils import tracing
 from .operator import Operator, SourceOperator
 
 _LEN = struct.Struct("<I")
@@ -106,12 +108,20 @@ class FlowInbox(SourceOperator):
     before pulling can pass the expected schema up front."""
 
     def __init__(self, sock: socket.socket, schema: Schema,
-                 dictionaries: dict[int, Dictionary] | None = None):
+                 dictionaries: dict[int, Dictionary] | None = None,
+                 expect_trace: bool = False):
         super().__init__()
         self.sock = sock
         self.output_schema = schema
         self.dictionaries = dict(dictionaries or {})
         self._done = False
+        # when the handshake carried a trace context the server appends
+        # its span recording as one JSON message AFTER the end-of-stream
+        # marker; graft it under the span that set the flow up (captured
+        # here — the inbox may be pulled from a puller thread whose
+        # context is empty)
+        self._expect_trace = expect_trace
+        self._trace_parent = tracing.current() if expect_trace else None
 
     def _next(self):
         if self._done:
@@ -119,6 +129,17 @@ class FlowInbox(SourceOperator):
         payload = _recv_msg(self.sock)
         if payload is None:
             self._done = True
+            if self._expect_trace:
+                try:
+                    trailer = _recv_msg(self.sock)
+                    if trailer:
+                        tracing.graft(
+                            json.loads(trailer.decode("utf-8")),
+                            into=self._trace_parent)
+                except (OSError, ConnectionError, ValueError):
+                    # trailer is best-effort: the data stream is already
+                    # complete, a lost recording must not fail the query
+                    trailer = None
             # a drained stream's socket is dead weight: close it HERE so
             # fd censuses don't depend on when the inbox gets collected
             try:
@@ -166,10 +187,29 @@ class FlowServer:
                 if msg is None:
                     continue
                 name = msg.decode("utf-8", errors="replace")
+                tctx = None
+                if name.startswith("{"):
+                    # JSON handshake (trace-carrying clients); a plain
+                    # flow name still works for legacy peers
+                    try:
+                        hello = json.loads(name)
+                        name = str(hello.get("flow", ""))
+                        tctx = hello.get("trace")
+                    except ValueError:
+                        tctx = None
                 make_op = self.flows.get(name)
                 if make_op is None:
                     continue
-                FlowOutbox(make_op(), conn).run()
+                with tracing.remote_span("flow/outbox", tctx,
+                                         flow=name) as osp:
+                    sent = FlowOutbox(make_op(), conn).run()
+                    if osp is not None:
+                        osp.add_tag("batches", sent)
+                if osp is not None:
+                    # ship the recording as one extra message after the
+                    # end-of-stream marker; the inbox grafts it
+                    _send_msg(conn,
+                              json.dumps(osp.to_dict()).encode("utf-8"))
             except Exception as e:  # crlint: allow-broad-except(accept loop survives any one connection/operator failure; logged below)
                 # operator/stream errors too: one connection's failure
                 # (including a flow whose operator raises mid-stream) must
@@ -192,5 +232,12 @@ def setup_remote_flow(addr, name: str, schema: Schema) -> FlowInbox:
     """Dial a FlowServer and return the Inbox for the named flow — the
     DistSQLPlanner.setupFlows remote half (distsql_running.go:391)."""
     sock = socket.create_connection(tuple(addr))
-    _send_msg(sock, name.encode("utf-8"))
-    return FlowInbox(sock, schema)
+    tctx = tracing.context()
+    if tctx is None:
+        _send_msg(sock, name.encode("utf-8"))
+        return FlowInbox(sock, schema)
+    # trace-carrying handshake: the server opens a remote span under our
+    # (trace_id, span_id) and ships its recording after the stream
+    _send_msg(sock, json.dumps(
+        {"flow": name, "trace": tctx}).encode("utf-8"))
+    return FlowInbox(sock, schema, expect_trace=True)

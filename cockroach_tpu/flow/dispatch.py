@@ -40,10 +40,11 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 
 import jax
 
-from ..utils import metric
+from ..utils import metric, tracing
 
 _lock = threading.Lock()
 _total = 0
@@ -138,7 +139,23 @@ def jit(fn=None, key=None, **jit_kwargs):
     @functools.wraps(fn)
     def counted(*args, **kwargs):
         note()
-        return jitted(*args, **kwargs)
+        sp = tracing.current()
+        if sp is None:
+            return jitted(*args, **kwargs)
+        # traced call: split wall time into compile (trace happened under
+        # this call) vs execute, folded into the enclosing span's tags so
+        # EXPLAIN ANALYZE (DEBUG) shows where dispatch time went
+        c0 = _compiles
+        t0 = time.perf_counter()
+        out = jitted(*args, **kwargs)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if _compiles > c0:
+            sp.inc_tag("jit_compiles", _compiles - c0)
+            sp.inc_tag("jit_compile_ms", round(dt_ms, 3))
+        else:
+            sp.inc_tag("jit_dispatches", 1)
+            sp.inc_tag("jit_dispatch_ms", round(dt_ms, 3))
+        return out
 
     counted._jitted = jitted  # uncounted handle (AOT lowering/inspection)
     counted._kernel_key = key

@@ -14,6 +14,8 @@ remote-attached TPU) overlaps compute instead of serializing after it.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..catalog import Catalog
@@ -88,6 +90,47 @@ class _ReadbackShrink:
         self._checks = []
 
 
+def _xla_profile_ctx():
+    """jax.profiler trace annotation for the query, gated behind
+    sql.trace.xla_profile — TPU rounds then show up as named regions in an
+    XLA profile linkable from the trace. Degrades to a no-op context when
+    the profiler is unavailable."""
+    from contextlib import nullcontext
+
+    from ..utils import settings
+
+    if not settings.get("sql.trace.xla_profile"):
+        return nullcontext()
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation("cockroach_tpu.query")
+    except Exception:  # crlint: allow-broad-except(profiler optional; query must run without it)
+        return nullcontext()
+
+
+def _fold_operator_spans(parent_span, op) -> None:
+    """Fold the operator tree's ComponentStats into synthetic child spans
+    (the execstats/traceanalyzer.go fold): inclusive wall time per
+    operator, nesting mirroring the operator tree, so the trace tree shows
+    where query latency went without per-tile span overhead in the pull
+    loop. Exclusive times telescope: summing (self - children) over the
+    whole subtree recovers the root operator's wall time."""
+    from ..utils import tracing
+
+    st = getattr(op, "stats", None)
+    if st is None:
+        child = parent_span
+    else:
+        child = tracing.synthetic_span(
+            parent_span, f"operator/{type(op).__name__}",
+            float(getattr(st, "time_s", 0.0) or 0.0),
+            rows=int(getattr(st, "rows", 0)),
+            batches=int(getattr(st, "batches", 0)))
+    for c in op.children():
+        _fold_operator_spans(child, c)
+
+
 def _post_run_updates(op) -> bool:
     """Give every operator its end-of-query adaptive update (deferred
     device-counter fetch — the ONE host sync speculative execution pays per
@@ -102,7 +145,7 @@ def _post_run_updates(op) -> bool:
 def run_operator(root) -> dict[str, np.ndarray]:
     import time
 
-    from ..utils import metric, settings
+    from ..utils import metric, settings, tracing
     from ..utils.errors import QueryError, _PASSTHROUGH
     from . import dispatch
 
@@ -116,40 +159,54 @@ def run_operator(root) -> dict[str, np.ndarray]:
         # shapes and validate their deferred counters after the pull; an
         # overflow (rare: first run after a data change) re-runs the query
         # with corrected capacities rather than paying a sync per tile
-        for attempt in range(4):
-            outs: list[dict[str, np.ndarray]] = []
-            shrink = _ReadbackShrink()
-            root.init()
-            if overlap:
-                # one-tile lag: materialize tile k (blocking host copy)
-                # while the root's async dispatches compute tile k+1
-                prev = None
-                while True:
-                    b = root.next_batch()
-                    if b is not None:
-                        b = shrink.shrink(b)
-                        _start_readback(b)
-                    if prev is not None:
-                        outs.append(to_host(prev, root.output_schema,
-                                            root.dictionaries))
-                    prev = b
-                    if b is None:
-                        break
+        with _xla_profile_ctx():
+            for attempt in range(4):
+                outs: list[dict[str, np.ndarray]] = []
+                shrink = _ReadbackShrink()
+                with tracing.leaf_span("flow/pull", attempt=attempt) as psp:
+                    root.init()
+                    if overlap:
+                        # one-tile lag: materialize tile k (blocking host
+                        # copy) while the root's async dispatches compute
+                        # tile k+1
+                        prev = None
+                        while True:
+                            b = root.next_batch()
+                            if b is not None:
+                                b = shrink.shrink(b)
+                                _start_readback(b)
+                            if prev is not None:
+                                r0 = time.perf_counter()
+                                outs.append(to_host(prev, root.output_schema,
+                                                    root.dictionaries))
+                                if psp is not None:
+                                    psp.inc_tag("readback_ms", round(
+                                        (time.perf_counter() - r0) * 1e3, 3))
+                            prev = b
+                            if b is None:
+                                break
+                    else:
+                        while True:
+                            b = root.next_batch()
+                            if b is None:
+                                break
+                            b = shrink.shrink(b)
+                            r0 = time.perf_counter()
+                            outs.append(to_host(b, root.output_schema,
+                                                root.dictionaries))
+                            if psp is not None:
+                                psp.inc_tag("readback_ms", round(
+                                    (time.perf_counter() - r0) * 1e3, 3))
+                    if psp is not None:
+                        psp.add_tag("tiles", len(outs))
+                if not _post_run_updates(root):
+                    shrink.finish(outs, root.output_schema,
+                                  root.dictionaries)
+                    break
             else:
-                while True:
-                    b = root.next_batch()
-                    if b is None:
-                        break
-                    b = shrink.shrink(b)
-                    outs.append(to_host(b, root.output_schema,
-                                        root.dictionaries))
-            if not _post_run_updates(root):
-                shrink.finish(outs, root.output_schema, root.dictionaries)
-                break
-        else:
-            raise RuntimeError(
-                "speculative emission capacities failed to converge"
-            )
+                raise RuntimeError(
+                    "speculative emission capacities failed to converge"
+                )
     except _PASSTHROUGH:
         raise
     except Exception as e:
@@ -187,7 +244,20 @@ def run_plan_with_stats(plan: PlanNode, catalog: Catalog):
     with tracing.span("query") as sp:
         res = run_operator(root)
         sp.record(root.stats)
+        _fold_operator_spans(sp, root)
+    root._trace_span = sp  # EXPLAIN ANALYZE renders the tree from here
+    _LAST_TRACE.span = sp
     return res, root
+
+
+_LAST_TRACE = threading.local()
+
+
+def last_trace_span():
+    """This thread's most recent run_plan_with_stats root span — EXPLAIN
+    ANALYZE (DEBUG) reads it for bundle capture after the rel API has
+    already discarded the root operator."""
+    return getattr(_LAST_TRACE, "span", None)
 
 
 def run_plan(plan: PlanNode, catalog: Catalog) -> dict[str, np.ndarray]:

@@ -26,7 +26,7 @@ import threading
 import uuid
 
 from ..storage.lsm import WriteIntentError
-from ..utils import locks
+from ..utils import locks, tracing
 from ..utils.errors import register_passthrough
 from ..utils.faults import InjectedFault
 from .liveness import EpochFencedError, NotLeaseHolderError
@@ -119,16 +119,23 @@ class BatchServer:
                 msg = _recv_msg(conn)
                 if msg is None:
                     return
+                ssp = None
                 try:
                     req = json.loads(msg.decode("utf-8"))
-                    resp = self._eval_batch(req)
-                    # post-apply response loss (the ambiguous-result
-                    # window): the batch IS applied, the client never
-                    # hears back. A `drop` here severs the stream; the
-                    # retry must hit the replay cache, not re-apply.
-                    from ..utils import faults
+                    # snowball half: the caller's (trace_id, span_id)
+                    # rides the envelope; the server-side span's finished
+                    # recording ships back on the response for grafting
+                    with tracing.remote_span(
+                            "kv/server.batch", req.get("trace"),
+                            ops=len(req.get("requests", ()))) as ssp:
+                        resp = self._eval_batch(req)
+                        # post-apply response loss (the ambiguous-result
+                        # window): the batch IS applied, the client never
+                        # hears back. A `drop` here severs the stream; the
+                        # retry must hit the replay cache, not re-apply.
+                        from ..utils import faults
 
-                    faults.fire("kv.rpc.server.respond")
+                        faults.fire("kv.rpc.server.respond")
                 except InjectedFault as e:
                     if e.kind == "drop":
                         raise  # sever the stream, like a crashed replica
@@ -147,6 +154,11 @@ class BatchServer:
                 except Exception as e:  # noqa: BLE001  # crlint: allow-broad-except(server loop converts the error to a wire response for the client)
                     resp = {"error": f"{type(e).__name__}: {e}",
                             "code": "Internal"}
+                if ssp is not None:
+                    # errored evals ship their recording too — the client
+                    # grafts BEFORE raising, so failed batches still show
+                    # in the trace
+                    resp["trace"] = ssp.to_dict()
                 _send_msg(conn, json.dumps(resp).encode("utf-8"))
         except (OSError, ConnectionError):
             pass  # client went away
@@ -166,7 +178,9 @@ class BatchServer:
         reqs = req.get("requests", ())
         if self.lease_check is not None and any(
                 r["op"] in _MUTATION_OPS for r in reqs):
-            self.lease_check(req)
+            with tracing.leaf_span("kv/lease_check",
+                                   range=req.get("range")):
+                self.lease_check(req)
         if req.get("cid") is not None and reqs and all(
                 r["op"] in _MUTATION_OPS for r in reqs):
             return self._eval_stamped_mutations(req)
@@ -330,66 +344,88 @@ class BatchClient:
             seq = next(self._seq)
             envelope["cid"] = self.cid
             envelope["seq"] = seq
+        # trace propagation: the current span's (trace_id, span_id) rides
+        # the envelope — built ONCE here so every transport retry carries
+        # the same parent and the server's recording grafts under it
+        tctx = tracing.context()
+        if tctx is not None:
+            envelope["trace"] = tctx
         payload = json.dumps(envelope).encode("utf-8")
 
-        def send_once():
-            with self._lock:  # one in-flight batch per connection
-                faults.fire("kv.rpc.client.batch")
-                try:
-                    _send_msg(self._sock, payload)
-                    msg = _recv_msg(self._sock)
-                except (socket.timeout, TimeoutError) as e:
-                    metric.RPC_TIMEOUTS.inc()
-                    # a timed-out stream has unknown framing state: the
-                    # next attempt MUST start on a fresh connection
-                    self._redial()
-                    raise retry.RPCDeadlineError(
-                        f"batch rpc deadline ({self.deadline_s}s) "
-                        f"exceeded against {self.addr}") from e
-                except (ConnectionError, OSError):
-                    self._redial()
-                    raise
-            if msg is None:
-                self._redial()
-                raise ConnectionError("batch server closed the stream")
-            return msg
+        with tracing.leaf_span(
+                "kv/batch", addr=f"{self.addr[0]}:{self.addr[1]}",
+                ops=len(requests)) as ksp:
+            attempts = 0
 
-        try:
-            msg = retry.call(
-                send_once,
-                retry.Backoff(max_attempts=self.max_retries,
-                              deadline_s=self.deadline_s * self.max_retries),
-                retryable=self._transport_error,
-            )
-        except Exception as e:
-            if stamped and self._transport_error(e):
-                # retries exhausted mid-mutation: the batch may or may
-                # not have applied, and nothing below can find out.
-                # Surface a typed ambiguity instead of letting a
-                # ConnectionError tempt an outer layer into re-sending
-                # under a FRESH seq (which WOULD double-apply).
-                metric.AMBIGUOUS_RESULTS.inc()
-                raise AmbiguousResultError(
-                    f"mutation batch (cid={self.cid}, seq={seq}) against "
-                    f"{self.addr}: transport failed after "
-                    f"{self.max_retries} attempts; apply state unknown",
-                    cid=self.cid, seq=seq) from e
-            raise
-        resp = json.loads(msg.decode("utf-8"))
-        if "error" in resp:
-            code = resp.get("code")
-            if code == "WriteIntentError":
-                raise WriteIntentError(
-                    [_unb64(k) for k in resp.get("keys", [])],
-                    resp.get("txns", []),
+            def send_once():
+                nonlocal attempts
+                attempts += 1
+                with self._lock:  # one in-flight batch per connection
+                    faults.fire("kv.rpc.client.batch")
+                    try:
+                        _send_msg(self._sock, payload)
+                        msg = _recv_msg(self._sock)
+                    except (socket.timeout, TimeoutError) as e:
+                        metric.RPC_TIMEOUTS.inc()
+                        # a timed-out stream has unknown framing state:
+                        # the next attempt MUST start on a fresh
+                        # connection
+                        self._redial()
+                        raise retry.RPCDeadlineError(
+                            f"batch rpc deadline ({self.deadline_s}s) "
+                            f"exceeded against {self.addr}") from e
+                    except (ConnectionError, OSError):
+                        self._redial()
+                        raise
+                if msg is None:
+                    self._redial()
+                    raise ConnectionError("batch server closed the stream")
+                return msg
+
+            try:
+                msg = retry.call(
+                    send_once,
+                    retry.Backoff(
+                        max_attempts=self.max_retries,
+                        deadline_s=self.deadline_s * self.max_retries),
+                    retryable=self._transport_error,
                 )
-            if code == "EpochFencedError":
-                raise EpochFencedError(resp["error"])
-            if code == "NotLeaseHolderError":
-                raise NotLeaseHolderError(
-                    resp["error"], holder=resp.get("holder"))
-            raise RuntimeError(f"batch rpc failed: {resp['error']}")
-        return resp["responses"]
+            except Exception as e:
+                if ksp is not None:
+                    ksp.add_tag("attempts", attempts)
+                if stamped and self._transport_error(e):
+                    # retries exhausted mid-mutation: the batch may or may
+                    # not have applied, and nothing below can find out.
+                    # Surface a typed ambiguity instead of letting a
+                    # ConnectionError tempt an outer layer into re-sending
+                    # under a FRESH seq (which WOULD double-apply).
+                    metric.AMBIGUOUS_RESULTS.inc()
+                    raise AmbiguousResultError(
+                        f"mutation batch (cid={self.cid}, seq={seq}) "
+                        f"against {self.addr}: transport failed after "
+                        f"{self.max_retries} attempts; apply state "
+                        f"unknown", cid=self.cid, seq=seq) from e
+                raise
+            if ksp is not None:
+                ksp.add_tag("attempts", attempts)
+            resp = json.loads(msg.decode("utf-8"))
+            # graft the server-side recording BEFORE the typed raises so
+            # failed evals still land in the caller's trace
+            tracing.graft(resp.pop("trace", None))
+            if "error" in resp:
+                code = resp.get("code")
+                if code == "WriteIntentError":
+                    raise WriteIntentError(
+                        [_unb64(k) for k in resp.get("keys", [])],
+                        resp.get("txns", []),
+                    )
+                if code == "EpochFencedError":
+                    raise EpochFencedError(resp["error"])
+                if code == "NotLeaseHolderError":
+                    raise NotLeaseHolderError(
+                        resp["error"], holder=resp.get("holder"))
+                raise RuntimeError(f"batch rpc failed: {resp['error']}")
+            return resp["responses"]
 
     # convenience single-op wrappers (the kv.DB surface over RPC)
     def put(self, key: bytes, value: bytes) -> int:

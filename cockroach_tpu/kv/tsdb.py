@@ -89,3 +89,19 @@ class TimeSeriesDB:
         for k, _ in rows:
             self.db.delete(k)
         return len(rows)
+
+    def prune_all(self, keep_after_ms: int) -> int:
+        """Retention sweep over EVERY series: drop samples below the
+        cutoff. The background scraper (server/node.py _metrics_loop)
+        calls this on a paced ticker driven by ``ts.retention_seconds``,
+        so the timeseries keyspace stays bounded on long-lived nodes."""
+        n = 0
+        for k, _ in self.db.scan(_PREFIX, _PREFIX + b"\xff"):
+            try:
+                wall = int(k[-13:])  # key tail: "|<13-digit millis>"
+            except ValueError:
+                continue
+            if wall < keep_after_ms:
+                self.db.delete(k)
+                n += 1
+        return n

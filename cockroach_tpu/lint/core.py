@@ -148,19 +148,21 @@ def attr_chain(node: ast.AST) -> tuple[str, ...] | None:
 
 def _rules():
     # late import: the rule modules import core for helpers
-    from . import errdiscipline, hostsync, lockorder, rawjit, unusedimport
+    from . import (errdiscipline, hostsync, lockorder, rawjit, tracingapi,
+                   unusedimport)
     per_file = {
         "host-sync": hostsync.check,
         "raw-jit": rawjit.check,
         "broad-except": errdiscipline.check,
         "unused-import": unusedimport.check,
+        "tracing-api": tracingapi.check,
     }
     tree = {"lock-order": lockorder.check}
     return per_file, tree
 
 
 ALL_RULES = ("host-sync", "raw-jit", "broad-except", "unused-import",
-             "lock-order")
+             "lock-order", "tracing-api")
 
 
 def run_lint(paths: list[str | pathlib.Path],

@@ -136,7 +136,15 @@ def explain_analyze(plan: S.PlanNode, root_op) -> str:
             walk(c, co, depth + 1)
 
     walk(plan, root_op, 0)
-    # trailing so the tree keeps its root on line 1 (consumers parse that)
+    # span tree from the traced run (flow/runtime.py attaches it): operator
+    # wall times plus the seams ComponentStats cannot see (pull attempts,
+    # readback, KV round-trips grafted from remote nodes); the plan tree
+    # keeps its root on line 1 and the dispatch footer its last two lines
+    # (consumers parse both)
+    tsp = getattr(root_op, "_trace_span", None)
+    if tsp is not None:
+        lines.append("trace:")
+        lines.append(tsp.tree(indent=1))
     kd = getattr(getattr(root_op, "stats", None), "kernel_dispatches", 0)
     if kd:
         lines.append(f"kernel dispatches: {kd}")

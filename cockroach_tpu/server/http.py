@@ -146,6 +146,38 @@ class AdminServer:
 
         return {"statements": sqlstats.DEFAULT.rows_payload()}
 
+    def vars(self) -> str:
+        """Prometheus text exposition (/_status/vars body)."""
+        return metric.DEFAULT.scrape()
+
+    def contention(self) -> dict:
+        from ..kv.contention import DEFAULT as _cont
+
+        return {"events": _cont.rows_payload()}
+
+    def diagnostics(self) -> dict:
+        """Statement diagnostics ring listing (newest first)."""
+        from ..sql import diagnostics as diag
+
+        return {"bundles": diag.bundles()}
+
+    def diagnostics_bundle(self, bundle_id: int) -> dict | None:
+        from ..sql import diagnostics as diag
+
+        return diag.get(bundle_id)
+
+    def spans(self) -> dict:
+        """In-flight trace spans (crdb_internal.node_inflight_trace_spans
+        over HTTP): everything started but not yet finished, oldest first."""
+        from ..utils import tracing
+
+        return {"spans": [
+            {"traceId": s.trace_id, "spanId": s.span_id,
+             "parentSpanId": s.parent_id, "operation": s.name,
+             "startWallMs": int(s.start_wall * 1e3)}
+            for s in tracing.inflight()
+        ]}
+
     def settings_payload(self) -> dict:
         return {"settings": {
             name: s.get() for name, s in settings.all_settings().items()
@@ -208,7 +240,7 @@ class AdminServer:
                     elif u.path in ("/health", "/healthz"):
                         self._json(admin.health())
                     elif u.path == "/_status/vars":
-                        self._reply(200, metric.DEFAULT.scrape().encode(),
+                        self._reply(200, admin.vars().encode(),
                                     "text/plain; version=0.0.4")
                     elif u.path == "/_status/nodes":
                         self._json(admin.nodes())
@@ -221,9 +253,21 @@ class AdminServer:
                     elif u.path in ("/hot_ranges", "/_status/hot_ranges"):
                         self._json(admin.hot_ranges())
                     elif u.path == "/_status/contention":
-                        from ..kv.contention import DEFAULT as _cont
-
-                        self._json({"events": _cont.rows_payload()})
+                        self._json(admin.contention())
+                    elif u.path == "/_status/diagnostics":
+                        q = parse_qs(u.query)
+                        bid = (q.get("id") or [""])[0]
+                        if bid:
+                            full = admin.diagnostics_bundle(int(bid))
+                            if full is None:
+                                self._json({"error": f"no bundle {bid}"},
+                                           404)
+                            else:
+                                self._json(full)
+                        else:
+                            self._json(admin.diagnostics())
+                    elif u.path == "/_status/spans":
+                        self._json(admin.spans())
                     elif u.path == "/ts/query":
                         q = parse_qs(u.query)
                         name = (q.get("name") or [""])[0]

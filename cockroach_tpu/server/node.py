@@ -43,7 +43,7 @@ class Node:
         engine: Engine | None = None,
         heartbeat_interval_s: float = 0.2,
         ttl_ms: int = 1000,
-        metrics_interval_s: float = 0.5,
+        metrics_interval_s: float | None = 0.5,
         adopt_interval_s: float = 0.5,
         gossip_peers: list | None = None,
         lease_ranges: list[int] | None = None,
@@ -371,9 +371,27 @@ class Node:
                     self._advertised_leases[rid] = ad
 
     def _metrics_loop(self) -> None:
-        while not self._stop.wait(self._metrics_interval):
+        import time as _time
+
+        from ..kv import hlc
+
+        last_prune = _time.monotonic()
+        while True:
+            # constructor interval wins when given; otherwise the live
+            # cluster setting paces the scraper (SET takes effect next tick)
+            iv = (self._metrics_interval if self._metrics_interval is not None
+                  else settings.get("ts.scrape_interval_seconds"))
+            if self._stop.wait(iv):
+                return
             try:
                 self.tsdb.record(metric.DEFAULT)
+                retention = settings.get("ts.retention_seconds")
+                # prune at ~1/10 the scrape cadence: a retention trim scans
+                # the whole ts keyspace, too heavy for per-tick work
+                if retention and _time.monotonic() - last_prune >= iv * 10:
+                    wall, _ = hlc.unpack(self.db.clock.now())
+                    self.tsdb.prune_all(wall - int(retention * 1e3))
+                    last_prune = _time.monotonic()
             except Exception as e:  # metric write must never kill the node  # crlint: allow-broad-except(metric write must never kill the node; logged)
                 log.warning(log.OPS, "tsdb poll failed", error=str(e))
 

@@ -8,12 +8,15 @@ from .session import Session
 
 
 def explain(catalog, text: str) -> str:
-    """EXPLAIN / EXPLAIN ANALYZE / EXPLAIN (DISTSQL) over SQL text. Accepts
-    the statement with or without the leading EXPLAIN keywords."""
+    """EXPLAIN / EXPLAIN ANALYZE [(DEBUG)] / EXPLAIN (DISTSQL) over SQL text.
+    Accepts the statement with or without the leading EXPLAIN keywords.
+    ANALYZE (DEBUG) additionally captures a statement diagnostics bundle
+    (sql/diagnostics.py) and reports its id."""
     t = text.strip()
     low = t.lower()
     analyze = False
     distsql = False
+    debug = False
     if low.startswith("explain"):
         t = t[len("explain"):].lstrip()
         if t.lower().startswith("(distsql)"):
@@ -22,16 +25,34 @@ def explain(catalog, text: str) -> str:
         if t.lower().startswith("analyze"):
             analyze = True
             t = t[len("analyze"):].lstrip()
+            if t.lower().startswith("(debug)"):
+                debug = True
+                t = t[len("(debug)"):].lstrip()
     rel = sql(catalog, t)
     if distsql:
         return rel.explain_distributed()
     if analyze:
+        import time as _time
+        from types import SimpleNamespace
+
         from . import plancache
 
+        t0 = _time.perf_counter()
         rendered, _ = rel.explain_analyze()
+        elapsed = _time.perf_counter() - t0
         # status a NORMAL execution of this statement would see (analyze
         # itself always runs a fresh instrumented tree)
-        return rendered + f"\nplan cache: {plancache.probe(rel)}"
+        out = rendered + f"\nplan cache: {plancache.probe(rel)}"
+        if debug:
+            from . import diagnostics
+            from ..flow.runtime import last_trace_span
+
+            bundle = diagnostics.capture(
+                SimpleNamespace(catalog=catalog), t, elapsed_s=elapsed,
+                span=last_trace_span(), trigger="explain_analyze_debug",
+            )
+            out += f"\ndiagnostics bundle: {bundle['id']}"
+        return out
     return rel.explain()
 
 

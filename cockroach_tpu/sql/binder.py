@@ -115,6 +115,11 @@ _STMT_NOW_US: list[int | None] = [None]
 
 def begin_statement() -> None:
     _STMT_NOW_US[0] = None
+    # fresh snapshots for crdb_internal virtual tables: bind-time and
+    # build-time materializations within THIS statement stay identical
+    from . import crdb_internal
+
+    crdb_internal.bump_generation()
 
 
 def _statement_now_us() -> int:

@@ -1,0 +1,222 @@
+"""crdb_internal virtual tables — the pkg/sql/crdb_internal.go reduction.
+
+Reference: crdb_internal is a schema of virtual tables materialized on
+read (crdb_internal.go:1346 node_statement_statistics, :1588
+cluster_queries/cluster_sessions, :1745 node_metrics, :6090 hot_ranges);
+every read reflects live registries, nothing is stored.
+
+Here the catalog resolves any unknown ``crdb_internal.<name>`` through
+:func:`build`, which materializes a plain :class:`~..catalog.Table` from
+the process registries (sqlstats, activity, metric, tracing, range meta).
+The binder and the plan builder each resolve the table once per
+statement, so materializations are generation-cached: both resolutions
+within one statement see the SAME Table object (string dictionary codes
+must match between bind-time schema inference and build-time scan).
+``begin_statement`` bumps the generation, so every statement gets a fresh
+snapshot.
+
+The plan cache never caches plans over these tables (sql/plancache.py
+treats the prefix as volatile) — a cached snapshot would freeze time.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..catalog import Table
+from ..coldata import types as T
+
+PREFIX = "crdb_internal."
+
+_gen = 0
+# (id(catalog), table name) -> (generation, materialized Table)
+_cache: dict[tuple[int, str], tuple[int, Table]] = {}
+
+
+def bump_generation() -> None:
+    """New statement: drop cached materializations so the next read sees
+    a fresh snapshot (called from binder.begin_statement)."""
+    global _gen
+    _gen += 1
+    _cache.clear()
+
+
+def _table(name: str, cols: list[tuple[str, object, np.ndarray]]) -> Table:
+    names = tuple(c[0] for c in cols)
+    types = tuple(c[1] for c in cols)
+    raw = {c[0]: c[2] for c in cols}
+    return Table.from_strings(name, T.Schema(names, types), raw)
+
+
+def _strs(vals) -> np.ndarray:
+    return np.array([str(v) for v in vals], dtype=object)
+
+
+def _ints(vals) -> np.ndarray:
+    return np.array([int(v) for v in vals], dtype=np.int64)
+
+
+def _floats(vals) -> np.ndarray:
+    return np.array([float(v) for v in vals], dtype=np.float64)
+
+
+def _stmt_statistics(catalog) -> Table:
+    from . import sqlstats
+
+    rows = sqlstats.DEFAULT.all()
+    return _table("crdb_internal.node_statement_statistics", [
+        ("fingerprint", T.STRING, _strs(r.fingerprint for r in rows)),
+        ("count", T.INT64, _ints(r.count for r in rows)),
+        ("mean_ms", T.FLOAT64, _floats(r.mean_s * 1e3 for r in rows)),
+        ("max_ms", T.FLOAT64, _floats(r.max_s * 1e3 for r in rows)),
+        ("p50_ms", T.FLOAT64,
+         _floats(r.percentile(0.50) * 1e3 for r in rows)),
+        ("p99_ms", T.FLOAT64,
+         _floats(r.percentile(0.99) * 1e3 for r in rows)),
+        ("rows_returned", T.INT64, _ints(r.rows for r in rows)),
+        ("errors", T.INT64, _ints(r.errors for r in rows)),
+    ])
+
+
+def _cluster_queries(catalog) -> Table:
+    from . import activity
+
+    rows = activity.queries()
+    return _table("crdb_internal.cluster_queries", [
+        ("query_id", T.INT64, _ints(r["id"] for r in rows)),
+        ("session_id", T.INT64, _ints(r["session_id"] for r in rows)),
+        ("query", T.STRING, _strs(r["query"] for r in rows)),
+        ("phase", T.STRING, _strs(r["phase"] for r in rows)),
+        ("elapsed_ms", T.FLOAT64,
+         _floats(r["elapsed_s"] * 1e3 for r in rows)),
+    ])
+
+
+def _cluster_sessions(catalog) -> Table:
+    from . import activity
+
+    rows = activity.sessions()
+    return _table("crdb_internal.cluster_sessions", [
+        ("session_id", T.INT64, _ints(r["id"] for r in rows)),
+        ("application_name", T.STRING,
+         _strs(r["application_name"] for r in rows)),
+        ("active_queries", T.INT64, _ints(r["active"] for r in rows)),
+        ("session_age_s", T.FLOAT64,
+         _floats(r["session_age_s"] for r in rows)),
+    ])
+
+
+def _node_metrics(catalog) -> Table:
+    from ..utils import metric
+
+    names: list[str] = []
+    values: list[float] = []
+    for name, m in list(metric.DEFAULT._metrics.items()):
+        if isinstance(m, (metric.Counter, metric.Gauge)):
+            names.append(name)
+            values.append(m.value)
+        elif isinstance(m, metric.Histogram):
+            names.append(name + "_sum")
+            values.append(m.sum)
+            names.append(name + "_count")
+            values.append(float(m.n))
+        elif isinstance(m, metric.LabeledCounter):
+            for k, v in m.items():
+                names.append(f'{name}{{{m.label}="{k}"}}')
+                values.append(v)
+    return _table("crdb_internal.node_metrics", [
+        ("name", T.STRING, _strs(names)),
+        ("value", T.FLOAT64, _floats(values)),
+    ])
+
+
+def _inflight_trace_spans(catalog) -> Table:
+    from ..utils import tracing
+
+    spans = tracing.inflight()
+    now = time.perf_counter()
+    return _table("crdb_internal.node_inflight_trace_spans", [
+        ("trace_id", T.INT64, _ints(s.trace_id for s in spans)),
+        ("span_id", T.INT64, _ints(s.span_id for s in spans)),
+        ("parent_span_id", T.INT64, _ints(s.parent_id for s in spans)),
+        ("operation", T.STRING, _strs(s.name for s in spans)),
+        ("elapsed_ms", T.FLOAT64,
+         _floats((now - s.start) * 1e3 for s in spans)),
+    ])
+
+
+def _hot_ranges_payload(catalog) -> list[dict]:
+    """The /_status/hot_ranges row shape, sourced from whatever range
+    infrastructure the session's environment carries: a stashed Node's
+    RangeLifecycle, else the engine's meta descriptor table, else empty
+    (single-range standalone sessions legitimately have no ranges)."""
+    node = getattr(catalog, "_crdb_node", None)
+    ranger = getattr(node, "ranger", None) if node is not None else None
+    if ranger is not None:
+        return ranger.hot_ranges().get("hotRanges", [])
+    db = getattr(catalog, "_crdb_db", None)
+    eng = getattr(db, "engine", None) if db is not None else None
+    meta = getattr(eng, "meta", None) if eng is not None else None
+    if meta is None:
+        return []
+    return [{"rangeId": d.range_id,
+             "startKey": d.start_key.decode(errors="replace"),
+             "endKey": (d.end_key.decode(errors="replace")
+                       if d.end_key is not None else None),
+             "storeId": d.store_id, "qps": 0.0, "writeBytesRate": 0.0,
+             "sizeBytes": None, "leaseholder": None}
+            for d in meta.snapshot()]
+
+
+def _hot_ranges(catalog) -> Table:
+    rows = _hot_ranges_payload(catalog)
+    return _table("crdb_internal.hot_ranges", [
+        ("range_id", T.INT64, _ints(r.get("rangeId", 0) for r in rows)),
+        ("start_key", T.STRING, _strs(r.get("startKey", "") for r in rows)),
+        ("end_key", T.STRING,
+         _strs(r.get("endKey") or "" for r in rows)),
+        ("store_id", T.INT64, _ints(r.get("storeId") or 0 for r in rows)),
+        ("qps", T.FLOAT64, _floats(r.get("qps") or 0.0 for r in rows)),
+        ("write_bytes_rate", T.FLOAT64,
+         _floats(r.get("writeBytesRate") or 0.0 for r in rows)),
+        ("size_bytes", T.INT64,
+         _ints(r.get("sizeBytes") or 0 for r in rows)),
+        ("leaseholder", T.INT64,
+         _ints(r.get("leaseholder") or 0 for r in rows)),
+    ])
+
+
+_BUILDERS = {
+    "crdb_internal.node_statement_statistics": _stmt_statistics,
+    "crdb_internal.cluster_queries": _cluster_queries,
+    "crdb_internal.cluster_sessions": _cluster_sessions,
+    "crdb_internal.node_metrics": _node_metrics,
+    "crdb_internal.node_inflight_trace_spans": _inflight_trace_spans,
+    "crdb_internal.hot_ranges": _hot_ranges,
+}
+
+
+def table_names() -> tuple[str, ...]:
+    return tuple(sorted(_BUILDERS))
+
+
+def is_virtual(name: str) -> bool:
+    return name.startswith(PREFIX)
+
+
+def build(catalog, name: str) -> Table:
+    """Materialize (or return this statement's cached materialization of)
+    one virtual table. Raises KeyError for unknown names — the binder
+    surfaces that as its usual unknown-table error."""
+    builder = _BUILDERS.get(name)
+    if builder is None:
+        raise KeyError(name)
+    key = (id(catalog), name)
+    hit = _cache.get(key)
+    if hit is not None and hit[0] == _gen:
+        return hit[1]
+    t = builder(catalog)
+    _cache[key] = (_gen, t)
+    return t

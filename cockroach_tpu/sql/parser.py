@@ -762,6 +762,10 @@ class Parser:
             alias = self.next().value
             return SubqueryRef(sub, alias)
         name = self.next().value
+        # dotted names (crdb_internal.node_metrics): the qualified name is
+        # one catalog key — no schema resolution layer in this build
+        while self.eat_op("."):
+            name += "." + self.next().value
         alias = None
         if self.eat_kw("as"):
             alias = self.next().value

@@ -45,7 +45,7 @@ from ..coldata.types import Family
 from ..ops import expr as ex
 from ..plan import builder as plan_builder
 from ..plan import spec as S
-from ..utils import metric, settings
+from ..utils import metric, settings, tracing
 
 # literal families rewritten into Param slots: everything whose device
 # representation is a plain numeric scalar. STRING stays literal (string
@@ -383,27 +383,47 @@ def _cacheable() -> bool:
 _VOLATILE = ("now(", "current_date", "current_timestamp")
 
 
+def _is_virtual_plan(plan) -> bool:
+    from . import crdb_internal
+
+    return any(crdb_internal.is_virtual(n) for n in _table_names(plan))
+
+
 def run_cached(rel, text: str | None = None):
+    """Execute a bound Rel through the plan cache; see
+    :func:`run_cached_ex` (this keeps the original 2-tuple shape)."""
+    res, status, _ = run_cached_ex(rel, text)
+    return res, status
+
+
+def run_cached_ex(rel, text: str | None = None):
     """Execute a bound Rel through the plan cache.
 
-    Returns ``(results, status)`` with status one of ``hit`` (literals
-    rebound into a cached tree, zero new builds), ``miss`` (built fresh
-    and cached), ``uncacheable`` (no stable key), ``bypass`` (cache off
-    or stats collection on)."""
+    Returns ``(results, status, fingerprint)`` with status one of ``hit``
+    (literals rebound into a cached tree, zero new builds), ``miss``
+    (built fresh and cached), ``uncacheable`` (no stable key), ``bypass``
+    (cache off, stats collection on, or crdb_internal virtual tables —
+    those materialize fresh per statement, so a cached plan would freeze
+    a snapshot). ``fingerprint`` is the serving entry's structural
+    fingerprint (the first text that built it — sqlstats uses it so
+    literal variants collapse to one row), or '' when no entry served."""
     from ..flow import runtime
 
     if not _cacheable():
-        return rel.run(), "bypass"
+        return rel.run(), "bypass", ""
     maybe_enable_compile_cache()
     cache = cache_for(rel.catalog)
     plan = rel.optimized_plan()
+    if _is_virtual_plan(plan):
+        return runtime.run_plan(plan, rel.catalog), "bypass", ""
     try:
-        pplan, values, types = parameterize(plan)
-        key = (plan_key(pplan), rel.catalog.version, _settings_sig(),
-               _dict_gen(rel.catalog, pplan))
+        with tracing.leaf_span("sql.plancache.lookup"):
+            pplan, values, types = parameterize(plan)
+            key = (plan_key(pplan), rel.catalog.version, _settings_sig(),
+                   _dict_gen(rel.catalog, pplan))
+            entry = cache.lookup(key)
     except _Unkeyable:
-        return runtime.run_plan(plan, rel.catalog), "uncacheable"
-    entry = cache.lookup(key)
+        return runtime.run_plan(plan, rel.catalog), "uncacheable", ""
     status = "hit"
     if entry is None:
         status = "miss"
@@ -416,12 +436,14 @@ def run_cached(rel, text: str | None = None):
         # insert keeps whichever published first)
         with entry.lock:
             entry.store.set_values(values)
-            res = runtime.run_operator(entry.root)
+            with tracing.leaf_span("query", cache="miss"):
+                res = runtime.run_operator(entry.root)
         entry = cache.insert(key, entry)
     else:
         with entry.lock:
             entry.store.set_values(values)
-            res = runtime.run_operator(entry.root)
+            with tracing.leaf_span("query", cache="hit"):
+                res = runtime.run_operator(entry.root)
     if text is not None:
         if entry.fingerprint:
             cache.note_text(entry.fingerprint, text)
@@ -430,14 +452,21 @@ def run_cached(rel, text: str | None = None):
             # verbatim repeats can skip parse/bind next time; statements
             # with per-bind folded volatiles (now()) must re-bind
             cache.memo_put(text, key, values, tuple(_table_names(pplan)))
-    return res, status
+    return res, status, entry.fingerprint
 
 
 def run_memoized(catalog, text: str):
+    """Exact-text fast path; see :func:`run_memoized_ex` (this keeps the
+    original results-or-None shape)."""
+    m = run_memoized_ex(catalog, text)
+    return None if m is None else m[0]
+
+
+def run_memoized_ex(catalog, text: str):
     """Exact-text fast path: if this verbatim statement ran before and
     its entry is still live (same catalog version + settings), execute it
-    without parsing or binding. Returns results or None (fall through to
-    the normal path)."""
+    without parsing or binding. Returns (results, entry fingerprint) or
+    None (fall through to the normal path)."""
     from ..flow import runtime
 
     if not _cacheable():
@@ -458,7 +487,8 @@ def run_memoized(catalog, text: str):
         return None
     with entry.lock:
         entry.store.set_values(values)
-        return runtime.run_operator(entry.root)
+        with tracing.leaf_span("query", cache="memo"):
+            return runtime.run_operator(entry.root), entry.fingerprint
 
 
 def probe(rel) -> str:
@@ -467,6 +497,8 @@ def probe(rel) -> str:
     runs the instrumented fresh tree)."""
     if not settings.get("sql.plan_cache.enabled"):
         return "disabled"
+    if _is_virtual_plan(rel.optimized_plan()):
+        return "uncacheable"
     try:
         pplan, _, _ = parameterize(rel.optimized_plan())
         key = (plan_key(pplan), rel.catalog.version, _settings_sig(),

@@ -122,6 +122,27 @@ class Session:
         # Aborted (_txn_aborted — only ROLLBACK/COMMIT leave it)
         self._txn = None
         self._txn_aborted = False
+        # observability plumbing: the live-session registry entry, plus
+        # the handles crdb_internal builders reach through the catalog
+        from . import activity
+
+        self._session_id = activity.register_session()
+        self._active_qid = None
+        self._last_fp = None
+        self.catalog._crdb_db = self.db
+
+    def close(self) -> None:
+        """Drop this session from the live registry (idempotent; a session
+        that is never closed falls off the registry's bounded end)."""
+        from . import activity
+
+        activity.deregister_session(self._session_id)
+
+    def _set_phase(self, phase: str) -> None:
+        if self._active_qid is not None:
+            from . import activity
+
+            activity.set_phase(self._active_qid, phase)
 
     # -- dispatch ------------------------------------------------------------
 
@@ -136,19 +157,36 @@ class Session:
             )
         import time as _time
 
-        from . import sqlstats
+        from . import activity, sqlstats
+        from ..utils import tracing
 
         t0 = _time.perf_counter()
+        self._active_qid = activity.begin_query(self._session_id, text)
+        self._last_fp = None
+        err = False
+        sp = None
         try:
-            out = self._dispatch(text)
+            # the root span of the statement's trace: everything below —
+            # parse/bind, plan-cache lookup, flow pull, KV batches, WAL
+            # appends — nests under it via the contextvar
+            with tracing.span("sql.execute",
+                              stmt=text.strip()[:120]) as sp:
+                out = self._dispatch(text)
         except BaseException:
             # ANY failure inside an explicit block aborts it (postgres /
             # CRDB: subsequent statements are rejected until ROLLBACK)
+            err = True
             if self._txn is not None:
                 self._txn_aborted = True
-            sqlstats.DEFAULT.record(text, _time.perf_counter() - t0, 0,
-                                    error=True)
             raise
+        finally:
+            activity.end_query(self._active_qid)
+            self._active_qid = None
+            elapsed = _time.perf_counter() - t0
+            if err:
+                sqlstats.DEFAULT.record(text, elapsed, 0, error=True,
+                                        fp=self._last_fp)
+                self._maybe_slow_query(text, elapsed, sp, error=True)
         nrows = 0
         if isinstance(out, dict) and out:
             if "rows_affected" in out:  # DML verbs report affected rows
@@ -157,8 +195,29 @@ class Session:
                 first = next(iter(out.values()))
                 if hasattr(first, "__len__") and not isinstance(first, str):
                     nrows = len(first)
-        sqlstats.DEFAULT.record(text, _time.perf_counter() - t0, nrows)
+        sqlstats.DEFAULT.record(text, elapsed, nrows, fp=self._last_fp)
+        self._maybe_slow_query(text, elapsed, sp)
         return out
+
+    def _maybe_slow_query(self, text: str, elapsed_s: float, span,
+                          error: bool = False) -> None:
+        """The slow-query log (sql.log.slow_query.latency_threshold, 0 =
+        off): past the threshold, log AND capture a diagnostics bundle so
+        the slow execution's trace is inspectable after the fact."""
+        from ..utils import settings
+
+        thresh = settings.get("sql.log.slow_query.latency_threshold")
+        if not thresh or elapsed_s < float(thresh):
+            return
+        from ..utils import log
+        from . import diagnostics
+
+        bundle = diagnostics.capture(
+            self, text, elapsed_s=elapsed_s, span=span,
+            trigger="slow_query", error=error)
+        log.warning(log.SQL_EXEC, "slow query",
+                    elapsed_ms=round(elapsed_s * 1e3, 1),
+                    bundle=bundle.get("id"), stmt=text.strip()[:120])
 
     def _dispatch(self, text: str):
         from .binder import begin_statement
@@ -176,10 +235,17 @@ class Session:
             # parse/bind and runs its cached prepared plan directly
             from . import plancache
 
-            res = plancache.run_memoized(self.catalog, text)
-            if res is not None:
+            self._set_phase("executing")
+            m = plancache.run_memoized_ex(self.catalog, text)
+            if m is not None:
+                res, fp = m
+                self._last_fp = fp or None
                 return res
-        stmt = P.parse_statement(text)
+        self._set_phase("parsing")
+        from ..utils import tracing
+
+        with tracing.leaf_span("sql.parse"):
+            stmt = P.parse_statement(text)
         if isinstance(stmt, P.Select):
             return self._select(stmt, text)
         if isinstance(stmt, (P.CreateTable, P.AlterTable, P.CreateIndex,
@@ -234,6 +300,10 @@ class Session:
             if not hasattr(self, "_session_vars"):
                 self._session_vars = {}
             self._session_vars[name] = raw
+            if name == "application_name":
+                from . import activity
+
+                activity.set_application_name(self._session_id, raw)
             return {"set": name}
         m = _re.match(r"(?is)^show\s+([a-z_][a-z0-9_]*)$", t)
         if m:
@@ -340,10 +410,15 @@ class Session:
             # structure, any numeric literals — the pgwire extended
             # protocol's Parse/Bind/Execute shape after literal inlining)
             # rebind into a cached operator tree with zero new compiles
+            from ..utils import tracing
             from . import plancache
 
-            res, _ = plancache.run_cached(
-                Binder(self.catalog).bind(stmt), text=text)
+            self._set_phase("binding")
+            with tracing.leaf_span("sql.bind"):
+                rel = Binder(self.catalog).bind(stmt)
+            self._set_phase("executing")
+            res, _, fp = plancache.run_cached_ex(rel, text=text)
+            self._last_fp = fp or None
             return res
         # in-txn SELECT: scans read at the txn snapshot, and every scanned
         # table's span lands in the txn's read set for commit-time refresh

@@ -36,6 +36,12 @@ def fingerprint(sql: str) -> str:
     return s
 
 
+# fixed log-scale latency buckets: 0.1ms doubling to ~52s; observations
+# past the last edge land in the overflow slot. Fixed — not adaptive — so
+# percentiles from two snapshots are comparable.
+_LAT_BUCKETS: tuple[float, ...] = tuple(0.0001 * 2 ** i for i in range(20))
+
+
 @dataclass
 class StmtStats:
     fingerprint: str
@@ -45,10 +51,33 @@ class StmtStats:
     max_s: float = 0.0
     rows: int = 0
     errors: int = 0
+    hist: list[int] = field(
+        default_factory=lambda: [0] * (len(_LAT_BUCKETS) + 1))
 
     @property
     def mean_s(self) -> float:
         return self.total_s / self.count if self.count else 0.0
+
+    def observe(self, elapsed_s: float) -> None:
+        import bisect
+
+        self.hist[bisect.bisect_left(_LAT_BUCKETS, elapsed_s)] += 1
+
+    def percentile(self, q: float) -> float:
+        """Latency quantile in seconds from the bucket counts (upper bucket
+        edge — the prometheus histogram_quantile convention, clamped to the
+        observed max)."""
+        if not self.count:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.hist):
+            seen += c
+            if seen >= target:
+                edge = (_LAT_BUCKETS[i] if i < len(_LAT_BUCKETS)
+                        else self.max_s)
+                return min(edge, self.max_s)
+        return self.max_s
 
 
 class StatsRegistry:
@@ -64,8 +93,13 @@ class StatsRegistry:
         self.evicted = 0
 
     def record(self, sql: str, elapsed_s: float, rows: int,
-               error: bool = False) -> None:
-        fp = fingerprint(sql)
+               error: bool = False, fp: str | None = None) -> None:
+        """Accumulate one execution. ``fp`` lets the plan cache supply the
+        structural fingerprint of the entry that served the statement (its
+        literal re-parameterization already proved `a=1` and `a=2` the
+        same plan), collapsing textual variants the regex would split."""
+        if fp is None:
+            fp = fingerprint(sql)
         with self._lock:
             st = self._stats.get(fp)
             if st is None:
@@ -81,6 +115,7 @@ class StatsRegistry:
             st.min_s = min(st.min_s, elapsed_s)
             st.max_s = max(st.max_s, elapsed_s)
             st.rows += rows
+            st.observe(elapsed_s)
             if error:
                 st.errors += 1
 
@@ -90,7 +125,8 @@ class StatsRegistry:
 
         with self._lock:
             return sorted(
-                (dataclasses.replace(s) for s in self._stats.values()),
+                (dataclasses.replace(s, hist=list(s.hist))
+                 for s in self._stats.values()),
                 key=lambda s: -s.total_s,
             )
 
@@ -101,6 +137,8 @@ class StatsRegistry:
             {"fingerprint": s.fingerprint, "count": s.count,
              "meanMs": round(s.mean_s * 1e3, 3),
              "maxMs": round(s.max_s * 1e3, 3),
+             "p50Ms": round(s.percentile(0.50) * 1e3, 3),
+             "p99Ms": round(s.percentile(0.99) * 1e3, 3),
              "rows": s.rows, "errors": s.errors}
             for s in self.all()
         ]

@@ -373,7 +373,7 @@ class Engine:
 
     def _wal_record(self, kind: int, key: bytes, value: bytes, ts: int,
                     seq: int, txn: int, flag: bool) -> None:
-        from ..utils import faults
+        from ..utils import faults, tracing
 
         rec = _WAL_REC.pack(kind, ts, seq, txn, 1 if flag else 0,
                             len(key), len(value))
@@ -384,17 +384,19 @@ class Engine:
         # stalling disk, `error` EIO before any byte lands, `partial` a
         # torn append — half the record hits the file, then the "disk"
         # dies. Replay's torn-tail truncation must recover all three.
-        faults.fire("storage.wal.append")
-        frac = faults.partial_fraction("storage.wal.append")
-        if frac is not None:
-            self._wal.write(payload[:max(1, int(len(payload) * frac))])
+        with tracing.leaf_span("storage/wal.append", bytes=len(payload)):
+            faults.fire("storage.wal.append")
+            frac = faults.partial_fraction("storage.wal.append")
+            if frac is not None:
+                self._wal.write(payload[:max(1, int(len(payload) * frac))])
+                self._wal.flush()
+                raise faults.InjectedFault("storage.wal.append", "partial")
+            self._wal.write(payload)
             self._wal.flush()
-            raise faults.InjectedFault("storage.wal.append", "partial")
-        self._wal.write(payload)
-        self._wal.flush()
-        if self.wal_fsync:
-            faults.fire("storage.wal.fsync")
-            os.fsync(self._wal.fileno())
+            if self.wal_fsync:
+                with tracing.leaf_span("storage/wal.fsync"):
+                    faults.fire("storage.wal.fsync")
+                    os.fsync(self._wal.fileno())
         if mon is not None:
             # the WAL append IS the write-latency signal the disk monitor
             # tracks (pkg/storage/disk samples the same device)
@@ -805,38 +807,46 @@ class Engine:
         tombstones (a full/manual compaction); bottom=False is the
         size-tiered incremental pass: merge only the `compact_width`
         smallest runs (pebble's tiered L0->Lbase compaction picking)."""
+        from ..utils import tracing
+
         self.flush_mem_only()
         if len(self.runs) < 2:
             return
-        if bottom:
-            picked = list(range(len(self.runs)))
-        else:
-            by_size = sorted(
-                range(len(self.runs)), key=lambda i: self.runs[i].capacity
+        with tracing.leaf_span("storage/compaction", bottom=bottom,
+                               runs=len(self.runs)):
+            if bottom:
+                picked = list(range(len(self.runs)))
+            else:
+                by_size = sorted(
+                    range(len(self.runs)),
+                    key=lambda i: self.runs[i].capacity
+                )
+                picked = sorted(by_size[: max(2, self.compact_width)])
+            blocks = tuple(self.runs[i] for i in picked)
+            total = sum(r.capacity for r in blocks)
+            merged = self._merge_for_compaction(blocks, total)
+            keep = mvcc.mvcc_gc_filter(merged, jnp.int64(self.gc_ts),
+                                       bottom)
+            merged = mvcc.KVBlock(
+                key=merged.key, ts=merged.ts, seq=merged.seq,
+                txn=merged.txn, tomb=merged.tomb, value=merged.value,
+                vlen=merged.vlen, mask=merged.mask & keep,
             )
-            picked = sorted(by_size[: max(2, self.compact_width)])
-        blocks = tuple(self.runs[i] for i in picked)
-        total = sum(r.capacity for r in blocks)
-        merged = self._merge_for_compaction(blocks, total)
-        keep = mvcc.mvcc_gc_filter(merged, jnp.int64(self.gc_ts), bottom)
-        merged = mvcc.KVBlock(
-            key=merged.key, ts=merged.ts, seq=merged.seq, txn=merged.txn,
-            tomb=merged.tomb, value=merged.value, vlen=merged.vlen,
-            mask=merged.mask & keep,
-        )
-        merged = _shrink(mvcc.sort_block(merged))
-        kept = [r for i, r in enumerate(self.runs) if i not in set(picked)]
-        # the merged run replaces its sources at the oldest picked position
-        kept.insert(min(len(kept), picked[0]), merged)
-        self.runs = kept
-        self._gen += 1
-        self.stats.compactions += 1
-        from ..utils import log, metric
+            merged = _shrink(mvcc.sort_block(merged))
+            kept = [r for i, r in enumerate(self.runs)
+                    if i not in set(picked)]
+            # the merged run replaces its sources at the oldest picked
+            # position
+            kept.insert(min(len(kept), picked[0]), merged)
+            self.runs = kept
+            self._gen += 1
+            self.stats.compactions += 1
+            from ..utils import log, metric
 
-        metric.ENGINE_COMPACTIONS.inc()
-        log.debug(log.STORAGE, "compaction", runs=len(self.runs),
-                  bottom=bottom)
-        self.stats.runs = len(self.runs)
+            metric.ENGINE_COMPACTIONS.inc()
+            log.debug(log.STORAGE, "compaction", runs=len(self.runs),
+                      bottom=bottom)
+            self.stats.runs = len(self.runs)
 
     def _merge_for_compaction(self, blocks, total: int) -> mvcc.KVBlock:
         """Pick the compaction merge: the bitonic-merge Pallas kernel

@@ -400,3 +400,44 @@ PLAN_WARMUP_ENABLED = register_bool(
     "(by sqlstats fingerprint) off the serving path after DDL or process "
     "start, so the first foreground execution finds warm kernels",
 )
+SLOW_QUERY_THRESHOLD = register_float(
+    "sql.log.slow_query.latency_threshold", 0.0,
+    "when > 0, any statement slower than this many seconds is logged to "
+    "the SQL_EXEC channel and a statement diagnostics bundle (trace, "
+    "plan, counters — sql/diagnostics.py) is captured to the bounded "
+    "on-disk ring; 0 disables",
+    lo=0.0,
+)
+XLA_PROFILE = register_bool(
+    "sql.trace.xla_profile", False,
+    "annotate query execution with jax.profiler.TraceAnnotation so "
+    "device timelines captured by an external profiler carry query "
+    "boundaries; off by default — the profiler is optional and queries "
+    "must run without it",
+)
+DIAG_RING_SIZE = register_int(
+    "sql.diagnostics.ring_size", 16,
+    "maximum statement diagnostics bundles retained on disk before the "
+    "oldest is evicted (sql/diagnostics.py ring); each bundle is a JSON "
+    "file with the trace, plan, and counter snapshot",
+    lo=1, hi=1 << 12,
+)
+DIAG_DIR = register_string(
+    "sql.diagnostics.dir", "",
+    "directory for statement diagnostics bundles; empty uses a "
+    "per-process temporary directory cleaned up on interpreter exit",
+)
+TS_RETENTION_SECONDS = register_float(
+    "ts.retention_seconds", 600.0,
+    "timeseries retention horizon: the background metrics scraper "
+    "(server/node.py) prunes kv/tsdb.py samples older than this after "
+    "each scrape tick; 0 disables pruning",
+    lo=0.0,
+)
+TS_SCRAPE_INTERVAL = register_float(
+    "ts.scrape_interval_seconds", 10.0,
+    "seconds between background metrics-scraper ticks on a server node "
+    "(each tick records every registry counter/gauge into the "
+    "timeseries store under cr.node.*)",
+    lo=0.1,
+)

@@ -2,69 +2,282 @@
 span.go:46): always-cheap structured spans forming a tree per operation,
 with structured payloads. DistSQL propagates spans through flows and folds
 per-processor ComponentStats into EXPLAIN ANALYZE via
-execstats/traceanalyzer.go; here the flow runtime opens a span per query and
-operators attach their stats to it.
+execstats/traceanalyzer.go; here every layer seam opens a span (parse/bind/
+plan-cache, flow pull, KV batch send, WAL append, compaction) and remote
+recordings graft back into the caller's tree (the snowball-trace shape).
+
+Concurrency model: the "current span" lives in a ``contextvars.ContextVar``
+so concurrent sessions — one thread per pgwire connection — keep disjoint
+span trees. A new thread starts with an empty context, so its first span is
+a new root; nothing ever needs to lock a shared stack. The inflight-span
+registry (crdb_internal.node_inflight_trace_spans / tracing/service's
+inflight collection) and the finished-root ring are the only shared state,
+each under its own lock.
+
+Wire shape: ``context()`` exports the Dapper-style ``(trace_id, span_id)``
+pair; a server opens its span with ``remote_span(name, ctx)`` and ships the
+finished recording (``Span.to_dict``) back in its response; the client
+calls ``graft(payload)`` to attach the remote subtree to its own span.
+
+Creation discipline (enforced by the crlint ``tracing-api`` pass): spans
+are only born through ``Tracer.span``/``remote_span``/``synthetic_span`` —
+no direct Span() construction or current-context mutation outside this
+module, so every span is guaranteed to close, unregister from the inflight
+table, and land in exactly one tree.
 """
 
 from __future__ import annotations
 
+import itertools
+import threading
 import time
 from contextlib import contextmanager
-from dataclasses import dataclass, field
+from contextvars import ContextVar
+from dataclasses import dataclass, field, is_dataclass
 from typing import Any
+
+_ids = itertools.count(1)
+_id_lock = threading.Lock()
+
+
+def _next_id() -> int:
+    with _id_lock:
+        return next(_ids)
+
+
+def _jsonable(v: Any):
+    """Best-effort JSON projection for tags/records (ComponentStats and
+    friends carry __slots__; unknown objects degrade to repr)."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(i) for i in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    slots = getattr(type(v), "__slots__", None)
+    if slots:
+        return {s: _jsonable(getattr(v, s, None)) for s in slots}
+    if is_dataclass(v) and not isinstance(v, type):
+        import dataclasses
+
+        return {f.name: _jsonable(getattr(v, f.name))
+                for f in dataclasses.fields(v)}
+    return repr(v)
 
 
 @dataclass
 class Span:
     name: str
-    start: float = 0.0
+    trace_id: int = 0
+    span_id: int = 0
+    parent_id: int = 0
+    start: float = 0.0       # perf_counter seconds (durations)
+    start_wall: float = 0.0  # epoch seconds (cross-process alignment)
     duration: float = 0.0
     tags: dict[str, Any] = field(default_factory=dict)
     records: list[Any] = field(default_factory=list)
     children: list["Span"] = field(default_factory=list)
+    remote: bool = False     # grafted from another node's recording
+    error: str | None = None
 
     def record(self, payload: Any) -> None:
         """Attach a structured payload (ComponentStats etc.)."""
         self.records.append(payload)
 
+    def add_tag(self, key: str, value: Any) -> None:
+        self.tags[key] = value
+
+    def inc_tag(self, key: str, delta: float) -> None:
+        """Accumulate a numeric tag (per-call costs folded into one
+        number: jit dispatch time, readback time, retry counts)."""
+        self.tags[key] = self.tags.get(key, 0) + delta
+
     def tree(self, indent: int = 0) -> str:
+        mark = " [remote]" if self.remote else ""
+        err = f" error={self.error}" if self.error else ""
         out = [f"{'  ' * indent}{self.name}: {self.duration*1e3:.2f}ms"
-               + (f" {self.tags}" if self.tags else "")]
+               + mark + (f" {self.tags}" if self.tags else "") + err]
         for c in self.children:
             out.append(c.tree(indent + 1))
         return "\n".join(out)
 
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
 
-MAX_FINISHED = 64  # ring of recent root spans (the span registry's cap)
+    def to_dict(self) -> dict:
+        """JSON-serializable recording (the wire/bundle shape)."""
+        d = {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "startWallMs": round(self.start_wall * 1e3, 3),
+            "durationMs": round(self.duration * 1e3, 4),
+            "tags": _jsonable(self.tags),
+            "children": [c.to_dict() for c in self.children],
+        }
+        if self.records:
+            d["records"] = _jsonable(self.records)
+        if self.remote:
+            d["remote"] = True
+        if self.error:
+            d["error"] = self.error
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Span":
+        s = Span(
+            name=str(d.get("name", "?")),
+            trace_id=int(d.get("traceId", 0)),
+            span_id=int(d.get("spanId", 0)),
+            parent_id=int(d.get("parentId", 0)),
+            start_wall=float(d.get("startWallMs", 0.0)) / 1e3,
+            duration=float(d.get("durationMs", 0.0)) / 1e3,
+            tags=dict(d.get("tags") or {}),
+            records=list(d.get("records") or ()),
+            remote=True,
+            error=d.get("error"),
+        )
+        s.children = [Span.from_dict(c) for c in d.get("children", ())]
+        return s
+
+
+MAX_FINISHED = 64   # ring of recent root spans (the span registry's cap)
+MAX_CHILDREN = 128  # per-span child cap (hot leaf sites: WAL appends)
 
 
 class Tracer:
-    """Per-process tracer; spans nest via a stack (single-threaded flows;
-    the pull loop is sequential by design). Finished root spans are kept in
-    a bounded ring so a long-lived process doesn't accumulate them."""
+    """Per-process tracer; the current span rides a ContextVar so every
+    thread (pgwire session, flow server conn, background queue) nests its
+    own tree. Finished root spans are kept in a bounded ring; open spans
+    are visible through ``inflight()`` for crdb_internal."""
 
     def __init__(self):
-        self._stack: list[Span] = []
+        self._current: ContextVar[Span | None] = ContextVar(
+            f"crdb_tpu_trace_{id(self)}", default=None)
         self.finished: list[Span] = []
+        self._fin_lock = threading.Lock()
+        self._inflight: dict[int, Span] = {}
+        self._if_lock = threading.Lock()
+
+    # -- span lifecycle ----------------------------------------------------
 
     @contextmanager
     def span(self, name: str, **tags):
-        s = Span(name=name, start=time.perf_counter(), tags=dict(tags))
-        if self._stack:
-            self._stack[-1].children.append(s)
-        self._stack.append(s)
+        yield from self._run_span(Span(name=name, tags=dict(tags)), None)
+
+    @contextmanager
+    def remote_span(self, name: str, ctx: dict | None, **tags):
+        """Server-side half of propagation: open a span whose parent is
+        the REMOTE caller's span (``ctx`` from :func:`context`). With
+        ``ctx=None`` this is a no-op context yielding None — so wire
+        handlers stay unconditional. The finished recording (``to_dict``)
+        is what the server ships back for grafting."""
+        if ctx is None:
+            yield None
+            return
+        s = Span(name=name, tags=dict(tags))
+        remote = (int(ctx.get("traceId", 0)), int(ctx.get("spanId", 0)))
+        yield from self._run_span(s, remote)
+
+    @contextmanager
+    def leaf_span(self, name: str, **tags):
+        """A span that only exists when an operation is already being
+        traced (hot sites: WAL appends, KV sends from background threads
+        must not flood the finished ring with root spans). Yields None
+        when no span is active."""
+        if self._current.get() is None:
+            yield None
+            return
+        yield from self._run_span(Span(name=name, tags=dict(tags)), None)
+
+    def _run_span(self, s: Span, remote_parent: tuple[int, int] | None):
+        parent = self._current.get()
+        s.span_id = _next_id()
+        s.start = time.perf_counter()
+        s.start_wall = time.time()
+        if remote_parent is not None:
+            s.trace_id, s.parent_id = remote_parent
+        elif parent is not None:
+            s.trace_id = parent.trace_id
+            s.parent_id = parent.span_id
+            if len(parent.children) < MAX_CHILDREN:
+                parent.children.append(s)
+            else:
+                parent.inc_tag("dropped_children", 1)
+        else:
+            s.trace_id = s.span_id
+        with self._if_lock:
+            self._inflight[s.span_id] = s
+        token = self._current.set(s)
         try:
             yield s
+        except BaseException as e:
+            if s.error is None:
+                s.error = f"{type(e).__name__}: {e}"
+            raise
         finally:
             s.duration = time.perf_counter() - s.start
-            self._stack.pop()
-            if not self._stack:
-                self.finished.append(s)
-                if len(self.finished) > MAX_FINISHED:
-                    del self.finished[: -MAX_FINISHED]
+            self._current.reset(token)
+            with self._if_lock:
+                self._inflight.pop(s.span_id, None)
+            if parent is None:
+                with self._fin_lock:
+                    self.finished.append(s)
+                    if len(self.finished) > MAX_FINISHED:
+                        del self.finished[: -MAX_FINISHED]
+
+    def synthetic_span(self, parent: Span, name: str, duration_s: float,
+                       **tags) -> Span:
+        """Attach an already-measured child span (execstats folding: per-
+        operator ComponentStats become spans after the pull loop ran).
+        The ONE sanctioned way to make a span without entering it."""
+        s = Span(name=name, trace_id=parent.trace_id,
+                 span_id=_next_id(), parent_id=parent.span_id,
+                 start_wall=parent.start_wall, duration=duration_s,
+                 tags=dict(tags))
+        parent.children.append(s)
+        return s
+
+    # -- context + recordings ----------------------------------------------
 
     def current(self) -> Span | None:
-        return self._stack[-1] if self._stack else None
+        return self._current.get()
+
+    def context(self) -> dict | None:
+        """The wire-propagated (trace_id, span_id) of the current span —
+        None when nothing is being traced (callers then skip the field)."""
+        s = self._current.get()
+        if s is None:
+            return None
+        return {"traceId": s.trace_id, "spanId": s.span_id}
+
+    def graft(self, payload: dict | None,
+              into: Span | None = None) -> Span | None:
+        """Attach a remote recording (a ``to_dict`` dict shipped back by
+        a server) under the current span — or under ``into``, for streams
+        whose trailer arrives on a different thread than the span owner
+        (flow inboxes pulled by puller threads). No-op outside a span or
+        for a None/bad payload — error paths call this unconditionally."""
+        if not payload:
+            return None
+        cur = into if into is not None else self._current.get()
+        if cur is None:
+            return None
+        try:
+            s = Span.from_dict(payload)
+        except (TypeError, ValueError, KeyError):
+            return None
+        cur.children.append(s)
+        return s
+
+    def inflight(self) -> list[Span]:
+        """Open spans, oldest first (node_inflight_trace_spans). The
+        returned Span objects are live — readers must not mutate them."""
+        with self._if_lock:
+            return sorted(self._inflight.values(), key=lambda s: s.start)
 
 
 # process-global default tracer (the reference hangs one off every Server)
@@ -75,5 +288,30 @@ def span(name: str, **tags):
     return DEFAULT.span(name, **tags)
 
 
+def remote_span(name: str, ctx: dict | None, **tags):
+    return DEFAULT.remote_span(name, ctx, **tags)
+
+
+def leaf_span(name: str, **tags):
+    return DEFAULT.leaf_span(name, **tags)
+
+
 def current() -> Span | None:
     return DEFAULT.current()
+
+
+def context() -> dict | None:
+    return DEFAULT.context()
+
+
+def graft(payload: dict | None, into: Span | None = None) -> Span | None:
+    return DEFAULT.graft(payload, into)
+
+
+def inflight() -> list[Span]:
+    return DEFAULT.inflight()
+
+
+def synthetic_span(parent: Span, name: str, duration_s: float,
+                   **tags) -> Span:
+    return DEFAULT.synthetic_span(parent, name, duration_s, **tags)

@@ -1,0 +1,106 @@
+"""Static metrics audit — every metric must carry help text and be
+documented in README's metrics table.
+
+Two failure classes, both observability drift the type system can't catch:
+
+- **undocumented metric**: a ``DEFAULT.counter/gauge/histogram/
+  labeled_counter`` registration whose name is missing from the README
+  metrics table, or whose help string is empty — an operator sees the
+  series in /_status/vars with no way to learn what it measures.
+- **stale table row**: a README row naming a metric no code registers —
+  documentation for a series that will never appear.
+
+Pure ast pass over ``cockroach_tpu/`` (no package import, so it runs
+without pulling in jax). Wired as a tier-1 test via
+tests/test_metrics_documented.py; also runnable directly:
+
+    python -m scripts.check_metrics_documented
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+_KINDS = ("counter", "gauge", "histogram", "labeled_counter")
+# README metrics-table rows: | `metric_name` | ... |
+_ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|", re.MULTILINE)
+
+
+def registrations(pkg: pathlib.Path) -> dict[str, dict]:
+    """{metric name: {kind, help, where}} for every DEFAULT registry
+    registration in the package."""
+    regs: dict[str, dict] = {}
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(pkg.parent).as_posix()
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _KINDS):
+                continue
+            base = node.func.value
+            base_name = (base.attr if isinstance(base, ast.Attribute)
+                         else base.id if isinstance(base, ast.Name)
+                         else None)
+            if base_name != "DEFAULT":
+                continue  # per-test registries document themselves
+            args = node.args
+            if not args or not isinstance(args[0], ast.Constant):
+                continue
+            name = str(args[0].value)
+            # labeled_counter(name, label, help); the rest (name, help)
+            hi = 2 if node.func.attr == "labeled_counter" else 1
+            help_txt = ""
+            if len(args) > hi and isinstance(args[hi], ast.Constant):
+                help_txt = str(args[hi].value)
+            for kw in node.keywords:
+                if kw.arg == "help" and isinstance(kw.value, ast.Constant):
+                    help_txt = str(kw.value.value)
+            regs[name] = {"kind": node.func.attr, "help": help_txt,
+                          "where": f"{rel}:{node.lineno}"}
+    return regs
+
+
+def documented(readme: pathlib.Path) -> set[str]:
+    return set(_ROW.findall(readme.read_text())) if readme.exists() else set()
+
+
+def check(repo_root: str | pathlib.Path | None = None) -> list[str]:
+    """Returns a list of human-readable violations (empty = clean)."""
+    if repo_root is None:
+        repo_root = pathlib.Path(__file__).resolve().parent.parent
+    root = pathlib.Path(repo_root)
+    regs = registrations(root / "cockroach_tpu")
+    rows = documented(root / "README.md")
+    problems = []
+    for name in sorted(regs):
+        if not regs[name]["help"].strip():
+            problems.append(
+                f"metric {name!r} ({regs[name]['where']}) registered with "
+                f"empty help text")
+        if name not in rows:
+            problems.append(
+                f"metric {name!r} ({regs[name]['where']}) missing from the "
+                f"README metrics table")
+    for name in sorted(rows - set(regs)):
+        problems.append(
+            f"README metrics table documents {name!r} but no code "
+            f"registers it")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"FAIL: {p}", file=sys.stderr)
+    if not problems:
+        print("metrics registry clean: every metric has help text and a "
+              "README table row")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -166,6 +166,50 @@ def test_broad_except_scoped_outside_kv_flow_server(tmp_path):
     assert not run_lint([root], rules=("broad-except",))
 
 
+# ------------------------------------------------------------ tracing-api
+
+def test_tracing_api_flags_direct_span_construction(tmp_path):
+    root = _tree(tmp_path, {
+        "cockroach_tpu/flow/thing.py": (
+            "from ..utils import tracing\n"
+            "from ..utils.tracing import Span\n"
+            "def f(tr):\n"
+            "    a = Span('x')\n"             # imported-name construction
+            "    b = tracing.Span('y')\n"     # attribute construction
+            "    tr._current.set(a)\n"        # tracer internals
+            "    return a, b\n"),
+        "cockroach_tpu/utils/tracing.py": (
+            "class Span:\n"
+            "    pass\n"
+            "def span(name):\n"
+            "    return Span(name)\n"),       # the API itself is exempt
+    })
+    found = run_lint([root], rules=("tracing-api",))
+    assert len(found) == 3, [f.render() for f in found]
+    assert all(f.path == "cockroach_tpu/flow/thing.py" for f in found)
+
+
+def test_tracing_api_pragma_suppresses(tmp_path):
+    root = _tree(tmp_path, {"cockroach_tpu/plan/thing.py": (
+        "from ..utils import tracing\n"
+        "def f():\n"
+        "    # crlint: allow-tracing-api(test fixture builds a detached tree)\n"
+        "    return tracing.Span('x')\n")})
+    assert not run_lint([root], rules=("tracing-api",))
+
+
+def test_tracing_api_ignores_entered_spans(tmp_path):
+    # the sanctioned forms produce no findings
+    root = _tree(tmp_path, {"cockroach_tpu/kv/thing.py": (
+        "from ..utils import tracing\n"
+        "def f():\n"
+        "    with tracing.span('a') as sp:\n"
+        "        with tracing.leaf_span('b'):\n"
+        "            pass\n"
+        "    return tracing.synthetic_span(sp, 'c', 0.1)\n")})
+    assert not run_lint([root], rules=("tracing-api",))
+
+
 # ---------------------------------------------------------- unused-import
 
 def test_unused_import_flagged_and_pragma(tmp_path):
