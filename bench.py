@@ -344,6 +344,35 @@ def _worker(job: str) -> None:
             "compactions": y["compactions"],
         }), flush=True)
         return
+    if job == "load":
+        # mixed-workload serving load (ROADMAP 3(c)): N concurrent sessions
+        # x (YCSB point ops + TPC-H analytics) through the full SQL front
+        # door, measuring throughput, admission queue-wait, and peak HBM
+        from cockroach_tpu.bench.load import run_mixed_load
+
+        r = run_mixed_load(
+            sessions=int(os.environ.get("BENCH_LOAD_SESSIONS", "4")),
+            duration_s=float(os.environ.get("BENCH_LOAD_S", "10")),
+            sf=float(os.environ.get("BENCH_LOAD_SF", "0.01")),
+        )
+        print("RESULT " + json.dumps({
+            "job": job, "platform": platform,
+            "sessions": r["sessions"],
+            "ops_per_sec": r["ops_per_sec"],
+            "point_ops": r["point_ops"],
+            "analytic_ops": r["analytic_ops"],
+            "inserts": r["inserts"],
+            "conflicts": r["conflicts"],
+            "errors": r["errors"],
+            "p50_queue_wait_ms": r["p50_queue_wait_ms"],
+            "p99_queue_wait_ms": r["p99_queue_wait_ms"],
+            "admission_waits": r["admission_waits"],
+            "admission_timeouts": r["admission_timeouts"],
+            "peak_hbm_bytes": r["peak_hbm_bytes"],
+            "spills": r["spills"],
+            "drain_failures": r["drain_failures"],
+        }), flush=True)
+        return
     from cockroach_tpu.bench import tpch
 
     t0 = time.time()
@@ -396,7 +425,7 @@ def _run_worker(job: str, timeout_s: float, env: dict) -> dict | None:
     return None
 
 
-def main() -> None:
+def main(only_job: str | None = None) -> None:
     sf = float(os.environ.get("TPCH_SF", "1.0"))
     deadline_s = float(os.environ.get("BENCH_TOTAL_S", "2700"))
     # north-star ladder (BASELINE.md): Q3/Q9/Q18 + the Q1 single-table base
@@ -455,12 +484,21 @@ def main() -> None:
     jobs = list(qnames)
     if os.environ.get("BENCH_YCSB", "1") != "0":
         jobs.append("ycsb")
+    if os.environ.get("BENCH_LOAD", "1") != "0":
+        jobs.append("load")
+    if only_job is not None:
+        # --job <name>: run exactly that ladder item (e.g. `bench.py --job
+        # load` for the mixed-workload serving run) with the same worker
+        # isolation + RESULT protocol as the full ladder
+        jobs = [only_job]
 
     def record(res) -> None:
         _partial["platform"] = res.pop("platform", platform)
         job_name = res.pop("job")
         if job_name == "ycsb":
             _partial["detail"]["ycsb_e_1m"] = res
+        elif job_name == "load":
+            _partial["detail"]["mixed_load"] = res
         else:
             _partial["detail"][job_name] = res
 
@@ -511,8 +549,11 @@ if __name__ == "__main__":
                   file=sys.stderr, flush=True)
             sys.exit(1)
         sys.exit(0)
+    _only = None
+    if len(sys.argv) >= 3 and sys.argv[1] == "--job":
+        _only = sys.argv[2]
     try:
-        main()
+        main(_only)
     except BaseException as e:  # ALWAYS emit one parseable JSON line
         print(json.dumps({
             "metric": "tpch_bench_failed",

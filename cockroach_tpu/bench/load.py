@@ -1,0 +1,229 @@
+"""Mixed-workload serving-load harness — ROADMAP 3(c).
+
+Reference: pkg/workload's mixed-cluster runs (YCSB writers beside TPC-H
+readers against one node) are how CockroachDB prices admission control and
+memory accounting under contention. This module drives the same shape
+through the FULL SQL front door: N concurrent ``Session``s over one shared
+KV store + TPC-H catalog, each thread mixing YCSB-style point ops (point
+SELECT / INSERT on an indexed kv table) with small TPC-H-flavoured analytic
+statements (scan-aggregate and top-K over lineitem/orders).
+
+Because every statement passes through ``Session.execute``, the run
+exercises — and measures — the whole resource observability plane:
+
+- admission: each statement takes a WorkQueue slot (utils/admission.py);
+  queue-wait lands in the ``admission_wait_seconds`` histogram, and p99
+  queue-wait is recovered from the histogram's bucket deltas;
+- memory: each statement opens a query monitor under its session
+  (flow/memory.py); peak HBM is the node root's high-water over the run,
+  cross-checked against the device allocator's peak where the backend
+  reports one.
+
+Returned dict feeds bench.py's ``load`` job (BENCH JSON ``mixed_load``
+entry): ops/s by class, p99 queue-wait ms, peak-HBM bytes, spill and
+admission counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+# analytic statements: TPC-H q1/q18 flavoured, sized so they plan and run
+# in milliseconds at the harness's small scale factor but still walk the
+# scan→aggregate→sort pipeline (operator accounts, spill checks, top-K)
+_ANALYTIC_SQL = (
+    "SELECT l_returnflag, l_linestatus, sum(l_quantity) AS sum_qty, "
+    "count(*) AS count_order FROM lineitem "
+    "GROUP BY l_returnflag, l_linestatus "
+    "ORDER BY l_returnflag, l_linestatus",
+    "SELECT o_orderpriority, count(*) AS n FROM orders "
+    "GROUP BY o_orderpriority ORDER BY n DESC LIMIT 5",
+    # high-cardinality group-by (q18's first stage): the per-order partial
+    # states actually occupy the agg spool, so the run's peak-HBM figure
+    # reflects real buffering, not just 6-group partial tiles
+    "SELECT l_orderkey, sum(l_quantity) AS sq FROM lineitem "
+    "GROUP BY l_orderkey ORDER BY sq DESC LIMIT 10",
+)
+
+
+def _hist_snapshot(h) -> tuple[list[int], int]:
+    with h._lock:
+        return list(h.counts), h.n
+
+
+def hist_quantile_from_deltas(buckets, before: list[int],
+                              after: list[int], q: float) -> float:
+    """Quantile from two cumulative-count snapshots of a fixed-bucket
+    histogram (the Prometheus histogram_quantile discipline): returns the
+    upper bound of the bucket where the q-th delta observation lands, 0.0
+    when no observations arrived between the snapshots. The overflow
+    bucket reports the last finite bound (a floor, not an estimate)."""
+    deltas = [a - b for a, b in zip(after, before)]
+    total = sum(deltas)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    seen = 0.0
+    for i, d in enumerate(deltas):
+        seen += d
+        if seen >= rank:
+            return float(buckets[i]) if i < len(buckets) else float(
+                buckets[-1])
+    return float(buckets[-1])
+
+
+class _Counters:
+    __slots__ = ("lock", "point_ops", "analytic_ops", "inserts",
+                 "conflicts", "errors", "last_error")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.point_ops = 0
+        self.analytic_ops = 0
+        self.inserts = 0
+        self.conflicts = 0
+        self.errors = 0
+        self.last_error = ""
+
+
+def _load_worker(sess, stop: threading.Event, ctr: _Counters,
+                 n_keys: int, analytic_frac: float, insert_frac: float,
+                 seed: int) -> None:
+    from ..kv.txn import TransactionRetryError
+    from ..storage.lsm import WriteIntentError
+
+    rng = np.random.default_rng(seed)
+    next_pk = n_keys + 1000 * seed  # per-thread pk range: no write-write conflicts
+    while not stop.is_set():
+        try:
+            r = rng.random()
+            if r < analytic_frac:
+                sess.execute(_ANALYTIC_SQL[int(rng.integers(
+                    0, len(_ANALYTIC_SQL)))])
+                with ctr.lock:
+                    ctr.analytic_ops += 1
+            elif r < analytic_frac + insert_frac:
+                sess.execute(
+                    f"INSERT INTO ycsb_kv VALUES ({next_pk}, {next_pk % 997})")
+                next_pk += 1
+                with ctr.lock:
+                    ctr.inserts += 1
+            else:
+                k = int(rng.integers(0, n_keys))
+                sess.execute(f"SELECT v FROM ycsb_kv WHERE k = {k}")
+                with ctr.lock:
+                    ctr.point_ops += 1
+        except (WriteIntentError, TransactionRetryError):
+            # retryable read/write conflict (a point read landed on a
+            # concurrent insert's intent): the client-retry case, counted
+            # as contention rather than failure — the 40001 shape
+            with ctr.lock:
+                ctr.conflicts += 1
+        except Exception as e:  # crlint: allow-broad-except(load harness: one failed op must not kill the thread; failures are counted and reported)
+            with ctr.lock:
+                ctr.errors += 1
+                ctr.last_error = f"{type(e).__name__}: {e}"[:200]
+
+
+def run_mixed_load(sessions: int = 4, duration_s: float = 3.0,
+                   sf: float = 0.01, n_keys: int = 512,
+                   analytic_frac: float = 0.2, insert_frac: float = 0.1,
+                   seed: int = 0) -> dict:
+    """N concurrent sessions × (YCSB point ops + TPC-H analytics) for
+    duration_s; returns throughput, p99 queue-wait, and peak-HBM figures.
+
+    Setup (untimed): generate the TPC-H catalog at ``sf``, bootstrap one
+    session over a fresh KV store, create + seed the ``ycsb_kv`` table.
+    Then ``sessions`` threads share that store/catalog, each through its
+    own Session (own monitor subtree, own admission entries)."""
+    from ..flow import memory
+    from ..sql.session import Session
+    from ..utils import metric
+    from .tpch import gen_tpch_cached
+
+    cat = gen_tpch_cached(sf)
+    boot = Session(catalog=cat)
+    boot.execute("CREATE TABLE ycsb_kv (k INT PRIMARY KEY, v INT)")
+    # seed in multi-row INSERTs (one statement per row would pay the
+    # admission + planning toll n_keys times before the clock even starts)
+    chunk = 128
+    for lo in range(0, n_keys, chunk):
+        rows = ", ".join(f"({k}, {k % 997})"
+                         for k in range(lo, min(lo + chunk, n_keys)))
+        boot.execute(f"INSERT INTO ycsb_kv VALUES {rows}")
+
+    # warm the analytic plans/kernels off the clock (plan + kernel caches
+    # are process-global, so workers serve steady-state from op one; a
+    # loaded box must not report ops=0 just because first-compile ate the
+    # whole window)
+    for stmt in _ANALYTIC_SQL:
+        boot.execute(stmt)
+
+    workers = [Session(catalog=cat, db=boot.db, bootstrap=False)
+               for _ in range(sessions)]
+
+    wait_h = metric.ADMISSION_WAIT_SECONDS
+    wait_before, n_before = _hist_snapshot(wait_h)
+    mem_floor = memory.ROOT.high_water
+    dev_before = memory.device_memory_stats()
+
+    ctr = _Counters()
+    stop = threading.Event()
+    threads = [
+        threading.Thread(
+            target=_load_worker,
+            args=(s, stop, ctr, n_keys, analytic_frac, insert_frac, i + 1),
+            name=f"load-{i}", daemon=True)
+        for i, s in enumerate(workers)
+    ]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    stop.wait(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    elapsed = time.time() - t0
+
+    wait_after, n_after = _hist_snapshot(wait_h)
+    dev_after = memory.device_memory_stats()
+    from ..utils import admission
+    q = admission.sql_queue()
+
+    total_ops = ctr.point_ops + ctr.analytic_ops + ctr.inserts
+    peak_hbm = memory.ROOT.high_water
+    out = {
+        "sessions": sessions,
+        "duration_s": round(elapsed, 3),
+        "ops": total_ops,
+        "ops_per_sec": round(total_ops / elapsed, 2) if elapsed > 0 else 0.0,
+        "point_ops": ctr.point_ops,
+        "analytic_ops": ctr.analytic_ops,
+        "inserts": ctr.inserts,
+        "conflicts": ctr.conflicts,
+        "errors": ctr.errors,
+        "last_error": ctr.last_error,
+        "admission_waits": n_after - n_before,
+        "p99_queue_wait_ms": round(1e3 * hist_quantile_from_deltas(
+            wait_h.buckets, wait_before, wait_after, 0.99), 4),
+        "p50_queue_wait_ms": round(1e3 * hist_quantile_from_deltas(
+            wait_h.buckets, wait_before, wait_after, 0.50), 4),
+        "admission_slots": q.slots,
+        "admission_timeouts": q.timeouts,
+        "peak_hbm_bytes": peak_hbm,
+        "peak_hbm_floor_bytes": mem_floor,  # node peak before the run
+        "spills": memory.ROOT.spills,
+        "drain_failures": memory.drain_failure_count(),
+    }
+    dev_peak = dev_after.get("peak_bytes_in_use", 0)
+    if dev_peak:
+        out["device_peak_bytes"] = dev_peak
+        out["device_peak_delta_bytes"] = (
+            dev_peak - dev_before.get("peak_bytes_in_use", 0))
+    for s in workers:
+        s.close()
+    boot.close()
+    return out

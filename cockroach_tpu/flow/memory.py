@@ -1,18 +1,46 @@
-"""Memory accounting — the colmem.Allocator / mon.BytesMonitor analog.
+"""Memory accounting — the mon.BytesMonitor tree + colmem.Allocator analog.
 
-Reference: pkg/sql/colmem/allocator.go:32 wraps every batch mutation with
-byte accounting against a BytesMonitor; pkg/sql/colexec/colexecdisk/
-disk_spiller.go:103 swaps an in-memory operator for its external variant
-when the account would exceed the budget. Here buffering operators charge
-their spools to an Allocator sized by `sql.distsql.workmem_bytes` (device
-HBM is the scarce resource; XLA owns the actual allocations, so accounting
-tracks LOGICAL bytes of live tiles — capacity x dtype width — which is what
-HBM pressure follows under static shapes)."""
+Reference: pkg/util/mon/bytes_usage.go:240 arranges BytesMonitor instances
+into a tree (node root -> session -> txn/query -> operator accounts);
+every reservation charges the whole ancestor chain, so the root's gauge is
+the node's true SQL memory figure and a query's high water is its peak.
+pkg/sql/colmem/allocator.go:32 wraps batch mutations with byte accounting;
+pkg/sql/colexec/colexecdisk/disk_spiller.go:103 swaps an in-memory
+operator for its external variant when the account would exceed the
+budget.
+
+Here the same tree over LOGICAL device bytes (capacity x dtype width —
+XLA owns the actual HBM allocations; under static shapes logical bytes
+are what HBM pressure follows, cross-checkable against
+``device_memory_stats`` where the backend reports them):
+
+- ``ROOT`` is the process (node) monitor feeding the ``sql_mem_current``/
+  ``sql_mem_max`` gauges;
+- sessions hang a monitor off ROOT (sql/session.py);
+- every statement opens a QUERY monitor via :func:`query_scope` (a
+  contextvar carries it, so operators need no constructor plumbing);
+- buffering operators open :class:`Allocator` accounts under the current
+  query monitor, budgeted by ``sql.distsql.workmem_bytes`` — exceeding
+  the budget raises :class:`BudgetExceededError` and the operator spills
+  to its external variant, attributed to the owning query by
+  :func:`note_spill`.
+
+A query monitor that closes with bytes still reserved is a LEAK (an
+operator failed to release its account): it is counted in
+``sql_mem_query_leaks`` and surfaced through :func:`drain_failures` so
+scripts/check_no_leaks.py can assert drains across the test suite.
+"""
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+import itertools
+import threading
+import weakref
 
 from ..coldata.batch import Batch
+from ..utils import metric
 
 
 class BudgetExceededError(Exception):
@@ -38,31 +66,82 @@ def batch_bytes(b: Batch) -> int:
     return int(total)
 
 
-class Allocator:
-    """Byte account for one operator (or operator subtree).
+# one lock for the whole tree: reservations are per-spool-tile (hundreds
+# per query, not per row), so contention is negligible and charge/unwind
+# up the ancestor chain stays atomic
+_TREE_LOCK = threading.RLock()
 
-    Unlike the reference's hierarchical monitors, budgets here are flat
-    per-operator accounts against the workmem setting — the multi-tenant
-    monitor tree arrives with the control plane."""
 
-    def __init__(self, op: str, budget: int | None = None):
-        from ..utils import settings
+class BytesMonitor:
+    """One node of the monitor tree. ``budget`` of 0 means unlimited at
+    this level (ancestors may still refuse). Reservations charge every
+    ancestor up to ROOT; high_water is the peak of ``used``."""
 
-        self.op = op
-        self.budget = (budget if budget is not None
-                       else settings.get("sql.distsql.workmem_bytes"))
+    def __init__(self, name: str, parent: "BytesMonitor | None" = None,
+                 budget: int = 0, level: str = "operator"):
+        self.name = name
+        self.parent = parent
+        self.budget = int(budget)
+        self.level = level
         self.used = 0
         self.high_water = 0
+        self.spills = 0
+        self.closed = False
+        self._children: list[weakref.ref] = []
+        if parent is not None:
+            with _TREE_LOCK:
+                parent._children.append(weakref.ref(self))
+
+    def child(self, name: str, budget: int = 0,
+              level: str = "operator") -> "BytesMonitor":
+        return BytesMonitor(name, parent=self, budget=budget, level=level)
+
+    def children(self) -> "list[BytesMonitor]":
+        """Live (unclosed) child monitors; dead weakrefs are compacted."""
+        with _TREE_LOCK:
+            out, alive = [], []
+            for r in self._children:
+                m = r()
+                if m is not None and not m.closed:
+                    out.append(m)
+                    alive.append(r)
+            self._children = alive
+            return out
 
     def would_exceed(self, nbytes: int) -> bool:
-        return self.used + int(nbytes) > self.budget
-
-    def reserve(self, nbytes: int) -> None:
         n = int(nbytes)
-        if self.used + n > self.budget:
-            raise BudgetExceededError(self.op, self.used + n, self.budget)
-        self.used += n
-        self.high_water = max(self.high_water, self.used)
+        with _TREE_LOCK:
+            m = self
+            while m is not None:
+                if m.budget and m.used + n > m.budget:
+                    return True
+                m = m.parent
+        return False
+
+    def reserve(self, nbytes: int, force: bool = False) -> None:
+        """Charge ``nbytes`` up the ancestor chain. ``force`` skips the
+        budget check — for buffered state that CANNOT spill (host-side
+        string_agg) where over-budget accounting beats no accounting."""
+        n = int(nbytes)
+        if n <= 0:
+            return
+        with _TREE_LOCK:
+            # check the whole chain BEFORE charging so a refusal anywhere
+            # leaves every ancestor untouched
+            if not force:
+                m = self
+                while m is not None:
+                    if m.budget and m.used + n > m.budget:
+                        raise BudgetExceededError(
+                            m.name, m.used + n, m.budget)
+                    m = m.parent
+            m = self
+            while m is not None:
+                m.used += n
+                if m.used > m.high_water:
+                    m.high_water = m.used
+                m = m.parent
+            _update_gauges()
 
     def reserve_batch(self, b: Batch) -> int:
         n = batch_bytes(b)
@@ -70,4 +149,253 @@ class Allocator:
         return n
 
     def release(self, nbytes: int | None = None) -> None:
-        self.used = 0 if nbytes is None else max(0, self.used - int(nbytes))
+        with _TREE_LOCK:
+            n = self.used if nbytes is None else min(int(nbytes), self.used)
+            if n <= 0:
+                return
+            m = self
+            while m is not None:
+                m.used = max(0, m.used - n)
+                m = m.parent
+            _update_gauges()
+
+    def note_spill(self) -> None:
+        with _TREE_LOCK:
+            m = self
+            while m is not None:
+                m.spills += 1
+                m = m.parent
+
+    def close(self) -> int:
+        """Release everything into the parent chain and detach. Returns the
+        bytes that were still reserved (0 = the account drained cleanly)."""
+        with _TREE_LOCK:
+            if self.closed:
+                return 0
+            leaked = self.used
+            self.release()
+            self.closed = True
+            return leaked
+
+
+# the node-level root monitor (the mon.BytesMonitor the server owns)
+ROOT = BytesMonitor("root", level="root")
+
+
+def _update_gauges() -> None:
+    # called under _TREE_LOCK on every root-visible delta
+    metric.SQL_MEM_CURRENT.set(ROOT.used)
+    metric.SQL_MEM_MAX.set(ROOT.high_water)
+
+
+def refresh_gauges() -> None:
+    """Re-publish the root monitor gauges (the background metrics scraper
+    calls this so a quiet node still exports truthful values)."""
+    with _TREE_LOCK:
+        _update_gauges()
+
+
+def root_budget() -> int:
+    from ..utils import settings
+
+    return int(settings.get("sql.mem.root_budget_bytes"))
+
+
+def mem_pressure() -> float:
+    """ROOT used / configured root budget (0.0 when the budget is
+    unlimited) — the signal admission's IOGovernor folds into write
+    pacing."""
+    b = root_budget()
+    return (ROOT.used / b) if b > 0 else 0.0
+
+
+def session_monitor(name: str) -> BytesMonitor:
+    return BytesMonitor(name, parent=ROOT, level="session")
+
+
+# -- query scope (contextvar-carried, like utils/tracing's current span) ----
+
+_CURRENT_QUERY: contextvars.ContextVar[BytesMonitor | None] = (
+    contextvars.ContextVar("ctpu_query_monitor", default=None))
+_QUERY_SEQ = itertools.count(1)
+
+# drain-failure census (scripts/check_no_leaks.py): monotonic count plus a
+# bounded ring of (monitor name, leaked bytes) for the assertion message
+_DRAIN_FAILURES: list[tuple[str, int]] = []
+_DRAIN_TOTAL = 0
+
+
+def current_query() -> BytesMonitor | None:
+    return _CURRENT_QUERY.get()
+
+
+@contextlib.contextmanager
+def query_scope(parent: BytesMonitor | None = None, name: str | None = None):
+    """Enter (or join) the current statement's query monitor. Nested scopes
+    (a diagnostics re-run inside a session statement) share the outer
+    monitor; the outermost exit closes it, records the peak into
+    ``sql_mem_query_peak_bytes`` and flags any retained reservation as a
+    drain failure."""
+    existing = _CURRENT_QUERY.get()
+    if existing is not None:
+        yield existing
+        return
+    qm = BytesMonitor(name or f"query-{next(_QUERY_SEQ)}",
+                      parent=parent or ROOT, level="query")
+    tok = _CURRENT_QUERY.set(qm)
+    try:
+        yield qm
+    finally:
+        _CURRENT_QUERY.reset(tok)
+        _close_query(qm)
+
+
+def _close_query(qm: BytesMonitor) -> None:
+    global _DRAIN_TOTAL
+    with _TREE_LOCK:
+        # an operator account still open at query end is the operator's
+        # bug, but its bytes must not poison the session/root gauges —
+        # close (force-release) children first, then judge the monitor
+        leaked = 0
+        for c in qm.children():
+            leaked += c.close()
+        leaked += qm.used
+        qm.close()
+        if leaked:
+            _DRAIN_TOTAL += 1
+            _DRAIN_FAILURES.append((qm.name, leaked))
+            del _DRAIN_FAILURES[:-100]
+            metric.SQL_MEM_QUERY_LEAKS.inc()
+    metric.SQL_MEM_QUERY_PEAK.observe(float(qm.high_water))
+
+
+def drain_failure_count() -> int:
+    """Monotonic count of query monitors that closed with bytes still
+    reserved (each is a leak — scripts/check_no_leaks.py asserts this
+    stays flat across every test)."""
+    return _DRAIN_TOTAL
+
+
+def drain_failures(last: int = 10) -> list[tuple[str, int]]:
+    return list(_DRAIN_FAILURES[-last:])
+
+
+def note_spill(kind: str) -> None:
+    """Attribute one spill-to-external-variant event to the owning query
+    (and its ancestors), plus the per-kind node counters."""
+    qm = _CURRENT_QUERY.get()
+    if qm is not None:
+        qm.note_spill()
+    else:
+        ROOT.note_spill()
+    if kind == "sort":
+        metric.EXTERNAL_SORT_SPILLS.inc()
+    elif kind == "join":
+        metric.GRACE_JOIN_SPILLS.inc()
+    # agg spills count through sql_external_agg_spills at the Grace
+    # staging site (flow/external.py) — not double-counted here
+
+
+def monitor_rows() -> list[dict]:
+    """Depth-first snapshot of the live monitor tree (the
+    crdb_internal.node_memory_monitors / /_status/load row shape)."""
+    rows: list[dict] = []
+
+    def walk(m: BytesMonitor, depth: int) -> None:
+        rows.append({
+            "name": m.name, "level": m.level, "depth": depth,
+            "used": m.used, "peak": m.high_water,
+            "budget": m.budget, "spills": m.spills,
+        })
+        for c in m.children():
+            walk(c, depth + 1)
+
+    with _TREE_LOCK:
+        walk(ROOT, 0)
+    return rows
+
+
+def device_memory_stats() -> dict:
+    """Physical-side cross-check of the logical accounting: per-device
+    allocator stats summed over the backend's devices plus the live jax
+    buffer total. Empty dict when the backend reports nothing (CPU)."""
+    try:
+        import jax
+
+        devs = jax.devices()
+    except Exception:  # crlint: allow-broad-except(no backend = no physical stats; logical accounting stands alone)
+        return {}
+    in_use = peak = 0
+    reported = False
+    for d in devs:
+        try:
+            ms = d.memory_stats()
+        except Exception:  # crlint: allow-broad-except(backends without allocator stats raise; skip them)
+            ms = None
+        if ms:
+            reported = True
+            in_use += int(ms.get("bytes_in_use", 0))
+            peak += int(ms.get("peak_bytes_in_use",
+                               ms.get("bytes_in_use", 0)))
+    out: dict = {}
+    if reported:
+        out["bytes_in_use"] = in_use
+        out["peak_bytes_in_use"] = peak
+        out["devices"] = len(devs)
+    try:
+        out["live_buffer_bytes"] = int(
+            sum(a.nbytes for a in jax.live_arrays()))
+    except (AttributeError, RuntimeError):
+        pass  # backend without live-array introspection; field omitted
+    return out
+
+
+class Allocator:
+    """Byte account for one operator (the colmem.Allocator / BoundAccount
+    role): a leaf monitor under the CURRENT query monitor (contextvar),
+    budgeted by ``sql.distsql.workmem_bytes``. The owner must ``close()``
+    it when its buffered state dies — a query monitor that reaches close
+    with open accounts flags a drain failure."""
+
+    def __init__(self, op: str, budget: int | None = None, stats=None):
+        from ..utils import settings
+
+        self.op = op
+        if budget is None:
+            budget = settings.get("sql.distsql.workmem_bytes")
+        parent = _CURRENT_QUERY.get() or ROOT
+        self._mon = BytesMonitor(f"operator/{op}", parent=parent,
+                                 budget=int(budget), level="operator")
+        self._stats = stats
+
+    @property
+    def budget(self) -> int:
+        return self._mon.budget
+
+    @property
+    def used(self) -> int:
+        return self._mon.used
+
+    @property
+    def high_water(self) -> int:
+        return self._mon.high_water
+
+    def would_exceed(self, nbytes: int) -> bool:
+        return self._mon.would_exceed(nbytes)
+
+    def reserve(self, nbytes: int, force: bool = False) -> None:
+        self._mon.reserve(nbytes, force=force)
+        if self._stats is not None:
+            self._stats.max_mem_bytes = max(
+                self._stats.max_mem_bytes, self._mon.high_water)
+
+    def reserve_batch(self, b: Batch) -> int:
+        n = batch_bytes(b)
+        self.reserve(n)
+        return n
+
+    def release(self, nbytes: int | None = None) -> None:
+        self._mon.release(nbytes)
+
+    def close(self) -> None:
+        self._mon.close()

@@ -27,13 +27,19 @@ class ComponentStats:
     plan/explain.py (the execstats/traceanalyzer.go role)."""
 
     __slots__ = ("batches", "rows", "time_s", "bytes", "kernel_dispatches",
-                 "kernel_compiles")
+                 "kernel_compiles", "max_mem_bytes", "spilled")
 
     def __init__(self):
         self.batches = 0
         self.rows = 0
         self.time_s = 0.0  # inclusive wall time in next_batch (incl. children)
         self.bytes = 0  # logical device bytes emitted (colmem accounting)
+        # peak reserved bytes across this operator's memory accounts
+        # (mon.BoundAccount high-water, shown as EXPLAIN ANALYZE "max mem")
+        self.max_mem_bytes = 0
+        # True once a memory account overflow swapped this operator to its
+        # external variant (disk_spiller.go's spilled marker)
+        self.spilled = False
         # XLA dispatches the whole query issued (flow/dispatch.py delta,
         # attributed to the ROOT's stats by run_operator — dispatches are
         # process-global, not attributable per operator without a sync)

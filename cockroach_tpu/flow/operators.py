@@ -789,6 +789,12 @@ class AggregateOp(OneInputOperator):
                 )
         self._acc = None
         self._emitted = False
+        self._spool_alloc = None
+
+    def _close_spool(self) -> None:
+        if self._spool_alloc is not None:
+            self._spool_alloc.close()
+            self._spool_alloc = None
 
     def _final_schema(self, base: Schema) -> Schema:
         return agg_ops.agg_output_schema(
@@ -801,6 +807,7 @@ class AggregateOp(OneInputOperator):
         self._tiles: list[Batch] = []
         self._emitted = False
         self._external = None
+        self._close_spool()  # cached-plan re-run: prior account is dead
         self._sagg_rows = {j: {} for j, _ in self._sagg}
         if hasattr(self, "_partial_fn"):
             return
@@ -857,18 +864,18 @@ class AggregateOp(OneInputOperator):
     def _spool(self):
         """Spool per-tile partial states (fused with the streaming chain
         beneath); merge down only when the spool exceeds workmem (rows or
-        accounted bytes — the colmem.Allocator discipline)."""
+        the monitor-tree byte account — the colmem.Allocator discipline)."""
         from ..utils import settings
-        from .memory import batch_bytes
+        from .memory import Allocator, batch_bytes, note_spill
 
         budget = settings.get("sql.distsql.workmem_rows")
-        byte_budget = settings.get("sql.distsql.workmem_bytes")
+        alloc = Allocator("aggregation spool", stats=self.stats)
+        self._spool_alloc = alloc
         if self.mode == "final":
             tile_raw, tile_jit = _identity_fn, _identity_fn
         else:
             tile_raw, tile_jit = self._partial_raw, self._partial_fn
         spooled = 0
-        spooled_bytes = 0
         if self._sagg:
             # plain pull (no fused chain): every input tile materializes
             # its (group key, string code) pairs host-side before the
@@ -888,19 +895,34 @@ class AggregateOp(OneInputOperator):
         for part in source_it:
             self._tiles.append(part)
             spooled += part.capacity
-            spooled_bytes += batch_bytes(part)
-            if spooled > budget or spooled_bytes > byte_budget:
+            nb = batch_bytes(part)
+            over = alloc.would_exceed(nb)
+            # the tile is resident whether or not the budget likes it, so
+            # account it truthfully (forcing past the refusal): a spilling
+            # operator's max-mem must show the footprint that tripped the
+            # budget, and string_agg (which cannot spill — host-side
+            # state) keeps over-budget accounting rather than none
+            alloc.reserve(nb, force=over)
+            if spooled > budget or over:
                 self._tiles = [self._merge_down()]
                 spooled = self._tiles[0].capacity
-                spooled_bytes = batch_bytes(self._tiles[0])
-                if ((spooled > budget or spooled_bytes > byte_budget)
-                        and not self._sagg):
+                alloc.release()
+                mb = batch_bytes(self._tiles[0])
+                over = alloc.would_exceed(mb)
+                alloc.reserve(mb, force=over)
+                if (spooled > budget or over) and not self._sagg:
                     # merge-down didn't shrink below budget: the GROUP
                     # COUNT itself exceeds memory. Hand the spooled state
                     # tiles + the rest of the partial stream to the Grace
                     # external aggregator (disk_spiller.go's swap;
-                    # external_hash_aggregator.go role)
+                    # external_hash_aggregator.go role), attributed to the
+                    # owning query's monitor
                     from .external import ChainOp, GraceAggregateOp
+
+                    note_spill("agg")
+                    self.stats.spilled = True
+                    alloc.close()
+                    self._spool_alloc = None
 
                     class _Rest:
                         def next_batch(_self):
@@ -1011,6 +1033,7 @@ class AggregateOp(OneInputOperator):
             return self._external.next_batch()
         self._emitted = True
         if not self._tiles:
+            self._close_spool()
             return None
         # a single tile is already fully grouped UNLESS it came from a
         # "final"-mode child (exchanged state rows may repeat group keys)
@@ -1019,12 +1042,17 @@ class AggregateOp(OneInputOperator):
         else:
             acc = self._merge_down()
         self._tiles = []
+        self._close_spool()  # spool tiles are dead; the account drains
         if self.mode == "partial":
             return acc
         out = self._finalize_fn(acc)
         if self._sagg:
             out = self._attach_saggs(out)
         return out
+
+    def close(self):
+        super().close()
+        self._close_spool()
 
 
 class ScalarAggregateOp(OneInputOperator):
@@ -1082,11 +1110,21 @@ class SortOp(OneInputOperator):
         self.output_schema = child.output_schema
         self.keys = keys
         self._emitted = False
+        self._spool_alloc = None
+
+    def close(self):
+        super().close()
+        if self._spool_alloc is not None:
+            self._spool_alloc.close()
+            self._spool_alloc = None
 
     def init(self):
         super().init()
         self._emitted = False
         self._external = None
+        if self._spool_alloc is not None:  # cached-plan re-run
+            self._spool_alloc.close()
+            self._spool_alloc = None
         if hasattr(self, "_fn"):
             return
         rank_tables = {
@@ -1116,7 +1154,7 @@ class SortOp(OneInputOperator):
 
     def _next(self):
         from ..utils import settings
-        from .memory import Allocator, batch_bytes
+        from .memory import Allocator, batch_bytes, note_spill
 
         if self._emitted:
             return None
@@ -1125,17 +1163,26 @@ class SortOp(OneInputOperator):
         tiles = []
         total = 0
         budget = settings.get("sql.distsql.workmem_rows")
-        alloc = Allocator("sort spool")
+        alloc = self._spool_alloc = Allocator("sort spool", stats=self.stats)
         for b in _consume(self, "spool", _identity_fn):
             nb = batch_bytes(b)
             tiles.append(b)
             total += b.capacity
-            if total > budget or alloc.would_exceed(nb):
+            over = alloc.would_exceed(nb)
+            # account the tile even past the budget (it is resident, and
+            # the spilling operator's max-mem must reflect it)
+            alloc.reserve(nb, force=over)
+            if total > budget or over:
                 # spill: hand the spooled tiles + the rest of the input to
                 # the external range-partitioned sort (disk_spiller swap) —
-                # triggered by the ROW budget or the byte ACCOUNT
+                # triggered by the ROW budget or the byte ACCOUNT,
+                # attributed to the owning query's monitor
                 from .external import ChainOp, ExternalSortOp
 
+                note_spill("sort")
+                self.stats.spilled = True
+                alloc.close()
+                self._spool_alloc = None
                 chain = ChainOp(tiles, self.output_schema,
                                 self.child.dictionaries, self.child)
                 self._external = ExternalSortOp(
@@ -1143,8 +1190,9 @@ class SortOp(OneInputOperator):
                 )
                 self._external.init()
                 return self._external.next_batch()
-            alloc.reserve(nb)
         self._emitted = True
+        alloc.close()  # the one-shot device sort consumes the spool
+        self._spool_alloc = None
         if not tiles:
             return None
         return self._fn(tuple(tiles), cap=_spool_cap(tiles))
@@ -1337,6 +1385,10 @@ class HashJoinOp(OneInputOperator):
         super().init()
         self._built = False
         self._grace = None
+        if getattr(self, "_build_alloc", None) is not None:
+            # cached-plan re-run: the prior build batch is garbage now
+            self._build_alloc.close()
+            self._build_alloc = None
         self._analytic = self._plan_analytic()
         if hasattr(self, "_build_fn"):
             return
@@ -1461,16 +1513,27 @@ class HashJoinOp(OneInputOperator):
                 return
             tiles = []
         else:
-            alloc = Allocator("hash join build")
+            alloc = self._build_alloc = Allocator("hash join build",
+                                                  stats=self.stats)
             tiles = []
             for b in _consume_op(self.build, "build_spool"):
                 nb = batch_bytes(b)
-                if alloc.would_exceed(nb):
+                over = alloc.would_exceed(nb)
+                # account the tile even past the budget: it is resident,
+                # and the spilling build's max-mem must show it
+                alloc.reserve(nb, force=over)
+                if over:
                     # build side exceeds workmem: swap in the Grace hash join
                     # (both sides hash-partition so each partition's build
-                    # fits the budget — disk_spiller.go's swap)
+                    # fits the budget — disk_spiller.go's swap), attributed
+                    # to the owning query's monitor
                     from .external import ChainOp, GraceHashJoinOp
+                    from .memory import note_spill
 
+                    note_spill("join")
+                    self.stats.spilled = True
+                    alloc.close()
+                    self._build_alloc = None
                     chain = ChainOp(tiles + [b], self.build.output_schema,
                                     self.build.dictionaries, self.build)
                     self._grace = GraceHashJoinOp(
@@ -1480,7 +1543,6 @@ class HashJoinOp(OneInputOperator):
                     self._grace.init()
                     self._built = True
                     return
-                alloc.reserve(nb)
                 tiles.append(b)
         if not tiles:
             from ..coldata.batch import empty_batch
@@ -1687,6 +1749,9 @@ class HashJoinOp(OneInputOperator):
     def close(self):
         super().close()
         self.build.close()
+        if getattr(self, "_build_alloc", None) is not None:
+            self._build_alloc.close()
+            self._build_alloc = None
 
 
 def _consume_op(op: Operator, tag: str):

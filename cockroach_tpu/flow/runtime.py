@@ -149,11 +149,20 @@ def run_operator(root) -> dict[str, np.ndarray]:
     from ..utils.errors import QueryError, _PASSTHROUGH
     from . import dispatch
 
+    from . import memory
+
     metric.QUERIES.inc()
     t0 = time.perf_counter()
     d0 = dispatch.total()
     c0 = dispatch.compiles()
     overlap = settings.get("sql.distsql.readback_overlap")
+    # joins the session's statement monitor when sql/session.py opened one;
+    # otherwise (direct rel-API use) an ephemeral query monitor under ROOT.
+    # Entered manually so the exit lands AFTER root.close() in the finally:
+    # operators drain their accounts in close(), and only then is the query
+    # monitor judged for drain failures.
+    _scope = memory.query_scope()
+    qmon = _scope.__enter__()
     try:
         # speculative-capacity retry loop: operators run with sticky learned
         # shapes and validate their deferred counters after the pull; an
@@ -226,6 +235,11 @@ def run_operator(root) -> dict[str, np.ndarray]:
             st.kernel_dispatches += dispatch.total() - d0
             st.kernel_compiles += dispatch.compiles() - c0
         root.close()
+        _scope.__exit__(None, None, None)
+        # peak/spills survive monitor close — EXPLAIN ANALYZE's query
+        # footer and sqlstats read them off the root operator
+        root._query_mem_peak = qmon.high_water
+        root._query_mem_spills = qmon.spills
     if not outs:
         return {n: np.array([]) for n in root.output_schema.names}
     return {

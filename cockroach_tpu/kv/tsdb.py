@@ -42,7 +42,8 @@ class TimeSeriesDB:
         self.db = db
 
     def record(self, registry, names: list[str] | None = None) -> int:
-        """Snapshot counters/gauges from a metric.Registry at now()."""
+        """Snapshot counters/gauges (and histogram _count/_sum series —
+        enough to chart rates and means) from a metric.Registry at now()."""
         from ..utils import metric as metric_mod
 
         wall, _ = hlc.unpack(self.db.clock.now())
@@ -54,6 +55,12 @@ class TimeSeriesDB:
                 self.db.put(_key(mname, wall),
                             _SAMPLE.pack(wall, float(m.value)))
                 n += 1
+            elif isinstance(m, metric_mod.Histogram):
+                self.db.put(_key(mname + "_count", wall),
+                            _SAMPLE.pack(wall, float(m.n)))
+                self.db.put(_key(mname + "_sum", wall),
+                            _SAMPLE.pack(wall, float(m.sum)))
+                n += 2
         return n
 
     def query(self, name: str, start_ms: int = 0,

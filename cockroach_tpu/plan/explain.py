@@ -107,6 +107,15 @@ def explain_plan(plan: S.PlanNode) -> str:
     return "\n".join(lines)
 
 
+def _fmt_bytes(n: int) -> str:
+    """Human byte figure for EXPLAIN ANALYZE memory lines (KiB below one
+    MiB, else MiB — mirroring the reference's humanizeutil sizes)."""
+    n = int(n)
+    if n < 1 << 20:
+        return f"{n / 1024:.1f} KiB"
+    return f"{n / (1 << 20):.1f} MiB"
+
+
 def explain_analyze(plan: S.PlanNode, root_op) -> str:
     """Render the plan tree with executed ComponentStats (EXPLAIN ANALYZE).
     `root_op` must have been run with collect_stats(True)."""
@@ -125,11 +134,16 @@ def explain_analyze(plan: S.PlanNode, root_op) -> str:
         op = unwrap(op)
         st = op.stats
         excl = st.exclusive(op.children())
+        # memory-account annotations (mon.BoundAccount high-water): only
+        # buffering operators open accounts, so most lines carry neither
+        mem = (f" max mem={_fmt_bytes(st.max_mem_bytes)}"
+               if getattr(st, "max_mem_bytes", 0) else "")
+        spill = " spilled" if getattr(st, "spilled", False) else ""
         lines.append(
             "  " * depth + "-> " + _node_label(n)
             + f"  [rows={st.rows} batches={st.batches} "
             f"bytes={st.bytes} "
-            f"time={st.time_s*1e3:.1f}ms self={excl*1e3:.1f}ms]"
+            f"time={st.time_s*1e3:.1f}ms self={excl*1e3:.1f}ms{mem}{spill}]"
             + _group_tag(groups, n)
         )
         for c, co in zip(_children(n), op.children()):
@@ -145,6 +159,13 @@ def explain_analyze(plan: S.PlanNode, root_op) -> str:
     if tsp is not None:
         lines.append("trace:")
         lines.append(tsp.tree(indent=1))
+    # query peak-memory footer (the statement monitor's high water, set by
+    # flow/runtime.py) BEFORE the dispatch lines, which stay last
+    peak = getattr(root_op, "_query_mem_peak", 0)
+    if peak:
+        spills = getattr(root_op, "_query_mem_spills", 0)
+        suffix = f" (spills: {spills})" if spills else ""
+        lines.append(f"query peak memory: {_fmt_bytes(peak)}{suffix}")
     kd = getattr(getattr(root_op, "stats", None), "kernel_dispatches", 0)
     if kd:
         lines.append(f"kernel dispatches: {kd}")

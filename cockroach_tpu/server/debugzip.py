@@ -33,6 +33,7 @@ _ENDPOINTS = {
     "contention.json": "/_status/contention",
     "spans.json": "/_status/spans",
     "diagnostics.json": "/_status/diagnostics",
+    "load.json": "/_status/load",
 }
 
 
@@ -65,6 +66,7 @@ def _process_files() -> dict[str, str]:
     from ..sql import diagnostics as diag
     from ..sql import sqlstats
     from ..utils import metric, settings, tracing
+    from .http import load_payload
 
     files = {
         "metrics.txt": metric.DEFAULT.scrape(),
@@ -81,6 +83,7 @@ def _process_files() -> dict[str, str]:
         ]}, indent=1),
         "diagnostics.json": json.dumps({"bundles": diag.bundles()},
                                        indent=1),
+        "load.json": json.dumps(load_payload(), indent=1, default=str),
     }
     for b in diag.bundles():
         full = diag.get(b["id"])

@@ -47,9 +47,15 @@ _CONSOLE_HTML = b"""<!doctype html><html><head>
 <h2>jobs</h2><table id="jobs"></table>
 <h2>statements</h2><table id="stmts"></table>
 <h2>contention</h2><table id="cont"></table>
+<h2>memory / load</h2><table id="load"></table>
 <h2>metrics (/_status/vars)</h2><pre id="vars"></pre>
 <script>
 async function j(p){return (await fetch(p)).json()}
+function mvar(text,name){
+ const m=text.match(new RegExp('^'+name+' ([0-9.eE+-]+)$','m'));
+ return m?Number(m[1]):0;
+}
+function mib(n){return (n/1048576).toFixed(1)+' MiB'}
 async function refresh(){
  const h=await j('/health');
  document.getElementById('health').innerHTML=
@@ -79,14 +85,60 @@ async function refresh(){
   '<tr><th>key</th><th>count</th><th>waiters</th></tr>'+ce.map(e=>
   `<tr><td>${e.key}</td><td>${e.count}</td>`+
   `<td>${e.numWaiters}</td></tr>`).join('');
- document.getElementById('vars').textContent=
-  await (await fetch('/_status/vars')).text();
+ const vt=await (await fetch('/_status/vars')).text();
+ document.getElementById('load').innerHTML=
+  '<tr><th>sql mem current</th><th>sql mem max</th>'+
+  '<th>admission slots in use</th><th>queue depth</th></tr>'+
+  `<tr><td>${mib(mvar(vt,'sql_mem_current'))}</td>`+
+  `<td>${mib(mvar(vt,'sql_mem_max'))}</td>`+
+  `<td>${mvar(vt,'admission_sql_slots_in_use')}`+
+  ` / ${mvar(vt,'admission_sql_slots')}</td>`+
+  `<td>${mvar(vt,'admission_sql_queue_depth')}</td></tr>`;
+ document.getElementById('vars').textContent=vt;
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
 
 
 from ..utils.errors import retry_past_intents as _status_read  # noqa: E402
+
+
+def load_payload(node=None) -> dict:
+    """The /_status/load body: the node's resource plane in one JSON —
+    memory-monitor tree, physical device stats, admission queue state and
+    live session/query counts. Module-level (not an AdminServer method) so
+    debug zip can capture it without a running server."""
+    from ..flow import memory
+    from ..sql import activity
+    from ..utils import admission
+
+    q = admission.sql_queue()
+    out = {
+        "memory": {
+            "currentBytes": memory.ROOT.used,
+            "peakBytes": memory.ROOT.high_water,
+            "rootBudgetBytes": memory.root_budget(),
+            "pressure": round(memory.mem_pressure(), 4),
+            "queryLeaks": memory.drain_failure_count(),
+            "monitors": memory.monitor_rows(),
+        },
+        "device": memory.device_memory_stats(),
+        "admission": {
+            "slots": q.slots,
+            "slotsInUse": q.in_use,
+            "queueDepth": q.queue_depth,
+            "admitted": q.admitted,
+            "waited": q.waited,
+            "timeouts": q.timeouts,
+        },
+        "activity": {
+            "sessions": len(activity.sessions()),
+            "activeQueries": len(activity.queries()),
+        },
+    }
+    if node is not None:
+        out["nodeId"] = node.node_id
+    return out
 
 
 class AdminServer:
@@ -205,6 +257,10 @@ class AdminServer:
             for d in meta.snapshot()
         ]}
 
+    def load(self) -> dict:
+        """Resource/serving-load snapshot (/_status/load)."""
+        return load_payload(self.node)
+
     def ts_query(self, name: str, start_ms: int, end_ms: int) -> dict:
         pts = self.node.tsdb.query(name, start_ms=start_ms, end_ms=end_ms)
         return {"name": name,
@@ -234,7 +290,7 @@ class AdminServer:
             def do_GET(self):  # noqa: N802
                 try:
                     u = urlparse(self.path)
-                    if u.path in ("/", "/index.html"):
+                    if u.path in ("/", "/index.html", "/_status/ui"):
                         self._reply(200, _CONSOLE_HTML,
                                     "text/html; charset=utf-8")
                     elif u.path in ("/health", "/healthz"):
@@ -268,6 +324,8 @@ class AdminServer:
                             self._json(admin.diagnostics())
                     elif u.path == "/_status/spans":
                         self._json(admin.spans())
+                    elif u.path == "/_status/load":
+                        self._json(admin.load())
                     elif u.path == "/ts/query":
                         q = parse_qs(u.query)
                         name = (q.get("name") or [""])[0]

@@ -384,6 +384,14 @@ class Node:
             if self._stop.wait(iv):
                 return
             try:
+                # re-publish the pull-style gauges (memory monitors,
+                # admission queue) so each scrape records live values even
+                # when nothing ran since the last tick
+                from ..flow import memory as flowmem
+                from ..utils import admission
+
+                flowmem.refresh_gauges()
+                admission.refresh_gauges()
                 self.tsdb.record(metric.DEFAULT)
                 retention = settings.get("ts.retention_seconds")
                 # prune at ~1/10 the scrape cadence: a retention trim scans

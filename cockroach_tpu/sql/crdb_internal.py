@@ -77,6 +77,63 @@ def _stmt_statistics(catalog) -> Table:
          _floats(r.percentile(0.99) * 1e3 for r in rows)),
         ("rows_returned", T.INT64, _ints(r.rows for r in rows)),
         ("errors", T.INT64, _ints(r.errors for r in rows)),
+        ("max_mem_mb", T.FLOAT64,
+         _floats(r.max_mem_bytes / (1 << 20) for r in rows)),
+        ("mem_p50_mb", T.FLOAT64,
+         _floats(r.percentile_mem(0.50) / (1 << 20) for r in rows)),
+        ("mem_p99_mb", T.FLOAT64,
+         _floats(r.percentile_mem(0.99) / (1 << 20) for r in rows)),
+        ("spills", T.INT64, _ints(r.spills for r in rows)),
+    ])
+
+
+def _memory_monitors(catalog) -> Table:
+    """The live mon.BytesMonitor tree, depth-first — the reference's
+    crdb_internal.node_memory_monitors (crdb_internal.go's monitor walk)."""
+    from ..flow import memory
+
+    rows = memory.monitor_rows()
+    return _table("crdb_internal.node_memory_monitors", [
+        ("name", T.STRING, _strs(r["name"] for r in rows)),
+        ("level", T.STRING, _strs(r["level"] for r in rows)),
+        ("depth", T.INT64, _ints(r["depth"] for r in rows)),
+        ("used_bytes", T.INT64, _ints(r["used"] for r in rows)),
+        ("peak_bytes", T.INT64, _ints(r["peak"] for r in rows)),
+        ("budget_bytes", T.INT64, _ints(r["budget"] for r in rows)),
+        ("spills", T.INT64, _ints(r["spills"] for r in rows)),
+    ])
+
+
+def _cluster_load(catalog) -> Table:
+    """One-row serving-load snapshot: sessions/queries in flight, the
+    node's SQL memory figures, admission queue state, and the physical
+    device cross-check where the backend reports it."""
+    from . import activity
+    from ..flow import memory
+    from ..utils import admission, metric
+
+    q = admission.sql_queue()
+    dev = memory.device_memory_stats()
+    sess = activity.sessions()
+    queries = activity.queries()
+    cols = {
+        "active_sessions": len(sess),
+        "active_queries": len(queries),
+        "sql_mem_current_bytes": memory.ROOT.used,
+        "sql_mem_peak_bytes": memory.ROOT.high_water,
+        "sql_mem_budget_bytes": memory.root_budget(),
+        "admission_slots": q.slots,
+        "admission_slots_in_use": q.in_use,
+        "admission_queue_depth": q.queue_depth,
+        "admission_admitted": q.admitted,
+        "admission_waited": q.waited,
+        "admission_timeouts": q.timeouts,
+        "device_bytes_in_use": dev.get("bytes_in_use", 0),
+        "device_peak_bytes": dev.get("peak_bytes_in_use", 0),
+        "queries_total": int(metric.QUERIES.value),
+    }
+    return _table("crdb_internal.cluster_load", [
+        (k, T.INT64, _ints([v])) for k, v in cols.items()
     ])
 
 
@@ -195,6 +252,8 @@ _BUILDERS = {
     "crdb_internal.node_metrics": _node_metrics,
     "crdb_internal.node_inflight_trace_spans": _inflight_trace_spans,
     "crdb_internal.hot_ranges": _hot_ranges,
+    "crdb_internal.node_memory_monitors": _memory_monitors,
+    "crdb_internal.cluster_load": _cluster_load,
 }
 
 

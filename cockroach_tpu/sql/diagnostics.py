@@ -83,7 +83,7 @@ def capture(session, text: str, *, elapsed_s: float, span=None,
 
 def _capture(session, text: str, *, elapsed_s: float, span,
              trigger: str, error: bool) -> dict:
-    from ..flow import dispatch
+    from ..flow import dispatch, memory
 
     bid = next(_ids)
     bundle = {
@@ -98,6 +98,18 @@ def _capture(session, text: str, *, elapsed_s: float, span,
             "kernelDispatches": dispatch.total(),
             "kernelCompiles": dispatch.compiles(),
             "kernelCacheHits": dispatch.kernel_cache_hits(),
+        },
+        "memory": {
+            # resource side of the bundle: node-level figures plus the
+            # capturing session's monitor (the statement's own query
+            # monitor has already closed by the time capture runs)
+            "sqlMemCurrentBytes": memory.ROOT.used,
+            "sqlMemPeakBytes": memory.ROOT.high_water,
+            "sessionPeakBytes": getattr(
+                getattr(session, "_mem_mon", None), "high_water", 0),
+            "sessionSpills": getattr(
+                getattr(session, "_mem_mon", None), "spills", 0),
+            "device": memory.device_memory_stats(),
         },
         "settings": {
             name: s.get()

@@ -130,6 +130,14 @@ class Session:
         self._active_qid = None
         self._last_fp = None
         self.catalog._crdb_db = self.db
+        # this session's node in the memory-monitor tree: statements open
+        # query monitors under it, so the session's used/peak aggregate
+        # every statement's operator accounts (mon.BytesMonitor session
+        # tier)
+        from ..flow import memory as flowmem
+
+        self._mem_mon = flowmem.session_monitor(
+            f"session-{self._session_id}")
 
     def close(self) -> None:
         """Drop this session from the live registry (idempotent; a session
@@ -137,6 +145,7 @@ class Session:
         from . import activity
 
         activity.deregister_session(self._session_id)
+        self._mem_mon.close()
 
     def _set_phase(self, phase: str) -> None:
         if self._active_qid is not None:
@@ -158,19 +167,25 @@ class Session:
         import time as _time
 
         from . import activity, sqlstats
-        from ..utils import tracing
+        from ..flow import memory as flowmem
+        from ..utils import admission, tracing
 
         t0 = _time.perf_counter()
         self._active_qid = activity.begin_query(self._session_id, text)
         self._last_fp = None
         err = False
         sp = None
+        qmon = None
         try:
-            # the root span of the statement's trace: everything below —
-            # parse/bind, plan-cache lookup, flow pull, KV batches, WAL
-            # appends — nests under it via the contextvar
-            with tracing.span("sql.execute",
-                              stmt=text.strip()[:120]) as sp:
+            # admission first (queue-wait is NOT query memory or trace
+            # time), then the statement's query monitor under this
+            # session's tier, then the root span of the statement's trace:
+            # everything below — parse/bind, plan-cache lookup, flow pull,
+            # KV batches, WAL appends — nests under them via contextvars
+            with admission.sql_slot(), \
+                    flowmem.query_scope(self._mem_mon) as qmon, \
+                    tracing.span("sql.execute",
+                                 stmt=text.strip()[:120]) as sp:
                 out = self._dispatch(text)
         except BaseException:
             # ANY failure inside an explicit block aborts it (postgres /
@@ -183,9 +198,15 @@ class Session:
             activity.end_query(self._active_qid)
             self._active_qid = None
             elapsed = _time.perf_counter() - t0
+            # peak/spills survive the monitor's close (read them off the
+            # closed query monitor — the scope exited above)
+            mem_peak = getattr(qmon, "high_water", 0)
+            mem_spills = getattr(qmon, "spills", 0)
             if err:
                 sqlstats.DEFAULT.record(text, elapsed, 0, error=True,
-                                        fp=self._last_fp)
+                                        fp=self._last_fp,
+                                        mem_bytes=mem_peak,
+                                        spills=mem_spills)
                 self._maybe_slow_query(text, elapsed, sp, error=True)
         nrows = 0
         if isinstance(out, dict) and out:
@@ -195,7 +216,8 @@ class Session:
                 first = next(iter(out.values()))
                 if hasattr(first, "__len__") and not isinstance(first, str):
                     nrows = len(first)
-        sqlstats.DEFAULT.record(text, elapsed, nrows, fp=self._last_fp)
+        sqlstats.DEFAULT.record(text, elapsed, nrows, fp=self._last_fp,
+                                mem_bytes=mem_peak, spills=mem_spills)
         self._maybe_slow_query(text, elapsed, sp)
         return out
 

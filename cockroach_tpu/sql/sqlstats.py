@@ -41,6 +41,12 @@ def fingerprint(sql: str) -> str:
 # percentiles from two snapshots are comparable.
 _LAT_BUCKETS: tuple[float, ...] = tuple(0.0001 * 2 ** i for i in range(20))
 
+# fixed log-scale peak-memory buckets: 4 KiB doubling to 8 GiB — the
+# per-fingerprint resource twin of the latency histogram, so statement
+# pages can show p50/p99 peak HBM next to p50/p99 latency
+_MEM_BUCKETS: tuple[float, ...] = tuple(float(4096 * 2 ** i)
+                                        for i in range(22))
+
 
 @dataclass
 class StmtStats:
@@ -53,6 +59,14 @@ class StmtStats:
     errors: int = 0
     hist: list[int] = field(
         default_factory=lambda: [0] * (len(_LAT_BUCKETS) + 1))
+    # query peak-memory accounting (monitor-tree high water per execution);
+    # mem_count tracks executions that reported a peak (older recordings
+    # and error paths may not), so percentiles stay truthful
+    max_mem_bytes: int = 0
+    spills: int = 0
+    mem_count: int = 0
+    mem_hist: list[int] = field(
+        default_factory=lambda: [0] * (len(_MEM_BUCKETS) + 1))
 
     @property
     def mean_s(self) -> float:
@@ -62,6 +76,14 @@ class StmtStats:
         import bisect
 
         self.hist[bisect.bisect_left(_LAT_BUCKETS, elapsed_s)] += 1
+
+    def observe_mem(self, peak_bytes: int) -> None:
+        import bisect
+
+        self.mem_count += 1
+        self.max_mem_bytes = max(self.max_mem_bytes, int(peak_bytes))
+        self.mem_hist[bisect.bisect_left(_MEM_BUCKETS,
+                                         float(peak_bytes))] += 1
 
     def percentile(self, q: float) -> float:
         """Latency quantile in seconds from the bucket counts (upper bucket
@@ -79,6 +101,21 @@ class StmtStats:
                 return min(edge, self.max_s)
         return self.max_s
 
+    def percentile_mem(self, q: float) -> float:
+        """Peak-memory quantile in bytes (same convention as
+        :meth:`percentile`, clamped to the observed max peak)."""
+        if not self.mem_count:
+            return 0.0
+        target = q * self.mem_count
+        seen = 0
+        for i, c in enumerate(self.mem_hist):
+            seen += c
+            if seen >= target:
+                edge = (_MEM_BUCKETS[i] if i < len(_MEM_BUCKETS)
+                        else float(self.max_mem_bytes))
+                return min(edge, float(self.max_mem_bytes))
+        return float(self.max_mem_bytes)
+
 
 class StatsRegistry:
     """Thread-safe per-fingerprint accumulation, capped like the
@@ -93,11 +130,15 @@ class StatsRegistry:
         self.evicted = 0
 
     def record(self, sql: str, elapsed_s: float, rows: int,
-               error: bool = False, fp: str | None = None) -> None:
+               error: bool = False, fp: str | None = None,
+               mem_bytes: int = 0, spills: int = 0) -> None:
         """Accumulate one execution. ``fp`` lets the plan cache supply the
         structural fingerprint of the entry that served the statement (its
         literal re-parameterization already proved `a=1` and `a=2` the
-        same plan), collapsing textual variants the regex would split."""
+        same plan), collapsing textual variants the regex would split.
+        ``mem_bytes`` is the execution's query-monitor peak (0 = the run
+        reported none, e.g. a settings statement); ``spills`` the number
+        of in-memory operators that swapped to external variants."""
         if fp is None:
             fp = fingerprint(sql)
         with self._lock:
@@ -116,6 +157,9 @@ class StatsRegistry:
             st.max_s = max(st.max_s, elapsed_s)
             st.rows += rows
             st.observe(elapsed_s)
+            if mem_bytes > 0:
+                st.observe_mem(mem_bytes)
+            st.spills += int(spills)
             if error:
                 st.errors += 1
 
@@ -125,7 +169,8 @@ class StatsRegistry:
 
         with self._lock:
             return sorted(
-                (dataclasses.replace(s, hist=list(s.hist))
+                (dataclasses.replace(s, hist=list(s.hist),
+                                     mem_hist=list(s.mem_hist))
                  for s in self._stats.values()),
                 key=lambda s: -s.total_s,
             )
@@ -139,7 +184,11 @@ class StatsRegistry:
              "maxMs": round(s.max_s * 1e3, 3),
              "p50Ms": round(s.percentile(0.50) * 1e3, 3),
              "p99Ms": round(s.percentile(0.99) * 1e3, 3),
-             "rows": s.rows, "errors": s.errors}
+             "rows": s.rows, "errors": s.errors,
+             "maxMemMb": round(s.max_mem_bytes / (1 << 20), 3),
+             "p50MemMb": round(s.percentile_mem(0.50) / (1 << 20), 3),
+             "p99MemMb": round(s.percentile_mem(0.99) / (1 << 20), 3),
+             "spills": s.spills}
             for s in self.all()
         ]
 

@@ -6,20 +6,32 @@ Pebble L0 health (io_load_listener.go) so writers slow down before the LSM
 inverts. Here the same two pieces at single-process scale:
 
 - ``WorkQueue``: bounded concurrency slots granted strictly by (priority,
-  arrival) order; released slots wake the highest-priority waiter.
-- ``IOGovernor``: watches the engine's L0 run count and computes a token
-  delay for write work once the LSM falls behind compaction (the
+  arrival) order; released slots wake the highest-priority waiter. Grant
+  vs timeout-withdrawal is decided atomically under the queue lock via an
+  explicit per-waiter grant flag: a waiter that times out while a grant
+  is racing in HANDS THE SLOT BACK (re-granted to the next waiter or
+  freed) and returns False — a timed-out admit never silently holds a
+  slot, and a granted slot is never leaked.
+- ``IOGovernor``: watches the engine's L0 run count AND the node's memory
+  pressure (flow/memory.py root monitor vs sql.mem.root_budget_bytes) and
+  computes a token delay for write work once either falls behind (the
   io_load_listener shape: back-pressure proportional to overload).
+
+The process-wide SQL queue (``sql_queue()`` / ``sql_slot()``) sits under
+sql/session.py: every statement takes a slot before executing, exporting
+queue depth / slots-in-use gauges and the admission_wait_seconds
+histogram (admission.sql.enabled / admission.sql.slots).
 """
 
 from __future__ import annotations
 
+import contextlib
 import heapq
 import itertools
 import threading
 import time
 
-from . import locks
+from . import locks, metric
 
 # work priorities (admissionpb ordering)
 LOW = 0
@@ -27,53 +39,139 @@ NORMAL = 10
 HIGH = 20
 
 
+class _Waiter:
+    """Queue entry. ``granted``/``withdrawn`` transitions happen only
+    under the WorkQueue lock, so exactly one of the two ever wins."""
+
+    __slots__ = ("event", "granted", "withdrawn")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.granted = False
+        self.withdrawn = False
+
+
 class WorkQueue:
     """Priority-ordered admission with bounded slots (WorkQueue +
-    slot-based GrantCoordinator)."""
+    slot-based GrantCoordinator). ``instrument=True`` exports the shared
+    admission gauges/histogram (only the process SQL queue sets it, so
+    test-local queues don't fight over the node metrics)."""
 
-    def __init__(self, slots: int = 4):
+    def __init__(self, slots: int = 4, instrument: bool = False):
         self._slots = slots
         self._used = 0
         self._lock = locks.lock("admission")
-        self._waiters: list = []  # heap of (-priority, seq, event)
+        # heap of (-priority, seq, _Waiter); withdrawn entries are skipped
+        # lazily at grant time instead of O(n) heap surgery on timeout
+        self._waiters: list = []
+        self._nwaiting = 0
         self._seq = itertools.count()
+        self._instrument = instrument
         self.admitted = 0
         self.waited = 0
+        self.timeouts = 0
+        if instrument:
+            metric.ADMISSION_SQL_SLOTS.set(slots)
+            self._publish()
+
+    @property
+    def slots(self) -> int:
+        return self._slots
+
+    @property
+    def in_use(self) -> int:
+        return self._used
+
+    @property
+    def queue_depth(self) -> int:
+        return self._nwaiting
+
+    def _publish(self) -> None:
+        # called under self._lock
+        if self._instrument:
+            metric.ADMISSION_SQL_SLOTS_IN_USE.set(self._used)
+            metric.ADMISSION_SQL_QUEUE_DEPTH.set(self._nwaiting)
+
+    def refresh_gauges(self) -> None:
+        """Re-publish gauges (background metrics scraper hook)."""
+        with self._lock:
+            if self._instrument:
+                metric.ADMISSION_SQL_SLOTS.set(self._slots)
+            self._publish()
+
+    def _grant_locked(self) -> bool:
+        """Hand the freed slot to the highest-priority live waiter; False
+        when no live waiter remains (caller frees the slot instead)."""
+        while self._waiters:
+            _, _, w = heapq.heappop(self._waiters)
+            if w.withdrawn:
+                continue  # timed out earlier; already uncounted
+            w.granted = True
+            w.event.set()
+            self._nwaiting -= 1
+            return True
+        return False
 
     def admit(self, priority: int = NORMAL, timeout: float | None = None
               ) -> bool:
-        """Block until a slot is granted (higher priority first)."""
+        """Block until a slot is granted (higher priority first). Returns
+        False only on timeout, in which case NO slot is held — a grant
+        racing the timeout is handed back under the lock."""
+        t0 = time.perf_counter()
         with self._lock:
             if self._used < self._slots and not self._waiters:
                 self._used += 1
                 self.admitted += 1
+                if self._instrument:
+                    # fast-path admissions observe too: the wait histogram
+                    # must count EVERY admission so queue-wait percentiles
+                    # reflect the workload, not just its queued tail
+                    metric.ADMISSION_WAIT_SECONDS.observe(
+                        time.perf_counter() - t0)
+                self._publish()
                 return True
-            ev = threading.Event()
-            heapq.heappush(self._waiters,
-                           (-priority, next(self._seq), ev))
+            w = _Waiter()
+            heapq.heappush(self._waiters, (-priority, next(self._seq), w))
+            self._nwaiting += 1
             self.waited += 1
-        if not ev.wait(timeout):
-            with self._lock:
-                # withdraw if still queued (timeout)
-                for i, (_, _, w) in enumerate(self._waiters):
-                    if w is ev:
-                        self._waiters.pop(i)
-                        heapq.heapify(self._waiters)
-                        return False
-            # granted between timeout and lock: keep the slot
-            self.admitted += 1
-            return True
+            self._publish()
+        granted = w.event.wait(timeout)
         with self._lock:
+            if not w.granted:
+                # pure timeout: withdraw (lazily — the heap entry is
+                # skipped at the next grant) and hold nothing
+                w.withdrawn = True
+                self._nwaiting -= 1
+                self.timeouts += 1
+                if self._instrument:
+                    metric.ADMISSION_SQL_TIMEOUTS.inc()
+                self._publish()
+                return False
+            if not granted and timeout is not None:
+                # the race: our event was set concurrently with the
+                # timeout expiring. The grant is definitive (flag set
+                # under this lock), but the caller asked for a deadline —
+                # hand the slot to the next waiter (or free it) and
+                # report the timeout instead of silently keeping it
+                if not self._grant_locked():
+                    self._used = max(0, self._used - 1)
+                self.timeouts += 1
+                if self._instrument:
+                    metric.ADMISSION_SQL_TIMEOUTS.inc()
+                self._publish()
+                return False
             self.admitted += 1
+            if self._instrument:
+                metric.ADMISSION_WAIT_SECONDS.observe(
+                    time.perf_counter() - t0)
+            self._publish()
         return True
 
     def release(self) -> None:
         with self._lock:
-            if self._waiters:
-                _, _, ev = heapq.heappop(self._waiters)
-                ev.set()  # hand the slot directly to the waiter
-            else:
+            if not self._grant_locked():
                 self._used = max(0, self._used - 1)
+            self._publish()
 
     def __enter__(self):
         self.admit()
@@ -84,10 +182,76 @@ class WorkQueue:
         return False
 
 
+# -- the process SQL admission queue (session statements) -------------------
+
+_SQL_QUEUE: WorkQueue | None = None
+_SQL_QUEUE_LOCK = threading.Lock()
+_TLS = threading.local()
+
+
+def sql_queue() -> WorkQueue:
+    """The node's shared statement-admission queue, sized by
+    admission.sql.slots at first use."""
+    global _SQL_QUEUE
+    with _SQL_QUEUE_LOCK:
+        if _SQL_QUEUE is None:
+            from . import settings
+
+            _SQL_QUEUE = WorkQueue(
+                slots=int(settings.get("admission.sql.slots")),
+                instrument=True)
+        return _SQL_QUEUE
+
+
+def refresh_gauges() -> None:
+    """Background metrics scraper hook: keep the admission gauges live
+    even when no statement has run since the last scrape."""
+    q = _SQL_QUEUE
+    if q is not None:
+        q.refresh_gauges()
+
+
+@contextlib.contextmanager
+def sql_slot(priority: int = NORMAL):
+    """Hold one SQL admission slot for the duration (Session.execute wraps
+    every statement in this). Yields the seconds spent queued. No-op when
+    admission.sql.enabled is off, and re-entrant per thread so a nested
+    statement (diagnostics re-run, internal executor) never deadlocks on
+    its own session's slot."""
+    from . import settings
+
+    if not settings.get("admission.sql.enabled"):
+        yield 0.0
+        return
+    depth = getattr(_TLS, "depth", 0)
+    if depth > 0:
+        _TLS.depth = depth + 1
+        try:
+            yield 0.0
+        finally:
+            _TLS.depth = depth
+        return
+    q = sql_queue()
+    t0 = time.perf_counter()
+    q.admit(priority)
+    wait = time.perf_counter() - t0
+    _TLS.depth = 1
+    try:
+        yield wait
+    finally:
+        _TLS.depth = 0
+        q.release()
+
+
 class IOGovernor:
-    """L0-health write back-pressure (io_load_listener reduction): when the
-    engine's run count exceeds the healthy threshold, write work pays a
-    delay proportional to the overload before proceeding."""
+    """L0-health + memory-pressure write back-pressure (io_load_listener
+    reduction): when the engine's run count exceeds the healthy threshold,
+    or the node's memory monitor runs hot against its budget, write work
+    pays a delay proportional to the overload before proceeding."""
+
+    # memory pressure past this fraction of sql.mem.root_budget_bytes
+    # starts adding write delay (full budget = 10 runs' worth of delay)
+    MEM_PRESSURE_FLOOR = 0.85
 
     def __init__(self, engine, healthy_runs: int | None = None,
                  delay_per_run_s: float = 0.001):
@@ -101,9 +265,21 @@ class IOGovernor:
         self.delay_per_run_s = delay_per_run_s
         self.throttled = 0
 
+    def mem_delay_s(self) -> float:
+        from ..flow import memory as flowmem
+
+        p = flowmem.mem_pressure()
+        over = p - self.MEM_PRESSURE_FLOOR
+        if over <= 0:
+            return 0.0
+        # scales 0 -> 10 runs' worth of delay across the remaining
+        # headroom, so a nearly-full monitor brakes writes hard
+        return (over / (1.0 - self.MEM_PRESSURE_FLOOR)
+                ) * 10 * self.delay_per_run_s
+
     def write_delay_s(self) -> float:
         over = len(self.engine.runs) - self.healthy_runs
-        return max(0, over) * self.delay_per_run_s
+        return max(0, over) * self.delay_per_run_s + self.mem_delay_s()
 
     def pace_write(self) -> float:
         """The single admission gate for engine write paths (put/ingest):

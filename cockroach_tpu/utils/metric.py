@@ -282,3 +282,49 @@ PLAN_CACHE_EVICTIONS = DEFAULT.counter(
     "sql_plan_cache_evictions",
     "prepared plans dropped by LRU capacity or catalog-version bumps "
     "(DDL invalidation)")
+SQL_MEM_CURRENT = DEFAULT.gauge(
+    "sql_mem_current",
+    "logical SQL bytes currently reserved against the node's root memory "
+    "monitor (flow/memory.py BytesMonitor tree)")
+SQL_MEM_MAX = DEFAULT.gauge(
+    "sql_mem_max",
+    "high water of sql_mem_current since process start (the root "
+    "monitor's peak reservation)")
+SQL_MEM_QUERY_PEAK = DEFAULT.histogram(
+    "sql_mem_query_peak_bytes",
+    "per-query peak logical memory at query-monitor close (bytes)",
+    buckets=(1 << 12, 1 << 16, 1 << 20, 1 << 22, 1 << 24, 1 << 26,
+             1 << 28, 1 << 30, 1 << 32, 1 << 34))
+SQL_MEM_QUERY_LEAKS = DEFAULT.counter(
+    "sql_mem_query_leaks",
+    "query memory monitors that closed with bytes still reserved (an "
+    "operator failed to release its account — always a bug; "
+    "scripts/check_no_leaks.py asserts this stays flat)")
+EXTERNAL_SORT_SPILLS = DEFAULT.counter(
+    "sql_external_sort_spills",
+    "sorts that exceeded workmem and spilled to the external "
+    "range-partitioned sort")
+GRACE_JOIN_SPILLS = DEFAULT.counter(
+    "sql_grace_join_spills",
+    "hash joins whose build side exceeded workmem and spilled to the "
+    "Grace hash join")
+ADMISSION_SQL_SLOTS = DEFAULT.gauge(
+    "admission_sql_slots",
+    "configured concurrency slots of the SQL admission WorkQueue "
+    "(admission.sql.slots)")
+ADMISSION_SQL_SLOTS_IN_USE = DEFAULT.gauge(
+    "admission_sql_slots_in_use",
+    "SQL admission slots currently granted to executing statements")
+ADMISSION_SQL_QUEUE_DEPTH = DEFAULT.gauge(
+    "admission_sql_queue_depth",
+    "statements waiting in the SQL admission queue for a slot")
+ADMISSION_WAIT_SECONDS = DEFAULT.histogram(
+    "admission_wait_seconds",
+    "time statements spent queued in SQL admission before their slot "
+    "was granted",
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5,
+             10, 60))
+ADMISSION_SQL_TIMEOUTS = DEFAULT.counter(
+    "admission_sql_timeouts",
+    "admission waits that hit their timeout and withdrew (any "
+    "concurrently granted slot is handed back, never leaked)")

@@ -209,6 +209,26 @@ PALLAS_MERGE = register_enum(
     "on CPU, for parity testing); 'off' forces concat+sort",
     choices=("auto", "on", "off"),
 )
+SQL_ADMISSION = register_bool(
+    "admission.sql.enabled", True,
+    "SQL admission control: every session statement takes a slot from the "
+    "shared WorkQueue before executing (work_queue.go role); queue depth "
+    "and wait land in admission_sql_queue_depth / admission_wait_seconds",
+)
+SQL_ADMISSION_SLOTS = register_int(
+    "admission.sql.slots", 64,
+    "concurrency slots of the SQL admission WorkQueue (the slot-based "
+    "GrantCoordinator's size); statements past this run in (priority, "
+    "arrival) order as slots free up",
+    lo=1,
+)
+SQL_MEM_ROOT_BUDGET = register_int(
+    "sql.mem.root_budget_bytes", 0,
+    "node-level logical-byte budget for the root memory monitor "
+    "(--max-sql-memory role). 0 = unlimited: the tree still tracks "
+    "usage/peaks, and mem_pressure() (read by the IOGovernor) reports 0",
+    lo=0,
+)
 IO_PACING = register_bool(
     "admission.io_pacing.enabled", True,
     "write admission control: engine writes pay a delay proportional to "

@@ -1,0 +1,151 @@
+"""Bench-regression gate — compare a fresh BENCH JSON against the roofline.
+
+The repo keeps one ``BENCH_r*.json`` per recorded bench run (wrapper dict
+with the parsed one-line bench JSON under ``"parsed"``). This checker
+takes a FRESH bench emission (a file holding ``python bench.py``'s one
+JSON line, or ``-`` for stdin) and diffs its throughput surface against
+the newest recorded baseline:
+
+- every throughput series (any key ending ``_per_sec``, plus the
+  top-level geomean ``value``) that dropped more than the threshold
+  (default 20%) is flagged as a regression;
+- every metric present in the baseline but ABSENT from the fresh run is
+  flagged — a bench refactor that silently stops emitting a series must
+  not pass as "no regressions".
+
+Both runs must come from the same platform (a cpu-fallback run diffed
+against a tpu baseline would flag everything); mismatches flag, they do
+not silently pass.
+
+Usage (tier-2, run_chaos_matrix.py-style — not part of the tier-1 pytest
+sweep; run it after a bench session, before committing a BENCH file):
+
+    python bench.py > /tmp/bench_fresh.json
+    python scripts/check_bench_regress.py /tmp/bench_fresh.json
+    python scripts/check_bench_regress.py --threshold 0.3 /tmp/fresh.json
+    python bench.py | python scripts/check_bench_regress.py -
+
+Exit code is non-zero if ANY regression or missing metric is flagged; the
+flags print one per line so the offending series are greppable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def latest_baseline(repo_root: str = _REPO_ROOT) -> tuple[str, dict] | None:
+    """Newest BENCH_r*.json's parsed bench dict (path, parsed); None when
+    no baseline has been recorded yet (first run is a free pass)."""
+    paths = sorted(glob.glob(os.path.join(repo_root, "BENCH_r*.json")))
+    if not paths:
+        return None
+    path = paths[-1]
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    # recorded files wrap the bench line under "parsed"; accept a bare
+    # bench dict too so old/raw captures also work as baselines
+    return path, doc.get("parsed", doc)
+
+
+def flatten_throughput(bench: dict) -> dict[str, float]:
+    """{series name: value} for every throughput figure in a bench dict:
+    the top-level geomean plus each detail entry's *_per_sec keys."""
+    out: dict[str, float] = {}
+    if isinstance(bench.get("value"), (int, float)):
+        out["value"] = float(bench["value"])
+    for dname, d in (bench.get("detail") or {}).items():
+        if not isinstance(d, dict):
+            continue
+        for k, v in d.items():
+            if k.endswith("_per_sec") and isinstance(v, (int, float)):
+                out[f"{dname}.{k}"] = float(v)
+    return out
+
+
+def compare(fresh: dict, baseline: dict, threshold: float = 0.2
+            ) -> list[str]:
+    """Flags (empty = clean): >threshold throughput drops vs baseline and
+    baseline series missing from the fresh run."""
+    flags: list[str] = []
+    # comparability gate: the metric name encodes scale factor + platform
+    # (tpch_sf0.5_cpu_...), so differing names means the runs measured
+    # different configurations — flag, don't diff apples to oranges
+    bname, fname = baseline.get("metric", ""), fresh.get("metric", "")
+    if bname and fname and bname != fname:
+        flags.append(
+            f"config mismatch: baseline {bname!r} vs fresh {fname!r} "
+            "(not comparable)")
+        return flags
+    base_t = flatten_throughput(baseline)
+    fresh_t = flatten_throughput(fresh)
+    for name, bval in sorted(base_t.items()):
+        fval = fresh_t.get(name)
+        if fval is None:
+            flags.append(f"missing metric: {name} (baseline {bval:g})")
+            continue
+        if bval > 0 and fval < bval * (1.0 - threshold):
+            drop = 100.0 * (1.0 - fval / bval)
+            flags.append(
+                f"regression: {name} {bval:g} -> {fval:g} "
+                f"(-{drop:.1f}% > {threshold:.0%} threshold)")
+    return flags
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="flag >threshold throughput regressions vs the newest "
+                    "recorded BENCH_r*.json")
+    ap.add_argument("fresh", help="fresh bench JSON file, or - for stdin")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="fractional drop that counts as a regression "
+                         "(default 0.2 = 20%%)")
+    ap.add_argument("--baseline", default=None,
+                    help="explicit baseline file (default: newest "
+                         "BENCH_r*.json in the repo root)")
+    args = ap.parse_args(argv)
+
+    raw = (sys.stdin.read() if args.fresh == "-"
+           else open(args.fresh, encoding="utf-8").read())
+    # bench.py's contract is ONE JSON line, but stderr passthrough means a
+    # captured file may carry '#' progress lines — take the last JSON line
+    fresh = None
+    for line in raw.strip().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            fresh = json.loads(line)
+    if fresh is None:
+        print("no JSON object found in fresh input", file=sys.stderr)
+        return 2
+
+    if args.baseline is not None:
+        with open(args.baseline, encoding="utf-8") as f:
+            doc = json.load(f)
+        bpath, baseline = args.baseline, doc.get("parsed", doc)
+    else:
+        found = latest_baseline()
+        if found is None:
+            print("no BENCH_r*.json baseline recorded; nothing to compare")
+            return 0
+        bpath, baseline = found
+
+    flags = compare(fresh, baseline, args.threshold)
+    if flags:
+        print(f"bench regressions vs {os.path.basename(bpath)}:")
+        for fl in flags:
+            print(f"  {fl}")
+        return 1
+    n = len(flatten_throughput(baseline))
+    print(f"ok: {n} throughput series within {args.threshold:.0%} of "
+          f"{os.path.basename(bpath)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,9 +2,14 @@
 
 Reference: CockroachDB wraps every test in pkg/testutils/leaktest, which
 snapshots goroutines before the test and fails if new ones survive it.
-Here the census covers the two resources the socket plane can leak:
-live threads (threading.enumerate) and open socket fds (/proc/self/fd
-symlinks pointing at socket inodes).
+Here the census covers three resources: live threads
+(threading.enumerate), open socket fds (/proc/self/fd symlinks pointing
+at socket inodes), and memory-monitor drain failures — a query-level
+BytesMonitor (flow/memory.py) that closed with bytes still reserved is a
+leaked account, the mon.BytesMonitor "monitor closed with outstanding
+bytes" assertion. The drain counter is monotonic, so the census compares
+totals: any increase between snapshots means some query in between
+failed to drain to zero.
 
 Usage (chaos + dcn tests):
 
@@ -35,6 +40,20 @@ class Census:
     threads: frozenset[str]
     n_threads: int
     socket_fds: int
+    # cumulative count of query memory monitors that closed non-drained
+    # (flow/memory.drain_failure_count); default keeps old snapshots valid
+    mem_drain_failures: int = 0
+
+
+def _drain_failure_count() -> int:
+    """Query-monitor drain failures so far (0 when the memory plane has
+    not been imported — the census must not force it in)."""
+    import sys
+
+    mod = sys.modules.get("cockroach_tpu.flow.memory")
+    if mod is None:
+        return 0
+    return mod.drain_failure_count()
 
 
 def _socket_fd_count() -> int:
@@ -55,7 +74,8 @@ def _socket_fd_count() -> int:
 def snapshot() -> Census:
     threads = frozenset(
         f"{t.name}:{t.ident}" for t in threading.enumerate())
-    return Census(threads, len(threads), _socket_fd_count())
+    return Census(threads, len(threads), _socket_fd_count(),
+                  _drain_failure_count())
 
 
 def leaks(before: Census) -> list[str]:
@@ -72,6 +92,15 @@ def leaks(before: Census) -> list[str]:
     if now.socket_fds > before.socket_fds:
         out.append(
             f"socket fds leaked: {before.socket_fds} -> {now.socket_fds}")
+    if now.mem_drain_failures > before.mem_drain_failures:
+        import sys
+
+        mod = sys.modules.get("cockroach_tpu.flow.memory")
+        recent = mod.drain_failures(last=3) if mod is not None else []
+        out.append(
+            "memory monitors closed non-drained: "
+            f"{before.mem_drain_failures} -> {now.mem_drain_failures}"
+            + (f" (recent: {recent})" if recent else ""))
     return out
 
 
@@ -89,6 +118,7 @@ def assert_no_leaks(before: Census, grace_s: float = 5.0) -> None:
 
 if __name__ == "__main__":
     c = snapshot()
-    print(f"threads={c.n_threads} socket_fds={c.socket_fds}")
+    print(f"threads={c.n_threads} socket_fds={c.socket_fds} "
+          f"mem_drain_failures={c.mem_drain_failures}")
     for t in sorted(c.threads):
         print(f"  {t}")

@@ -35,3 +35,24 @@ def pytest_configure(config):
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _memory_drain_census():
+    """leaktest analog for the memory-monitor tree: every query-level
+    monitor must drain to zero by the time its query scope closes. The
+    drain-failure counter (flow/memory.py) is monotonic, so any increase
+    across a test means that test leaked reserved bytes — fail it, with
+    the offending monitors named (scripts/check_no_leaks.py carries the
+    same census for standalone harnesses)."""
+    from scripts.check_no_leaks import _drain_failure_count
+
+    before = _drain_failure_count()
+    yield
+    after = _drain_failure_count()
+    if after > before:
+        from cockroach_tpu.flow import memory
+
+        raise AssertionError(
+            f"query memory monitors closed non-drained ({before} -> "
+            f"{after}): {memory.drain_failures(last=after - before)}")

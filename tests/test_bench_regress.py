@@ -1,0 +1,98 @@
+"""Bench-regression gate (scripts/check_bench_regress.py) and the
+histogram-quantile helper the load harness recovers queue-wait from."""
+
+from __future__ import annotations
+
+import json
+
+from scripts.check_bench_regress import (compare, flatten_throughput,
+                                         latest_baseline, main)
+
+_BASE = {
+    "metric": "tpch_sf0.5_cpu_geomean_rows_per_sec",
+    "value": 1000,
+    "detail": {
+        "q1": {"rows_per_sec": 500, "vs_pandas": 2.0},
+        "ycsb_e_1m": {"ops_per_sec": 2000.0, "compactions": 3},
+        "mixed_load": {"ops_per_sec": 40.0, "p99_queue_wait_ms": 1.0},
+    },
+}
+
+
+def test_flatten_throughput_picks_per_sec_series():
+    flat = flatten_throughput(_BASE)
+    assert flat == {
+        "value": 1000.0,
+        "q1.rows_per_sec": 500.0,
+        "ycsb_e_1m.ops_per_sec": 2000.0,
+        "mixed_load.ops_per_sec": 40.0,
+    }  # vs_pandas / compactions / wait_ms are not throughput series
+
+
+def test_compare_clean_within_threshold():
+    fresh = json.loads(json.dumps(_BASE))
+    fresh["detail"]["q1"]["rows_per_sec"] = 420  # -16%: under the bar
+    assert compare(fresh, _BASE, threshold=0.2) == []
+
+
+def test_compare_flags_regressions_and_missing_series():
+    fresh = json.loads(json.dumps(_BASE))
+    fresh["detail"]["q1"]["rows_per_sec"] = 300  # -40%
+    del fresh["detail"]["ycsb_e_1m"]["ops_per_sec"]
+    flags = compare(fresh, _BASE, threshold=0.2)
+    assert any(f.startswith("regression: q1.rows_per_sec") for f in flags)
+    assert any(f.startswith("missing metric: ycsb_e_1m.ops_per_sec")
+               for f in flags)
+    assert len(flags) == 2
+    # a looser threshold forgives the drop but never the missing series
+    flags = compare(fresh, _BASE, threshold=0.5)
+    assert len(flags) == 1 and flags[0].startswith("missing metric")
+
+
+def test_compare_refuses_config_mismatch():
+    fresh = dict(_BASE, metric="tpch_sf1_tpu_geomean_rows_per_sec")
+    flags = compare(fresh, _BASE)
+    assert len(flags) == 1 and flags[0].startswith("config mismatch")
+
+
+def test_main_against_recorded_baseline(tmp_path, capsys):
+    """CLI shape: wrapper files ({"parsed": ...}) unwrap, '#' progress
+    lines in the fresh capture are skipped, exit codes gate."""
+    base = tmp_path / "BENCH_r01.json"
+    base.write_text(json.dumps({"n": 1, "parsed": _BASE}))
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text("# gen/load sf=0.5: ...\n" + json.dumps(_BASE) + "\n")
+    assert main([str(fresh), "--baseline", str(base)]) == 0
+    bad = json.loads(json.dumps(_BASE))
+    bad["detail"]["q1"]["rows_per_sec"] = 1
+    fresh.write_text(json.dumps(bad))
+    assert main([str(fresh), "--baseline", str(base)]) == 1
+    out = capsys.readouterr().out
+    assert "regression: q1.rows_per_sec" in out
+
+
+def test_latest_baseline_picks_newest(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"parsed": {"value": 1}}))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"parsed": {"value": 2}}))
+    path, parsed = latest_baseline(str(tmp_path))
+    assert path.endswith("BENCH_r02.json") and parsed["value"] == 2
+    assert latest_baseline(str(tmp_path / "empty")) is None
+
+
+def test_hist_quantile_from_bucket_deltas():
+    from cockroach_tpu.bench.load import hist_quantile_from_deltas
+
+    buckets = (0.001, 0.01, 0.1, 1.0)
+    before = [0, 0, 0, 0, 0]
+    # 90 observations <=1ms, 9 in (1ms,10ms], 1 in (10ms,100ms]
+    after = [90, 9, 1, 0, 0]
+    assert hist_quantile_from_deltas(buckets, before, after, 0.50) == 0.001
+    assert hist_quantile_from_deltas(buckets, before, after, 0.95) == 0.01
+    assert hist_quantile_from_deltas(buckets, before, after, 0.999) == 0.1
+    # no traffic between snapshots -> 0, not a stale figure
+    assert hist_quantile_from_deltas(buckets, after, after, 0.99) == 0.0
+    # overflow bucket reports the last finite bound (a floor)
+    assert hist_quantile_from_deltas(buckets, before,
+                                     [0, 0, 0, 0, 5], 0.99) == 1.0
