@@ -339,8 +339,14 @@ def _worker(job: str) -> None:
         print("RESULT " + json.dumps({
             "job": job, "platform": platform,
             "load_keys_per_sec": y["load_keys_per_sec"],
+            "put_keys_per_sec": y["put_keys_per_sec"],
+            "ingest_speedup": y["ingest_speedup"],
+            "bit_identical": y["bit_identical"],
             "scan_rows_per_sec": round(y["rows_per_sec"]),
             "ops_per_sec": round(y["ops_per_sec"], 1),
+            "point_ops_per_sec": y["point_ops_per_sec"],
+            "blockcache_hit_rate": y["blockcache_hit_rate"],
+            "bloom_skips": y["bloom_skips"],
             "compactions": y["compactions"],
         }), flush=True)
         return

@@ -274,8 +274,16 @@ def plan_create_index(catalog, db, stmt,
 def backfill_index(reg, job, catalog) -> None:
     """The create_index resumer: chunked entry writes + checkpoint + a
     fenced descriptor swap that makes the index visible (the
-    schemachange.py discipline; concurrent DML is out of scope, as there)."""
+    schemachange.py discipline; concurrent DML is out of scope, as there).
+
+    With storage.bulk_ingest.enabled, each chunk's entries encode
+    vectorized and land as a device-built run through the RunBuilder —
+    the reference's backfiller writes AddSSTables, not per-row txn puts.
+    The checkpoint/resume discipline is identical either way; re-running
+    a chunk after a crash just re-lands the same entries at a newer
+    timestamp."""
     from ..sql.schemachange import _fenced_job_read
+    from ..storage import ingest as bulk
     from .table import KVTable, write_descriptor
 
     payload = job.payload
@@ -287,6 +295,8 @@ def backfill_index(reg, job, catalog) -> None:
     tbl: KVTable = catalog.tables[payload["table"]]
     ix = IndexDesc(payload["index"], payload["col"], payload["index_id"])
     db = reg.db
+    use_bulk = (bulk.enabled()
+                and db.engine.key_width >= ENTRY_BYTES)
     start, end = rowcodec.table_span(tbl.table_id)
     last_pk = job.progress.get("last_pk")
     while True:
@@ -296,7 +306,8 @@ def backfill_index(reg, job, catalog) -> None:
         if not rows:
             break
 
-        def write_chunk(t, rows=rows):
+        if use_bulk:
+            pks_l, vals_l = [], []
             done = None
             for k, v in rows:
                 pk = rowcodec.decode_pk(k)
@@ -304,10 +315,29 @@ def backfill_index(reg, job, catalog) -> None:
                 row = rowcodec.decode_row(tbl.schema, v)
                 val = row.get(ix.col)
                 if val is not None:
-                    t.put(encode_entry(ix.index_id, int(val), pk), b"")
-            return done
+                    pks_l.append(pk)
+                    vals_l.append(int(val))
+            if vals_l:
+                ik = encode_entries(ix.index_id,
+                                    np.asarray(vals_l, np.int64),
+                                    np.asarray(pks_l, np.int64))
+                rb = bulk.RunBuilder(db.engine, db.clock.now())
+                rb.add(ik, np.zeros((len(ik), 0), np.uint8))
+                rb.finish()
+            last_pk = done
+        else:
+            def write_chunk(t, rows=rows):
+                done = None
+                for k, v in rows:
+                    pk = rowcodec.decode_pk(k)
+                    done = pk
+                    row = rowcodec.decode_row(tbl.schema, v)
+                    val = row.get(ix.col)
+                    if val is not None:
+                        t.put(encode_entry(ix.index_id, int(val), pk), b"")
+                return done
 
-        last_pk = db.txn(write_chunk)
+            last_pk = db.txn(write_chunk)
         job.progress["last_pk"] = int(last_pk)
         reg.checkpoint(job)
 

@@ -321,9 +321,22 @@ class KVTable:
         pks = np.asarray(cols[self.pk], dtype=np.int64)
         keys = rowcodec.encode_pk_batch(self.table_id, pks)
         values = rowcodec.encode_rows(self.schema, cols, valids)
-        for lo in range(0, n, chunk):
-            hi = min(lo + chunk, n)
-            self.db.engine.ingest(keys[lo:hi], values[lo:hi], ts=ts)
+        from ..storage import ingest as bulk
+
+        use_bulk = bulk.enabled()
+        if use_bulk:
+            # run-builder route: chunks accumulate into device-built
+            # sorted/deduped runs (storage/ingest.py) and link into the
+            # LSM with one WAL record per run
+            rb = bulk.RunBuilder(self.db.engine, ts)
+            for lo in range(0, n, chunk):
+                hi = min(lo + chunk, n)
+                rb.add(keys[lo:hi], values[lo:hi])
+            rb.finish()
+        else:
+            for lo in range(0, n, chunk):
+                hi = min(lo + chunk, n)
+                self.db.engine.ingest(keys[lo:hi], values[lo:hi], ts=ts)
         if self.indexes:
             # index runs ingest alongside the rows (IMPORT assumes fresh
             # pks — the insert path handles upsert tombstoning)
@@ -339,13 +352,21 @@ class KVTable:
                 ik = ixm.encode_entries(
                     ix.index_id, np.asarray(a, dtype=np.int64)[keep],
                     pks[keep])
-                # entries must land SORTED (ingest builds one sorted run)
-                order = np.lexsort(ik.T[::-1])
-                ik = ik[order]
                 iv = np.zeros((len(ik), 0), dtype=np.uint8)
-                for lo in range(0, len(ik), chunk):
-                    hi = min(lo + chunk, len(ik))
-                    self.db.engine.ingest(ik[lo:hi], iv[lo:hi], ts=ts)
+                if use_bulk:
+                    # the builder sorts device-side — no host lexsort
+                    rb = bulk.RunBuilder(self.db.engine, ts)
+                    for lo in range(0, len(ik), chunk):
+                        hi = min(lo + chunk, len(ik))
+                        rb.add(ik[lo:hi], iv[lo:hi])
+                    rb.finish()
+                else:
+                    # entries must land SORTED (ingest builds one run)
+                    order = np.lexsort(ik.T[::-1])
+                    ik = ik[order]
+                    for lo in range(0, len(ik), chunk):
+                        hi = min(lo + chunk, len(ik))
+                        self.db.engine.ingest(ik[lo:hi], iv[lo:hi], ts=ts)
         self._count_cache = None
         return n
 

@@ -42,7 +42,12 @@ def explain(catalog, text: str) -> str:
         elapsed = _time.perf_counter() - t0
         # status a NORMAL execution of this statement would see (analyze
         # itself always runs a fresh instrumented tree)
+        from ..storage import blockcache
+
         out = rendered + f"\nplan cache: {plancache.probe(rel)}"
+        # storage read-path health alongside the plan status: how much of
+        # this node's point/seek traffic the block cache absorbed
+        out += f"\nblock cache: {blockcache.node_cache().describe()}"
         if debug:
             from . import diagnostics
             from ..flow.runtime import last_trace_span

@@ -132,6 +132,19 @@ def _cluster_load(catalog) -> Table:
         "device_peak_bytes": dev.get("peak_bytes_in_use", 0),
         "queries_total": int(metric.QUERIES.value),
     }
+    # storage read/ingest plane: block-cache absorption, bloom pruning,
+    # and bulk-ingest volume for this node
+    from ..storage import blockcache
+
+    bc = blockcache.node_cache().stats()
+    cols.update({
+        "block_cache_hits": bc["hits"],
+        "block_cache_misses": bc["misses"],
+        "block_cache_evictions": bc["evictions"],
+        "block_cache_bytes": bc["bytes"],
+        "bloom_skipped_runs": int(metric.BLOOM_SKIPS.value),
+        "bulk_ingest_rows": int(metric.INGEST_ROWS.value),
+    })
     return _table("crdb_internal.cluster_load", [
         (k, T.INT64, _ints([v])) for k, v in cols.items()
     ])

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import blockcache
 from . import keys as K
 from . import mvcc
 from ..utils import locks
@@ -318,10 +319,11 @@ class Engine:
         self._newest_committed = _TsCache(key_width)
         # read caches, invalidated by generation counters
         self._gen = 0  # bumps whenever the run set changes
-        # per-run host key bytes for iterator seeks (block-index analog);
-        # keyed by id with a strong run ref so ids can't be reused
-        self._run_key_cache: dict[int, tuple] = {}
-        self._run_bloom_cache: dict[int, tuple] = {}
+        # per-run read-path metadata — seek keys + split-block bloom +
+        # the token namespacing the run's block-cache entries
+        # (storage/blockcache.py); keyed by id with a strong run ref so
+        # ids can't be reused
+        self._run_meta: dict[int, tuple[mvcc.KVBlock, blockcache.RunMeta]] = {}
         self._runs_view_cache: tuple[int, mvcc.KVBlock] | None = None
         self._scan_windows: dict[int, int] = {}  # max_keys -> learned window
         self._mem_cache: tuple[int, mvcc.KVBlock] | None = None
@@ -700,7 +702,8 @@ class Engine:
     @_locked
     def ingest(self, keys: np.ndarray, values: np.ndarray, ts: int,
                seq: int | None = None,
-               vlens: np.ndarray | None = None) -> None:
+               vlens: np.ndarray | None = None,
+               presorted: bool = False) -> None:
         """Bulk ingest: land pre-built KV arrays as ONE sorted run — the
         AddSSTable path (kvserver/batcheval/cmd_add_sstable.go role; the
         reference's bulk loaders build SSTs client-side and link them into
@@ -711,7 +714,11 @@ class Engine:
         One device sort builds the run; the WriteTooOld index takes the
         whole batch in one vectorized pass — per-row put() would pay host
         encode + append per key (the ingest-vs-write asymmetry the
-        reference's IMPORT exists for)."""
+        reference's IMPORT exists for).
+
+        ``presorted=True`` promises the keys are already unique and in
+        canonical run order (the RunBuilder sorted and deduped them
+        device-side) — the landing re-sort is skipped."""
         n = len(keys)
         if n == 0:
             return
@@ -752,6 +759,13 @@ class Engine:
                     os.fsync(dfd)
                 finally:
                     os.close(dfd)
+            from ..utils import faults
+
+            # chaos: crash window between the durable side file and the
+            # WAL link record — the run must stay invisible (replay sees
+            # no record; the orphan side file is cleaned at checkpoint)
+            # and a retry must land it cleanly
+            faults.fire("storage.ingest.link")
             self._wal_record(_REC_INGEST, os.path.basename(side).encode(),
                              b"", int(ts), int(seq), 0, False)
         blk = mvcc.KVBlock(
@@ -764,26 +778,28 @@ class Engine:
             vlen=jnp.asarray(vl),
             mask=jnp.asarray(np.arange(cap) < n),
         )
-        self.runs.insert(0, mvcc.sort_block(blk))
+        run = blk if presorted else mvcc.sort_block(blk)
+        self.runs.insert(0, run)
         self._gen += 1
         self.stats.flushes += 1
         self.stats.runs = len(self.runs)
         from ..utils import metric
 
         metric.ENGINE_INGESTS.inc()
+        metric.INGEST_ROWS.inc(n)
+        metric.INGEST_BYTES.inc(int(n * self.key_width + int(vl[:n].sum())))
         metric.ENGINE_RUNS.set(len(self.runs))
+        self._register_run(run)
         # one sorted-batch tscache insert for the whole ingest (no per-key
         # host work — see _TsCache)
         self._newest_committed.bulk(kb[:n], int(ts))
-        if len(self.runs) > self.l0_trigger:
-            self.compact(bottom=False)
+        self._maybe_compact()
 
     @_locked
     def flush(self):
         """Memtable -> sorted immutable run (Pebble memtable flush)."""
         self.flush_mem_only()
-        if len(self.runs) > self.l0_trigger:
-            self.compact(bottom=False)
+        self._maybe_compact()
 
     @_locked
     def flush_mem_only(self):
@@ -800,6 +816,16 @@ class Engine:
 
         metric.ENGINE_FLUSHES.inc()
         metric.ENGINE_RUNS.set(len(self.runs))
+        self._register_run(blk)
+
+    def _maybe_compact(self) -> None:
+        """Size-tiered compaction trigger behind the IOGovernor's pacing
+        decision: small debt may be deferred (storage.compaction.pacing.*)
+        so back-to-back merges can't starve foreground reads; debt past
+        max_debt_runs always compacts immediately."""
+        if (len(self.runs) > self.l0_trigger
+                and self.governor.pace_compaction()):
+            self.compact(bottom=False)
 
     @_locked
     def compact(self, bottom: bool = True):
@@ -840,6 +866,20 @@ class Engine:
             kept.insert(min(len(kept), picked[0]), merged)
             self.runs = kept
             self._gen += 1
+            from ..utils import faults
+
+            try:
+                # chaos: the run-set swap is visible but the cache/bloom
+                # bookkeeping hasn't happened yet — invalidation MUST
+                # still run (finally) or readers could be served stale
+                # cached windows of the replaced runs
+                faults.fire("storage.compaction.swap")
+            finally:
+                # the output run rebuilds its bloom; its inputs drop
+                # their metadata and ONLY their own block-cache entries
+                for b in blocks:
+                    self._drop_run_meta(b)
+                self._register_run(merged)
             self.stats.compactions += 1
             from ..utils import log, metric
 
@@ -847,6 +887,7 @@ class Engine:
             log.debug(log.STORAGE, "compaction", runs=len(self.runs),
                       bottom=bottom)
             self.stats.runs = len(self.runs)
+            self.governor.note_compaction()
 
     def _merge_for_compaction(self, blocks, total: int) -> mvcc.KVBlock:
         """Pick the compaction merge: the bitonic-merge Pallas kernel
@@ -949,7 +990,8 @@ class Engine:
                 # key bytes finds the start position, one device
                 # dynamic-slice lands the window — O(window), never
                 # O(run length) (the pebble iterator SeekGE discipline)
-                vkeys, n_live = self._run_keys(src)
+                meta = self._meta_for(src)
+                vkeys, n_live = meta.void_keys, meta.n_live
                 if n_live == 0:
                     continue
                 sw_raw = _words_to_bytes(sw)
@@ -962,7 +1004,14 @@ class Engine:
                     continue
                 size = min(_pad(limit_rows, _CAND_ALIGN), src.capacity)
                 cpos = min(pos, max(0, src.capacity - size))
-                win = _slice_window(src, cpos, size)
+                # block cache: runs are immutable, so a (token, pos,
+                # size) window's contents never change — consult the
+                # node cache before dispatching the device slice
+                cache = blockcache.node_cache()
+                win = cache.get(meta.token, cpos, size)
+                if win is None:
+                    win = _slice_window(src, cpos, size)
+                    cache.put(meta.token, cpos, size, win)
                 end_pos = cpos + size
                 if end_pos < n_live:
                     cut = bytes(vkeys[end_pos - 1].tobytes())
@@ -988,84 +1037,64 @@ class Engine:
         view = mvcc.merge_blocks(tuple(parts), cap=_pad(total, _CAND_ALIGN))
         return view, boundary
 
-    # -- bloom filters (pebble table-filter role) ---------------------------
+    # -- per-run read metadata (blockcache.RunMeta: seek keys + bloom) ------
 
-    _BLOOM_BITS_PER_KEY = 10
-    _BLOOM_K = 3
-
-    @staticmethod
-    def _bloom_hashes(void_keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        """Two vectorized 64-bit FNV-style hashes per key (double hashing
-        composes the k probe positions). uint64 wraparound is the hash
-        function working as designed — suppress numpy's overflow warning."""
-        kb = void_keys.view(np.uint8).reshape(len(void_keys), -1)
-        with np.errstate(over="ignore"):
-            h1 = np.full(len(kb), 0xCBF29CE484222325, np.uint64)
-            h2 = np.full(len(kb), 0x9E3779B97F4A7C15, np.uint64)
-            p1 = np.uint64(0x100000001B3)
-            p2 = np.uint64(0xC2B2AE3D27D4EB4F)
-            for j in range(kb.shape[1]):
-                col = kb[:, j].astype(np.uint64)
-                h1 = (h1 ^ col) * p1
-                h2 = (h2 + col) * p2 ^ (h2 >> np.uint64(29))
-            return h1, h2 | np.uint64(1)
-
-    def _run_bloom(self, run: mvcc.KVBlock) -> tuple[np.ndarray, int]:
-        """(bitset, nbits) over the run's LIVE keys — point reads skip
-        runs whose filter misses (pebble's per-table bloom filter). Host
-        numpy; cached alongside the seek index and pruned with it."""
-        c = self._run_bloom_cache.get(id(run))
-        if c is None or c[0] is not run:
-            vkeys, n_live = self._run_keys(run)
-            nbits = max(64, _pad(max(1, n_live) * self._BLOOM_BITS_PER_KEY,
-                                 64))
-            bits = np.zeros(nbits, dtype=bool)
-            if n_live:
-                h1, h2 = self._bloom_hashes(vkeys[:n_live])
-                for i in range(self._BLOOM_K):
-                    bits[(h1 + np.uint64(i) * h2) % np.uint64(nbits)] = True
-            if len(self._run_bloom_cache) > 4 * max(1, len(self.runs)):
-                live_ids = {id(r) for r in self.runs}
-                self._run_bloom_cache = {
-                    k: v for k, v in self._run_bloom_cache.items()
-                    if k in live_ids
-                }
-            c = self._run_bloom_cache[id(run)] = (run, bits, nbits)
-        return c[1], c[2]
-
-    def _bloom_might_contain(self, run: mvcc.KVBlock, key: bytes) -> bool:
-        bits, nbits = self._run_bloom(run)
-        kb = np.zeros((1, self.key_width), np.uint8)
-        raw = np.frombuffer(key, np.uint8)
-        kb[0, :len(raw)] = raw
-        h1, h2 = self._bloom_hashes(
-            np.ascontiguousarray(kb).view(f"V{self.key_width}").reshape(-1)
-        )
-        a, d = int(h1[0]), int(h2[0])
-        for i in range(self._BLOOM_K):
-            if not bits[((a + i * d) & 0xFFFFFFFFFFFFFFFF) % nbits]:
-                return False
-        return True
-
-    def _run_keys(self, run: mvcc.KVBlock):
-        """Host copy of a sorted run's key bytes as a void array (memcmp
-        ordering) + its live count — the SST block-index analog backing
-        host-side iterator seeks. Cached per run; stale entries pruned as
-        the run set turns over."""
-        c = self._run_key_cache.get(id(run))
+    def _meta_for(self, run: mvcc.KVBlock) -> blockcache.RunMeta:
+        """Read-path metadata for a run. Built eagerly by _register_run at
+        run construction (ingest/flush/compaction output); built lazily
+        here for the rewrite paths (intent resolution, span clears) whose
+        per-txn run churn would make eager bloom rebuilds a commit tax.
+        Stale entries prune as the run set turns over — dropping a meta
+        also invalidates its block-cache entries, or dead runs would pin
+        cache bytes forever."""
+        c = self._run_meta.get(id(run))
         if c is None or c[0] is not run:
             kb = np.asarray(run.key)
             void = np.ascontiguousarray(kb).view(
                 f"V{kb.shape[1]}").reshape(-1)
             n_live = int(np.asarray(jnp.sum(run.mask, dtype=jnp.int32)))
-            if len(self._run_key_cache) > 4 * max(1, len(self.runs)):
+            if len(self._run_meta) > 4 * max(1, len(self.runs)):
                 live_ids = {id(r) for r in self.runs}
-                self._run_key_cache = {
-                    k: v for k, v in self._run_key_cache.items()
-                    if k in live_ids
-                }
-            c = self._run_key_cache[id(run)] = (run, void, n_live)
-        return c[1], c[2]
+                cache = blockcache.node_cache()
+                for k in [k for k in self._run_meta if k not in live_ids]:
+                    cache.invalidate_run(self._run_meta[k][1].token)
+                    del self._run_meta[k]
+            c = self._run_meta[id(run)] = (
+                run, blockcache.build_meta(void, n_live))
+        return c[1]
+
+    def _register_run(self, run: mvcc.KVBlock) -> None:
+        """Eager metadata build for a newly constructed run — run
+        construction is where the reference builds its table filters, so
+        the first point read never pays the build."""
+        self._meta_for(run).bloom()
+
+    def _drop_run_meta(self, run: mvcc.KVBlock) -> None:
+        c = self._run_meta.pop(id(run), None)
+        if c is not None:
+            blockcache.node_cache().invalidate_run(c[1].token)
+
+    def _bloom_might_contain(self, run: mvcc.KVBlock, key: bytes) -> bool:
+        """Per-run split-block bloom probe (pebble's table-filter role).
+        False is a CRC-backed proof of absence; a filterless or corrupt
+        run always answers maybe."""
+        bloom = self._meta_for(run).bloom()
+        if bloom is None:
+            return True
+        kb = np.zeros((1, self.key_width), np.uint8)
+        raw = np.frombuffer(key, np.uint8)
+        kb[0, :len(raw)] = raw
+        h1, h2 = blockcache.bloom_hashes(
+            np.ascontiguousarray(kb).view(f"V{self.key_width}").reshape(-1)
+        )
+        return bloom.might_contain(int(h1[0]), int(h2[0]))
+
+    def _run_keys(self, run: mvcc.KVBlock):
+        """Host copy of a sorted run's key bytes as a void array (memcmp
+        ordering) + its live count — the SST block-index analog backing
+        host-side iterator seeks."""
+        m = self._meta_for(run)
+        return m.void_keys, m.n_live
 
     def _view_for(self, sw, ew) -> mvcc.KVBlock | None:
         if sw is None and ew is None:
@@ -1229,10 +1258,25 @@ class Engine:
 
     @_locked
     def get(self, key: bytes | str, ts: int, txn: int = 0) -> bytes | None:
+        """Point read. The full consult order is bloom -> block cache ->
+        device slice: each surviving run is seeked to a small candidate
+        window (O(window), not O(run)) and the window is served from the
+        node block cache when hot — a point read on a cached key set
+        dispatches no device gather at all. A window cut inside the
+        key's version set (boundary) grows geometrically, the pagination
+        discipline scan() uses."""
         b = key.encode() if isinstance(key, str) else bytes(key)
         sw = K.encode_bound(b, self.key_width)
         ew = K.bound_next(sw)
-        view, _ = self._bounded_view(sw, ew, point=b)
+        limit = 8
+        while True:
+            view, boundary = self._bounded_view(sw, ew, limit_rows=limit,
+                                                point=b)
+            if boundary is None:
+                break
+            # some run's window was cut inside [key, next(key)) — a
+            # version of this key may be missing; widen and retry
+            limit *= 4
         if view is None:
             return None
         sel, conflict = mvcc.mvcc_scan_filter(
@@ -1268,14 +1312,19 @@ class Engine:
                     self._newest_committed.put(k, int(commit_ts))
         self._locks = {k: t for k, t in self._locks.items() if t != txn}
         self.flush_mem_only()
+        old_runs = self.runs
         self.runs = [
             mvcc.sort_block(
                 mvcc.resolve_intents(
                     r, jnp.int64(txn), jnp.int64(commit_ts), commit
                 )
             )
-            for r in self.runs
+            for r in old_runs
         ]
+        # every run object was replaced: retire their read metadata (and
+        # block-cache entries); rebuilds stay lazy — see _meta_for
+        for r in old_runs:
+            self._drop_run_meta(r)
         self._gen += 1
 
     @_locked
@@ -1481,9 +1530,11 @@ class Engine:
             vlen=jnp.asarray(padrow(rows["vlen"])),
             mask=jnp.asarray(np.arange(cap) < n),
         )
-        self.runs.insert(0, mvcc.sort_block(blk))
+        run = mvcc.sort_block(blk)
+        self.runs.insert(0, run)
         self._gen += 1
         self.stats.runs = len(self.runs)
+        self._register_run(run)
         committed = rows["txn"] == 0
         if committed.any():
             self._newest_committed.bulk(
@@ -1492,8 +1543,7 @@ class Engine:
         for i in np.nonzero(~committed)[0]:
             k = bytes(rows["key"][i]).rstrip(b"\x00")
             self._locks[k] = int(rows["txn"][i])
-        if len(self.runs) > self.l0_trigger:
-            self.compact(bottom=False)
+        self._maybe_compact()
 
     @_locked
     def clear_span(self, start: bytes | None, end: bytes | None) -> None:
@@ -1516,6 +1566,9 @@ class Engine:
             if int(np.asarray(cnt)) == 0:
                 new_runs.append(r)
                 continue
+            # this run is rewritten or dropped: retire its read metadata
+            # and block-cache entries (untouched runs keep theirs)
+            self._drop_run_meta(r)
             keep = r.mask & ~m
             kept = int(np.asarray(jnp.sum(keep)))
             if kept == 0:

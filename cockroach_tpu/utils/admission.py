@@ -264,6 +264,10 @@ class IOGovernor:
                              else max(1, engine.l0_trigger // 2))
         self.delay_per_run_s = delay_per_run_s
         self.throttled = 0
+        # compaction pacing state (pace_compaction/note_compaction)
+        self.compactions_deferred = 0
+        self._last_compaction_t = 0.0
+        self._pacing_wait_start: float | None = None
 
     def mem_delay_s(self) -> float:
         from ..flow import memory as flowmem
@@ -293,3 +297,51 @@ class IOGovernor:
             self.throttled += 1
             time.sleep(d)
         return d
+
+    def compaction_debt(self) -> int:
+        """Runs past the L0 compaction trigger — the backlog the pacing
+        loop amortizes."""
+        return max(0, len(self.engine.runs) - self.engine.l0_trigger)
+
+    def pace_compaction(self) -> bool:
+        """Should the pending size-tiered compaction run NOW? The pacing
+        loop: while debt stays at or under
+        storage.compaction.pacing.max_debt_runs, compactions respect a
+        minimum inter-compaction interval so back-to-back merges can't
+        monopolize the device against foreground reads; past max debt the
+        pacer steps aside — read amplification at that depth starves
+        reads worse than any compaction pause. Deferred compactions are
+        counted, and the eventual run records how long pacing held it
+        (storage_compaction_pacing_delay_seconds)."""
+        from . import settings
+
+        if not settings.get("storage.compaction.pacing.enabled"):
+            return True
+        debt = self.compaction_debt()
+        if debt <= 0:
+            return False
+        if debt > settings.get("storage.compaction.pacing.max_debt_runs"):
+            return True
+        min_iv = settings.get(
+            "storage.compaction.pacing.min_interval_ms") / 1e3
+        if min_iv <= 0:
+            return True
+        if time.monotonic() - self._last_compaction_t >= min_iv:
+            return True
+        self.compactions_deferred += 1
+        if self._pacing_wait_start is None:
+            self._pacing_wait_start = time.monotonic()
+        return False
+
+    def note_compaction(self) -> None:
+        """Engine hook: a compaction just ran. Resets the pacing clock
+        and, if pacing had been holding this compaction back, records the
+        total deferral."""
+        from . import metric
+
+        now = time.monotonic()
+        if self._pacing_wait_start is not None:
+            metric.COMPACTION_PACING_DELAY.observe(
+                now - self._pacing_wait_start)
+            self._pacing_wait_start = None
+        self._last_compaction_t = now

@@ -44,6 +44,21 @@ Reference mapping (each named site's CockroachDB analogue):
 - ``ranger.lease.transfer``  — the range's data moved but the lease
   transfer write was lost (AdminTransferLease's in-flight window);
   retry must be a no-op move + lease stamp.
+- ``storage.ingest.link``    — AddSSTable crash window: the bulk-ingest
+  run's side file is durable but the WAL link record never lands
+  (cmd_add_sstable's link-don't-copy torn-link case). The run must stay
+  invisible — replay sees no record — and a retry must land it cleanly;
+  the orphaned side file is cleaned at the next checkpoint.
+- ``storage.compaction.swap`` — crash between a compaction's run-set
+  swap and its cache/bloom bookkeeping: block-cache invalidation for the
+  replaced runs must still happen or readers could be served stale
+  cached windows.
+- ``storage.bloom.build``    — bloom filter construction failure.
+  `error` models an allocation/build crash (the run serves reads
+  filterless — correct, just unpruned); `partial` models silent bit
+  corruption after the build checksum was taken — the lazy CRC verify
+  must disable the filter on its first negative answer, preserving the
+  zero-false-negative guarantee.
 
 Discipline: everything is OFF unless ``fault.injection.enabled`` is set
 AND the test armed specs via :func:`arm`. Firing decisions come from ONE

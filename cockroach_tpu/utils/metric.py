@@ -189,6 +189,34 @@ TXN_RETRIES = DEFAULT.counter("txn_retries", "transaction retries")
 RANGE_SPLITS = DEFAULT.counter("range_splits", "admin range splits")
 BLOOM_SKIPS = DEFAULT.counter(
     "storage_bloom_skips", "runs skipped by bloom filters on point reads")
+BLOOM_CORRUPTIONS = DEFAULT.counter(
+    "storage_bloom_corruptions",
+    "bloom filters disabled after their lazy CRC verification failed on "
+    "a first negative (the filter answers maybe forever after; reads "
+    "stay correct, just unfiltered)")
+BLOCKCACHE_HITS = DEFAULT.counter(
+    "storage_blockcache_hits",
+    "point/seek read windows served from the node block cache")
+BLOCKCACHE_MISSES = DEFAULT.counter(
+    "storage_blockcache_misses",
+    "block-cache lookups that fell through to a device window slice")
+BLOCKCACHE_EVICTIONS = DEFAULT.counter(
+    "storage_blockcache_evictions",
+    "cached windows evicted by the clock sweep under budget pressure")
+BLOCKCACHE_BYTES = DEFAULT.gauge(
+    "storage_blockcache_bytes",
+    "bytes of decoded KVBlock windows resident in the node block cache")
+INGEST_ROWS = DEFAULT.counter(
+    "storage_ingest_rows",
+    "rows landed as device-built runs through the bulk-ingest path")
+INGEST_BYTES = DEFAULT.counter(
+    "storage_ingest_bytes",
+    "logical key+value bytes landed through the bulk-ingest path")
+COMPACTION_PACING_DELAY = DEFAULT.histogram(
+    "storage_compaction_pacing_delay_seconds",
+    "how long the IOGovernor's pacing loop deferred a pending "
+    "size-tiered compaction before it ran",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0))
 EXTERNAL_AGG_SPILLS = DEFAULT.counter(
     "sql_external_agg_spills", "aggregations spilled to Grace partitions")
 RANGE_MOVES = DEFAULT.counter(

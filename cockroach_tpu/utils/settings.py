@@ -235,6 +235,43 @@ IO_PACING = register_bool(
     "L0 overload (io_load_listener role) so compaction catches up before "
     "read amplification inverts",
 )
+BULK_INGEST = register_bool(
+    "storage.bulk_ingest.enabled", True,
+    "route bulk loads (IMPORT, index backfill, bench loaders) through "
+    "the AddSSTable-style run builder (storage/ingest.py): column "
+    "batches sort and dedup device-side and link into the LSM as whole "
+    "runs — one WAL link record per run instead of per-key WAL appends. "
+    "Off falls back to the per-row write path",
+)
+BLOCK_CACHE_BYTES = register_int(
+    "storage.block_cache.size_bytes", 256 << 20,
+    "budget for the node-wide block cache of decoded KVBlock windows "
+    "(storage/blockcache.py), accounted as a cache-level child of the "
+    "root memory monitor tree. 0 disables caching entirely",
+    lo=0,
+)
+COMPACTION_PACING = register_bool(
+    "storage.compaction.pacing.enabled", True,
+    "schedule size-tiered compactions through the IOGovernor's pacing "
+    "loop instead of compacting inline the instant the L0 trigger "
+    "trips: small-debt compactions may be deferred (min_interval_ms) so "
+    "back-to-back merges can't starve foreground reads",
+)
+COMPACTION_PACING_INTERVAL = register_int(
+    "storage.compaction.pacing.min_interval_ms", 0,
+    "minimum milliseconds between paced size-tiered compactions while "
+    "debt stays at or under storage.compaction.pacing.max_debt_runs; "
+    "0 compacts as eagerly as the unpaced engine",
+    lo=0, hi=60_000,
+)
+COMPACTION_PACING_MAX_DEBT = register_int(
+    "storage.compaction.pacing.max_debt_runs", 8,
+    "compaction debt (runs past the L0 trigger) above which pacing is "
+    "bypassed and compaction runs immediately — read amplification past "
+    "this point starves foreground reads worse than the compaction "
+    "pause would",
+    lo=1, hi=256,
+)
 DENSE_LUT_BITS = register_int(
     "sql.distsql.dense_lut_bits", 24,
     "max packed-key bits for the dense direct-addressing join index "

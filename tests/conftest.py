@@ -50,6 +50,13 @@ def _memory_drain_census():
     before = _drain_failure_count()
     yield
     after = _drain_failure_count()
+    # the node-wide block cache (storage/blockcache.py) outlives any one
+    # engine: drain it between tests so cached windows from a dead test's
+    # runs can't pin root-monitor bytes or leak hit-rate state across
+    # tests (every test starts cold, like a fresh node)
+    from cockroach_tpu.storage import blockcache
+
+    blockcache.node_cache().clear()
     if after > before:
         from cockroach_tpu.flow import memory
 

@@ -1,0 +1,127 @@
+"""Block cache + split-block bloom tests (storage/blockcache.py).
+
+The pebble read-stack properties: blooms may lie positive, never
+negative; the cache obeys its monitor budget under pressure; the engine
+seek path serves repeat windows from cache and compaction invalidates
+exactly its input runs' entries.
+"""
+
+import numpy as np
+
+from cockroach_tpu.storage import blockcache
+from cockroach_tpu.utils import settings
+
+
+def _void(arr_u8: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(arr_u8).view(
+        np.dtype((np.void, arr_u8.shape[1]))).reshape(-1)
+
+
+def _rand_keys(rng, n: int, tag: int) -> np.ndarray:
+    out = np.zeros((n, 16), dtype=np.uint8)
+    out[:, 0] = tag  # disjoint keyspaces per tag
+    out[:, 1:] = rng.integers(0, 256, size=(n, 15), dtype=np.uint8)
+    return out
+
+
+def test_bloom_fp_bound_and_zero_false_negatives(rng):
+    """Membership is exact-negative: every inserted key answers True
+    (zero FN — the correctness property) and the false-positive rate over
+    disjoint probe keys stays under 3% (10 bits/key theoretical ~1.2%)."""
+    members = _void(_rand_keys(rng, 4096, tag=1))
+    probes = _void(_rand_keys(rng, 4096, tag=2))
+    filt = blockcache.SplitBloom.build(members)
+
+    mh1, mh2 = blockcache.bloom_hashes(members)
+    assert all(filt.might_contain(int(mh1[i]), int(mh2[i]))
+               for i in range(len(members))), "false negative"
+
+    ph1, ph2 = blockcache.bloom_hashes(probes)
+    fp = sum(filt.might_contain(int(ph1[i]), int(ph2[i]))
+             for i in range(len(probes)))
+    assert fp / len(probes) < 0.03, f"FP rate {fp / len(probes):.3f}"
+
+
+def test_bloom_empty_and_single_key(rng):
+    empty = blockcache.SplitBloom.build(_void(_rand_keys(rng, 1, 1))[:0])
+    h1, h2 = blockcache.bloom_hashes(_void(_rand_keys(rng, 8, 3)))
+    assert not any(empty.might_contain(int(h1[i]), int(h2[i]))
+                   for i in range(8))
+    one = _void(_rand_keys(rng, 1, 4))
+    filt = blockcache.SplitBloom.build(one)
+    oh1, oh2 = blockcache.bloom_hashes(one)
+    assert filt.might_contain(int(oh1[0]), int(oh2[0]))
+
+
+def test_cache_eviction_under_budget_pressure():
+    """The clock sweep keeps residency under storage.block_cache.size_bytes
+    and releases evicted bytes back to the monitor tree; referenced
+    entries survive one sweep (second chance), cold ones go first."""
+    settings.set("storage.block_cache.size_bytes", 4096)
+    cache = blockcache.BlockCache(name="test/block-cache")
+    try:
+        blk = lambda: np.zeros(1024, dtype=np.uint8)  # noqa: E731
+        for pos in range(4):
+            cache.put(1, pos, 8, blk())
+        assert cache.stats()["entries"] == 4
+        assert cache.used_bytes() == 4096
+        # touch (1, 0, 8): its ref bit survives the next sweep
+        assert cache.get(1, 0, 8) is not None
+        cache.put(2, 0, 8, blk())  # forces one eviction
+        s = cache.stats()
+        assert s["evictions"] >= 1
+        assert cache.used_bytes() <= 4096
+        assert cache.get(1, 0, 8) is not None, "referenced entry evicted"
+        assert cache.get(1, 1, 8) is None, "cold entry should have gone"
+        # oversized windows never cache (would evict the whole world)
+        cache.put(3, 0, 99, np.zeros(8192, dtype=np.uint8))
+        assert cache.stats()["entries"] <= 4
+        # budget 0 disables caching outright
+        settings.set("storage.block_cache.size_bytes", 0)
+        cache.put(4, 0, 8, blk())
+        assert cache.get(4, 0, 8) is None
+    finally:
+        cache.close()
+        settings.reset("storage.block_cache.size_bytes")
+
+
+def test_cache_invalidate_run_is_surgical():
+    cache = blockcache.BlockCache(name="test/block-cache-2")
+    try:
+        for tok in (7, 8):
+            for pos in range(3):
+                cache.put(tok, pos, 4, np.zeros(64, dtype=np.uint8))
+        cache.invalidate_run(7)
+        assert all(cache.get(7, p, 4) is None for p in range(3))
+        assert all(cache.get(8, p, 4) is not None for p in range(3))
+    finally:
+        cache.close()
+
+
+def test_engine_seek_path_hits_cache_and_compaction_invalidates():
+    """Repeat point reads over a flushed run serve their seek windows
+    from the node cache; compacting runs away drops exactly their
+    entries (fresh tokens, so no aliasing with the merged output)."""
+    from cockroach_tpu.storage.lsm import Engine
+
+    eng = Engine(key_width=16, val_width=16, memtable_size=4,
+                 l0_trigger=64)
+    for i in range(48):
+        eng.put(b"c%05d" % i, b"v%05d" % i, ts=i + 1)
+    eng.flush()
+    assert len(eng.runs) >= 2
+    cache = blockcache.node_cache()
+
+    assert eng.get(b"c%05d" % 7, ts=100) == b"v%05d" % 7  # populate
+    s0 = cache.stats()
+    assert eng.get(b"c%05d" % 7, ts=100) == b"v%05d" % 7  # repeat
+    s1 = cache.stats()
+    assert s1["hits"] > s0["hits"], "repeat read missed the cache"
+
+    old_tokens = {eng._meta_for(r).token for r in eng.runs}
+    eng.compact(bottom=True)
+    assert not any(k[0] in old_tokens for k in cache._entries), \
+        "compaction left dead runs' windows cached"
+    # reads after the invalidation are still correct and re-cacheable
+    assert eng.get(b"c%05d" % 7, ts=100) == b"v%05d" % 7
+    assert eng.get(b"c%05d" % 7, ts=100) == b"v%05d" % 7
