@@ -53,16 +53,44 @@ class HostPartitions:
     device Batch per partition."""
 
     def __init__(self, schema: Schema, nparts: int, spill_dir: str | None = None):
+        from . import memory as flowmem
+
         self.schema = schema
         self.nparts = nparts
         self.parts: list[list[dict]] = [[] for _ in range(nparts)]
         self.rows = [0] * nparts
+        # staged host rows charge the node-level spill-staging account
+        # (NOT the query monitor: partitions outlive operator accounts,
+        # and the drain census ignores cache-level children). A finalizer
+        # releases whatever free() was never called for; the holder dict
+        # keeps the finalizer from retaining self.
+        self._mon = flowmem.staging_monitor("flow/spill-staging")
+        self._charged = [0] * nparts
+        hold, mon = {"n": 0}, self._mon
+        self._hold = hold
+        import weakref
+
+        weakref.finalize(self, lambda: mon.release(hold["n"]))
 
     def append_host(self, pid: int, arrays: dict, valids: dict, n: int):
         if n == 0:
             return
+        nb = int(sum(a.nbytes for a in arrays.values())
+                 + sum(v.nbytes for v in valids.values()))
+        self._mon.reserve(nb, force=True)
+        self._charged[pid] += nb
+        self._hold["n"] += nb
         self.parts[pid].append({"arrays": arrays, "valids": valids, "n": n})
         self.rows[pid] += n
+
+    def free(self, pid: int) -> None:
+        """Drop a partition's staged rows and release their reservation —
+        callers free as they consume so peak staging tracks the live set."""
+        self._mon.release(self._charged[pid])
+        self._hold["n"] -= self._charged[pid]
+        self._charged[pid] = 0
+        self.parts[pid] = []
+        self.rows[pid] = 0
 
     def reload(self, pid: int) -> Batch | None:
         chunks = self.parts[pid]
@@ -202,6 +230,7 @@ class GraceHashJoinOp(OneInputOperator):
                 bd = build.dictionaries[bk]
                 self.probe_hash_tables[pk] = pd_.hashes
                 self.build_hash_tables[bk] = bd.hashes
+                # crlint: allow-mem-accounting(dictionary code remap: one int32 per distinct build-side string, bounded by dictionary size)
                 self.build_code_remaps[pos] = np.array(
                     [pd_.code_of(str(v)) for v in bd.values], dtype=np.int32
                 )
@@ -292,6 +321,7 @@ class GraceHashJoinOp(OneInputOperator):
 # External sort
 
 
+# crlint: allow-mem-accounting(tile-width device temp for order-preserving key packing; the owning batch is charged by its operator account)
 def _primary_u64(batch: Batch, schema: Schema, key: sort_ops.SortKey,
                  rank_table=None) -> jax.Array:
     """Order-preserving uint64 of the primary sort key (NULL ordering
@@ -407,12 +437,17 @@ class ExternalSortOp(OneInputOperator):
             self._parts = None
             self._staged = True
             return
-        # quantile boundaries over the staged u64s
-        allu = np.concatenate([c[2] for c in chunks])
-        P = min(self.nparts, max(1, (total + self.budget_rows - 1)
-                                 // self.budget_rows * 2))
-        qs = np.quantile(allu, np.linspace(0, 1, P + 1)[1:-1])
-        bounds = np.unique(qs.astype(np.uint64))
+        from . import memory as flowmem
+
+        # quantile boundaries over the staged u64s: the transient key
+        # vector is 8 B/row over the whole staged input — charge it for
+        # the split computation's lifetime
+        with flowmem.staged("flow/spill-staging", 8 * total):
+            allu = np.concatenate([c[2] for c in chunks])
+            P = min(self.nparts, max(1, (total + self.budget_rows - 1)
+                                     // self.budget_rows * 2))
+            qs = np.quantile(allu, np.linspace(0, 1, P + 1)[1:-1])
+            bounds = np.unique(qs.astype(np.uint64))
         parts = HostPartitions(self.output_schema, len(bounds) + 1)
         for arrays, valids, u in chunks:
             pids = np.searchsorted(bounds, u, side="right")
@@ -515,7 +550,7 @@ class GraceAggregateOp(Operator):
             pid = self._pid
             self._pid += 1
             batch = self._parts.reload(pid)
-            self._parts.parts[pid] = []  # free as we go
+            self._parts.free(pid)  # free as we go (releases the staging charge)
             if batch is None:
                 continue
             cap = batch.capacity

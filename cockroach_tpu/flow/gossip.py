@@ -16,7 +16,7 @@ import socket
 import threading
 import time
 
-from ..utils import locks
+from ..utils import locks, racesan
 from .dcn import _recv_msg, _send_msg
 
 
@@ -67,6 +67,7 @@ class Gossip:
 
     def add_info(self, key: str, value) -> None:
         with self._lock:
+            racesan.note_write(self, "_infos")
             self._clock += 1
             self._infos[key] = Info(key, value, self._clock, self.node_id)
             self._enforce_bound()
@@ -107,6 +108,7 @@ class Gossip:
 
     def get_info(self, key: str):
         with self._lock:
+            racesan.note_read(self, "_infos")
             info = self._infos.get(key)
             return None if info is None else info.value
 
@@ -117,6 +119,7 @@ class Gossip:
     def _merge(self, infos: list[Info]) -> int:
         fresh = 0
         with self._lock:
+            racesan.note_write(self, "_infos")
             for info in infos:
                 cur = self._infos.get(info.key)
                 if (cur is None

@@ -182,6 +182,53 @@ class BytesMonitor:
 ROOT = BytesMonitor("root", level="root")
 
 
+# -- long-lived staging accounts ---------------------------------------------
+#
+# Node-level "cache"-level children of ROOT for allocations that outlive a
+# query scope (spill staging, storage run/bloom residency, ingest blocks).
+# The per-query drain census ignores cache-level monitors, so these charge
+# the node budget without tripping leak detection — the block cache
+# (storage/blockcache.py) established the pattern.
+
+_STAGING: dict[str, BytesMonitor] = {}
+
+
+def staging_monitor(name: str) -> BytesMonitor:
+    with _TREE_LOCK:
+        m = _STAGING.get(name)
+        if m is None or m.closed:
+            m = _STAGING[name] = ROOT.child(name, level="cache")
+        return m
+
+
+@contextlib.contextmanager
+def staged(name: str, nbytes: int):
+    """Scoped charge for a transient staging buffer (host padding blocks,
+    quantile key vectors): reserved for the materialization's lifetime,
+    released on exit. ``force=True`` — the buffer must exist either way;
+    over-budget accounting beats no accounting (the operators.py spool
+    discipline)."""
+    mon = staging_monitor(name)
+    n = int(nbytes)
+    mon.reserve(n, force=True)
+    try:
+        yield mon
+    finally:
+        mon.release(n)
+
+
+def charge_object(name: str, obj, nbytes: int) -> None:
+    """Charge residency for ``obj``'s lifetime — released when the object
+    is garbage-collected (weakref.finalize), for structures whose drop
+    point is diffuse (per-run bloom filters discarded by compaction)."""
+    mon = staging_monitor(name)
+    n = int(nbytes)
+    if n <= 0:
+        return
+    mon.reserve(n, force=True)
+    weakref.finalize(obj, mon.release, n)
+
+
 def _update_gauges() -> None:
     # called under _TREE_LOCK on every root-visible delta
     metric.SQL_MEM_CURRENT.set(ROOT.used)

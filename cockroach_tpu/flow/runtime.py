@@ -82,7 +82,7 @@ class _ReadbackShrink:
             return
         import jax.numpy as jnp
 
-        # crlint: allow-host-sync(deferred shrink counts: ONE stacked sync at query end by design)
+        # crlint: allow-host-sync(deferred shrink counts: ONE stacked sync at query end by design)  # crlint: allow-mem-accounting(one int32 per shrunk tile — bounded by the query's tile count)
         counts = np.asarray(jnp.stack([c for *_, c in self._checks]))
         for (i, orig, cap, _), n in zip(self._checks, counts):
             if int(n) > cap:

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import time
 
-from ..utils import locks
+from ..utils import locks, racesan
 from .rpc import BatchClient
 
 _KEY = "node/%d/kv"
@@ -133,6 +133,7 @@ class NodeDialer:
         addr = self.resolve(node_id)
         with self._lock:
             self._breaker(node_id).admit()
+            racesan.note_read(self, "_conns")
             cached = self._conns.get(node_id)
             if cached is not None and cached[0] == addr:
                 self._breaker(node_id).probe_aborted()  # no probe needed
@@ -160,6 +161,7 @@ class NodeDialer:
                     cached[1].close()
                 except OSError:
                     pass
+            racesan.note_write(self, "_conns")
             self._conns[node_id] = (addr, client)
             return client
 
@@ -185,6 +187,7 @@ class NodeDialer:
         """Drop a cached conn (callers do this on a connection error so
         the next dial reconnects)."""
         with self._lock:
+            racesan.note_write(self, "_conns")
             cached = self._conns.pop(node_id, None)
         if cached is not None:
             try:
@@ -194,6 +197,7 @@ class NodeDialer:
 
     def close(self) -> None:
         with self._lock:
+            racesan.note_write(self, "_conns")
             conns = list(self._conns.values())
             self._conns.clear()
         for _, c in conns:

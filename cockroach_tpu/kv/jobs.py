@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass
 
+from ..utils import locks, racesan
 from .txn import DB
 
 _PREFIX = b"\x01job"
@@ -57,6 +58,9 @@ class Registry:
         # and checkpoints are fenced against epoch increments; None keeps
         # the single-registry behavior (claims recorded, never contested)
         self.liveness = liveness
+        # guards _resumers/_running: register() runs on the main thread
+        # while Node._adopt_loop adopts from its background thread
+        self._mu = locks.lock("kv.jobs.registry")
         self._resumers: dict[str, object] = {}
         self._running: set[int] = set()  # in-process, guards self-re-adoption
 
@@ -66,7 +70,9 @@ class Registry:
         """resume_fn(registry, job) runs/continues the job; it reads
         job.progress for its checkpoint and calls registry.checkpoint(job)
         after each unit of work. Return value = final result payload."""
-        self._resumers[job_type] = resume_fn
+        with self._mu:
+            racesan.note_write(self, "_resumers")
+            self._resumers[job_type] = resume_fn
 
     # -- record persistence --------------------------------------------------
     #
@@ -213,7 +219,10 @@ class Registry:
 
         out = []
         for job in self.jobs():
-            if job.state != "running" or job.job_id in self._running:
+            with self._mu:
+                racesan.note_read(self, "_running")
+                in_flight = job.job_id in self._running
+            if job.state != "running" or in_flight:
                 continue
             if job.claim_node == 0:
                 continue
@@ -277,7 +286,9 @@ class Registry:
             raise KeyError(f"no job {job_id}")
         if observed.state in ("succeeded", "failed"):
             return observed
-        resume = self._resumers.get(observed.job_type)
+        with self._mu:
+            racesan.note_read(self, "_resumers")
+            resume = self._resumers.get(observed.job_type)
         if resume is None:
             raise KeyError(f"no resumer for job type {observed.job_type!r}")
         job = self._claim(job_id, observed)
@@ -285,7 +296,9 @@ class Registry:
             return self.load(job_id)  # lost the claim race: current state
         if job.state in ("succeeded", "failed"):
             return job
-        self._running.add(job_id)
+        with self._mu:
+            racesan.note_write(self, "_running")
+            self._running.add(job_id)
         try:
             try:
                 result = resume(self, job)
@@ -300,7 +313,9 @@ class Registry:
             self.checkpoint(job)
             return job
         finally:
-            self._running.discard(job_id)
+            with self._mu:
+                racesan.note_write(self, "_running")
+                self._running.discard(job_id)
 
 
 # -- built-in job types ------------------------------------------------------

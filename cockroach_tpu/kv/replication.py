@@ -90,6 +90,7 @@ class ReplicationStream:
                     if self._stop.is_set():
                         return
                     if "resolved" in frame:
+                        # crlint: allow-shared-state(single-writer RMW on the stream thread; readers tolerate a stale frontier — resubscribe just replays)
                         self.frontier = max(self.frontier,
                                             int(frame["resolved"]))
                     else:

@@ -1,15 +1,40 @@
 """CLI: ``python -m cockroach_tpu.lint [--json] [--rule R ...] paths...``
 
-Exit 0 when clean, 1 when any unsuppressed finding survives — the same
-contract as scripts/check_lint.py, which wires this into tier-1.
+Exit codes (the contract CI and editors key on):
+
+* **0** — clean: every selected pass ran, no unsuppressed finding.
+* **1** — findings: the tree violates an invariant (or waives one
+  without a reason).
+* **2** — internal error: the linter itself failed to run (unparseable
+  file, unreadable path, bad arguments) — distinct from 1 so a wrapper
+  can tell "the gate failed" from "the gate is broken".
+
+``--changed-only FILE`` reads a newline-separated path list (typically
+``git diff --name-only``) and reports only findings landing in those
+files. The WHOLE path set is still linted — tree rules (lock-order,
+shared-state, fault-coverage) need the full cross-module graph to be
+sound — only the report is filtered, so a pre-commit hook gets correct
+findings fast without a pass silently reasoning over half a program.
 """
 
 from __future__ import annotations
 
 import argparse
+import pathlib
 import sys
 
 from .core import ALL_RULES, report_json, report_text, run_lint
+
+
+def _changed_set(list_path: str) -> set[str]:
+    """Posix-normalized path suffixes from a git-diff-style file list
+    (blank lines and non-.py entries dropped)."""
+    out = set()
+    for line in pathlib.Path(list_path).read_text().splitlines():
+        line = line.strip()
+        if line and line.endswith(".py"):
+            out.add(pathlib.PurePath(line).as_posix())
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -19,12 +44,28 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("paths", nargs="+",
                     help="files or directories to lint")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable findings")
+                    help="machine-readable findings (stable file:line "
+                         "order)")
     ap.add_argument("--rule", action="append", choices=ALL_RULES,
                     help="run only this rule (repeatable)")
+    ap.add_argument("--changed-only", metavar="FILE",
+                    help="newline-separated path list; lint everything "
+                         "but report only findings in these files")
     args = ap.parse_args(argv)
-    findings = run_lint(args.paths,
-                        tuple(args.rule) if args.rule else None)
+    try:
+        findings = run_lint(args.paths,
+                            tuple(args.rule) if args.rule else None)
+        if args.changed_only:
+            changed = _changed_set(args.changed_only)
+            findings = [f for f in findings
+                        if f.path in changed
+                        or any(c.endswith("/" + f.path) for c in changed)]
+    except Exception as e:
+        # the linter failing to run is NOT a finding — exit 2 so CI can
+        # distinguish a broken gate from a dirty tree
+        print(f"crlint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
     if args.as_json:
         print(report_json(findings))
     elif findings:

@@ -148,7 +148,8 @@ def attr_chain(node: ast.AST) -> tuple[str, ...] | None:
 
 def _rules():
     # late import: the rule modules import core for helpers
-    from . import (errdiscipline, hostsync, lockorder, rawjit, tracingapi,
+    from . import (errdiscipline, faultcoverage, hostsync, lockorder,
+                   memaccounting, rawjit, sharedstate, tracingapi,
                    unusedimport)
     per_file = {
         "host-sync": hostsync.check,
@@ -156,13 +157,39 @@ def _rules():
         "broad-except": errdiscipline.check,
         "unused-import": unusedimport.check,
         "tracing-api": tracingapi.check,
+        "mem-accounting": memaccounting.check,
     }
-    tree = {"lock-order": lockorder.check}
+    tree = {
+        "lock-order": lockorder.check,
+        "shared-state": sharedstate.check,
+        "fault-coverage": faultcoverage.check,
+    }
     return per_file, tree
 
 
 ALL_RULES = ("host-sync", "raw-jit", "broad-except", "unused-import",
-             "lock-order", "tracing-api")
+             "lock-order", "tracing-api", "shared-state", "mem-accounting",
+             "fault-coverage", "unknown-pragma")
+
+
+def _unknown_pragmas(files: list[SourceFile]) -> list[Finding]:
+    """A pragma naming a rule crlint doesn't know suppresses NOTHING —
+    usually a typo ('alow-host-sync', 'mem-acounting') silently leaving
+    the author convinced a finding is waived. That near-miss is itself a
+    finding."""
+    known = set(ALL_RULES)
+    out = []
+    for f in files:
+        for ln in sorted(f.pragmas):
+            for rule in f.pragmas[ln]:
+                if rule not in known:
+                    out.append(Finding(
+                        "unknown-pragma", f.rel, ln,
+                        f"pragma waives unknown rule {rule!r} — no such "
+                        "pass exists, so this suppresses nothing "
+                        f"(known rules: {', '.join(sorted(known))})",
+                    ))
+    return out
 
 
 def run_lint(paths: list[str | pathlib.Path],
@@ -182,13 +209,17 @@ def run_lint(paths: list[str | pathlib.Path],
     for name, check in tree.items():
         if name in wanted:
             findings.extend(check(files))
+    if "unknown-pragma" in wanted:
+        findings.extend(_unknown_pragmas(files))
     live = []
     for fd in findings:
         src = by_rel.get(fd.path)
         if fd.suppressible and src is not None and src.allows(fd.rule, fd.line):
             continue
         live.append(fd)
-    return sorted(live, key=lambda f: (f.path, f.line, f.rule))
+    # fully deterministic order (message included: two findings of one
+    # rule can share a line) — reporters and CI diffs rely on stability
+    return sorted(live, key=lambda f: (f.path, f.line, f.rule, f.message))
 
 
 def report_text(findings: list[Finding]) -> str:

@@ -83,7 +83,8 @@ class SplitBloom:
     ~1.2%; the property test holds the line at <3%.
     """
 
-    __slots__ = ("bits", "nblocks", "crc", "disabled", "_verified")
+    __slots__ = ("bits", "nblocks", "crc", "disabled", "_verified",
+                 "__weakref__")
 
     def __init__(self, bits: np.ndarray, nblocks: int, crc: int):
         self.bits = bits
@@ -109,6 +110,12 @@ class SplitBloom:
                     bits[base + bit] = True
         crc = zlib.crc32(np.packbits(bits).tobytes())
         filt = cls(bits, nblocks, crc)
+        from ..flow import memory as flowmem
+
+        # filter residency (~BLOOM_BITS_PER_KEY bytes/key as host bools)
+        # charges the node budget until compaction drops the run's meta
+        flowmem.charge_object("storage/bloom-residency", filt,
+                              int(bits.nbytes))
         frac = faults.partial_fraction("storage.bloom.build")
         if frac is not None:
             # chaos: silent bit corruption AFTER the checksum was taken —

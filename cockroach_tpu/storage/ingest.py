@@ -92,22 +92,28 @@ class RunBuilder:
         eng = self.engine
         n = len(kb_in)
         cap = _pad(n)
-        kb = np.zeros((cap, eng.key_width), np.uint8)
-        kb[:n, : kb_in.shape[1]] = kb_in
-        vb = np.zeros((cap, eng.val_width), np.uint8)
-        vb[:n, : vb_in.shape[1]] = vb_in
-        vl = np.zeros(cap, np.int32)
-        vl[:n] = vb_in.shape[1] if vl_in is None else vl_in
-        return mvcc.KVBlock(
-            key=jnp.asarray(kb),
-            ts=jnp.full((cap,), self.ts, jnp.int64),
-            seq=jnp.full((cap,), seq, jnp.int64),
-            txn=jnp.zeros((cap,), jnp.int64),
-            tomb=jnp.zeros((cap,), jnp.bool_),
-            value=jnp.asarray(vb),
-            vlen=jnp.asarray(vl),
-            mask=jnp.asarray(np.arange(cap) < n),
-        )
+        from ..flow import memory as flowmem
+
+        # host padding buffers live only until jnp.asarray copies them to
+        # device; the merged run's residency is charged by Engine.ingest
+        est = cap * (eng.key_width + eng.val_width + 4)
+        with flowmem.staged("storage/ingest-staging", est):
+            kb = np.zeros((cap, eng.key_width), np.uint8)
+            kb[:n, : kb_in.shape[1]] = kb_in
+            vb = np.zeros((cap, eng.val_width), np.uint8)
+            vb[:n, : vb_in.shape[1]] = vb_in
+            vl = np.zeros(cap, np.int32)
+            vl[:n] = vb_in.shape[1] if vl_in is None else vl_in
+            return mvcc.KVBlock(
+                key=jnp.asarray(kb),
+                ts=jnp.full((cap,), self.ts, jnp.int64),
+                seq=jnp.full((cap,), seq, jnp.int64),
+                txn=jnp.zeros((cap,), jnp.int64),
+                tomb=jnp.zeros((cap,), jnp.bool_),
+                value=jnp.asarray(vb),
+                vlen=jnp.asarray(vl),
+                mask=jnp.asarray(np.arange(cap) < n),
+            )
 
     def _merge(self, blocks: tuple) -> mvcc.KVBlock:
         if len(blocks) == 1:

@@ -90,6 +90,22 @@ def _pad(n: int, align: int = _RUN_ALIGN) -> int:
     return p
 
 
+def _block_nbytes(blk: mvcc.KVBlock) -> int:
+    """Logical bytes of a block's arrays — what run residency charges to
+    the storage staging monitor (flow/memory.py staging accounts)."""
+    return int(sum(int(x.size) * x.dtype.itemsize
+                   for x in (blk.key, blk.ts, blk.seq, blk.txn,
+                             blk.tomb, blk.value, blk.vlen, blk.mask)))
+
+
+def _charge_run(run: mvcc.KVBlock) -> None:
+    """Run residency joins the monitor tree (PR 8): reserved against the
+    node budget, released when compaction drops the run and it is GC'd."""
+    from ..flow import memory as flowmem
+
+    flowmem.charge_object("storage/run-residency", run, _block_nbytes(run))
+
+
 def _shrink(block: mvcc.KVBlock) -> mvcc.KVBlock:
     """Slice a *sorted* block (dead rows last) down to a power-of-2 capacity
     covering its live rows."""
@@ -217,6 +233,7 @@ class _TsCache:
         if len(self.batches) > self._MAX_BATCHES:
             self._fold()
 
+    # crlint: allow-mem-accounting(fold compacts already-resident ts-cache batches: a transient concat whose output is strictly smaller than its inputs)
     def _fold(self) -> None:
         ks = np.concatenate([k for k, _ in self.batches])
         ts = np.concatenate([t for _, t in self.batches])
@@ -696,6 +713,13 @@ class Engine:
             cap=_pad(n),
             seq=seq_arr[order],
         )
+        from ..flow import memory as flowmem
+
+        # memtable-block residency (cached until the next write changes
+        # the memtable): charged like a run, released when the cache
+        # entry is replaced and the old block is GC'd
+        flowmem.charge_object("storage/run-residency", blk,
+                              _block_nbytes(blk))
         self._mem_cache = (n, blk)
         return blk
 
@@ -779,6 +803,7 @@ class Engine:
             mask=jnp.asarray(np.arange(cap) < n),
         )
         run = blk if presorted else mvcc.sort_block(blk)
+        _charge_run(run)
         self.runs.insert(0, run)
         self._gen += 1
         self.stats.flushes += 1
@@ -1081,7 +1106,7 @@ class Engine:
         bloom = self._meta_for(run).bloom()
         if bloom is None:
             return True
-        kb = np.zeros((1, self.key_width), np.uint8)
+        kb = np.zeros((1, self.key_width), np.uint8)  # crlint: allow-mem-accounting(single-key probe buffer, key_width bytes)
         raw = np.frombuffer(key, np.uint8)
         kb[0, :len(raw)] = raw
         h1, h2 = blockcache.bloom_hashes(
@@ -1150,6 +1175,7 @@ class Engine:
             if boundary is not None:
                 # emit only rows strictly below the truncation point
                 keys_np = np.asarray(view.key)[idx]
+                # crlint: allow-mem-accounting(one bool per candidate row of a truncated scan batch — bounded by the scan limit)
                 below = np.array(
                     [bytes(k) < boundary for k in keys_np], dtype=bool
                 )
@@ -1425,7 +1451,7 @@ class Engine:
             return empty
         vals_np = np.asarray(view.value)[idx]
         vlen_np = np.asarray(view.vlen)[idx]
-        return {
+        out = {
             "key": np.asarray(view.key)[idx],
             "ts": np.asarray(view.ts)[idx],
             "seq": np.asarray(view.seq)[idx],
@@ -1442,6 +1468,15 @@ class Engine:
                 for i in np.nonzero(vlen_np > self.val_width)[0]
             ), dtype=np.uint8),
         }
+        from ..flow import memory as flowmem
+
+        # the snapshot payload lives until the transport drops it —
+        # charge its residency for that lifetime (anchored on the key
+        # array: dicts take no weakrefs, and the arrays die together)
+        flowmem.charge_object(
+            "storage/export-staging", out["key"],
+            int(sum(a.nbytes for a in out.values())))
+        return out
 
     @_locked
     def import_rows(self, rows: dict) -> None:
@@ -1531,6 +1566,7 @@ class Engine:
             mask=jnp.asarray(np.arange(cap) < n),
         )
         run = mvcc.sort_block(blk)
+        _charge_run(run)
         self.runs.insert(0, run)
         self._gen += 1
         self.stats.runs = len(self.runs)

@@ -76,6 +76,36 @@ import time
 from dataclasses import dataclass, field
 
 
+# Machine-readable site registry — the docstring above is the prose; THIS
+# is what tooling consumes. The fault-coverage lint pass parses this dict
+# literal (site -> one-line description) and enforces that (a) every
+# fire()/fire_scoped()/partial_fraction() call in product code names a
+# registered site, (b) every registered site has a product fire call, and
+# (c) every registered site is exercised by at least one chaos test —
+# scripts/run_chaos_matrix.py fails on uncovered sites. Keep this a pure
+# literal: the linter reads it with ast.literal_eval, never by import.
+SITES: dict[str, str] = {
+    "kv.rpc.client.batch": "DistSender send error before evaluation",
+    "kv.rpc.server.eval": "replica-side evaluation failure",
+    "kv.rpc.server.respond": "response lost after apply (ambiguous result)",
+    "flow.host.setup": "SetupFlow RPC failure at the gateway",
+    "flow.host.stream": "FlowStream attach/stream failure",
+    "kv.dialer.dial": "nodedialer connect failure (breaker-tracked)",
+    "storage.wal.append": "WAL write error/stall/torn append",
+    "storage.wal.fsync": "fsync stall or failure",
+    "liveness.heartbeat": "node-liveness heartbeat failure (node-scoped)",
+    "liveness.epoch_bump": "IncrementEpoch CPut failure (node-scoped)",
+    "gossip.broadcast": "gossip exchange failure (node-scoped)",
+    "kv.rangefeed.subscribe": "rangefeed (re)subscription failure",
+    "ranger.split.apply": "split partially applied before bookkeeping",
+    "ranger.merge.apply": "merge partially applied before bookkeeping",
+    "ranger.lease.transfer": "lease transfer write lost in flight",
+    "storage.ingest.link": "bulk-ingest side file durable, link lost",
+    "storage.compaction.swap": "crash between run swap and bookkeeping",
+    "storage.bloom.build": "bloom build crash or silent bit corruption",
+}
+
+
 class InjectedFault(ConnectionError):
     """Raised by `error`/`drop` faults. Subclasses ConnectionError so the
     retry layer classifies an injected drop exactly like a real one."""

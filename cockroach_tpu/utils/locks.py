@@ -78,8 +78,13 @@ def _reachable(src: str, dst: str) -> list[str] | None:
 
 
 def _note_acquire(name: str) -> None:
+    # the held stack serves two debug consumers: the order graph below
+    # (debug.lock_order) and the race sanitizer's locksets
+    # (utils/racesan.py reads _held_stack under debug.race_detector) —
+    # graph edges and cycle checks stay gated on lock_order alone
     st = _held_stack()
-    if st and st[-1] != name:
+    if st and st[-1] != name \
+            and settings.get("debug.lock_order.enabled"):
         prev = st[-1]
         with _graph_mu:
             back = _reachable(name, prev)
@@ -114,7 +119,9 @@ class OrderedLock:
         self._lk = self._factory()
 
     def _checking(self) -> bool:
-        return bool(settings.get("debug.lock_order.enabled"))
+        # either debug mode needs the per-thread held stack maintained
+        return bool(settings.get("debug.lock_order.enabled")
+                    or settings.get("debug.race_detector.enabled"))
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         check = self._checking()

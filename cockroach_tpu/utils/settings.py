@@ -11,6 +11,7 @@ metamorphically randomizable for tests.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Any
 
@@ -38,6 +39,7 @@ _REGISTRY: dict[str, Setting] = {}
 def _register(s: Setting) -> Setting:
     if s.name in _REGISTRY:
         raise ValueError(f"duplicate setting {s.name}")
+    # crlint: allow-shared-state(registration happens at import time, before any worker thread exists; runtime mutation goes through Setting.value)
     _REGISTRY[s.name] = s
     return s
 
@@ -99,22 +101,29 @@ def set(name: str, value) -> None:  # noqa: A001 - SQL SET semantics
 
 
 _CHANGE_LISTENERS: list = []
+# bare threading.Lock, not utils.locks: locks.py reads its settings from
+# this module, so the ordered-lock machinery can't be imported here
+_LISTENERS_MU = threading.Lock()
 
 
 def on_change(cb) -> None:
     """Subscribe cb(name, value) to every settings.set — the gossip bridge
     (the reference gossips updated cluster settings to every node,
     settings/updater.go); Node wires this to publish into its infostore."""
-    _CHANGE_LISTENERS.append(cb)
+    with _LISTENERS_MU:
+        _CHANGE_LISTENERS.append(cb)
 
 
 def remove_on_change(cb) -> None:
-    if cb in _CHANGE_LISTENERS:
-        _CHANGE_LISTENERS.remove(cb)
+    with _LISTENERS_MU:
+        if cb in _CHANGE_LISTENERS:
+            _CHANGE_LISTENERS.remove(cb)
 
 
 def _notify(name: str, value) -> None:
-    for cb in list(_CHANGE_LISTENERS):
+    with _LISTENERS_MU:
+        snapshot = list(_CHANGE_LISTENERS)
+    for cb in snapshot:
         cb(name, value)
 
 
@@ -408,6 +417,15 @@ LOCK_ORDER_CHECKS = register_bool(
     "while holding A records edge A->B, and an acquisition that would "
     "close a cycle raises LockOrderError instead of deadlocking; off "
     "(default) the wrappers are plain locks with no per-acquire overhead",
+)
+RACE_DETECTOR = register_bool(
+    "debug.race_detector.enabled", False,
+    "arm the runtime data-race sanitizer (utils/racesan.py): tracked "
+    "control-plane fields run the Eraser lockset algorithm — a "
+    "lockset-disjoint write/write or write/read across threads raises "
+    "DataRaceError at the access instead of corrupting state; also keeps "
+    "the per-thread held-lock stack live. Off (default) every "
+    "note_read/note_write is a single settings check",
 )
 READBACK_OVERLAP = register_bool(
     "sql.distsql.readback_overlap", True,

@@ -1,8 +1,10 @@
 """crlint tree gate — the static-analysis suite must be clean at HEAD.
 
 Runs every crlint pass (cockroach_tpu/lint/: host-sync, raw-jit,
-broad-except, unused-import, lock-order) over the package, the
-scripts/ directory, and the tests/ tree and fails on any unsuppressed
+broad-except, unused-import, tracing-api, lock-order, shared-state,
+mem-accounting, fault-coverage, unknown-pragma) over the package, the
+scripts/ directory, the tests/ tree, and the repo-root entry points
+(bench.py, __graft_entry__.py) and fails on any unsuppressed
 finding. This is the
 nogo/roachvet analog: the lint rules are only worth having if the tree
 is kept at zero findings, so the gate rides in tier-1 next to the
@@ -35,9 +37,13 @@ def check(repo_root: str | pathlib.Path | None = None) -> list[str]:
     if repo_root is None:
         repo_root = pathlib.Path(__file__).resolve().parent.parent
     root = pathlib.Path(repo_root)
-    return [f.render() for f in
-            run_lint([root / "cockroach_tpu", root / "scripts",
-                      root / "tests"])]
+    paths = [root / "cockroach_tpu", root / "scripts", root / "tests"]
+    # repo-root entry points ride along when present (fixture trees in
+    # the lint tests call check() on trimmed copies without them)
+    for entry in ("bench.py", "__graft_entry__.py"):
+        if (root / entry).is_file():
+            paths.append(root / entry)
+    return [f.render() for f in run_lint(paths)]
 
 
 def main() -> int:
@@ -45,8 +51,8 @@ def main() -> int:
     for p in problems:
         print(f"FAIL: {p}", file=sys.stderr)
     if not problems:
-        print("crlint clean: all passes over cockroach_tpu/, scripts/ "
-              "and tests/")
+        print("crlint clean: all passes over cockroach_tpu/, scripts/, "
+              "tests/ and the repo-root entry points")
     return 1 if problems else 0
 
 

@@ -7,11 +7,25 @@ registry adds the offset to every armed seed (utils/faults.arm), so each
 pass fires a DIFFERENT deterministic schedule while staying replayable:
 a failing offset reproduces with the same command.
 
+Before any seed runs, the driver closes the coverage loop with the
+fault-coverage lint pass (cockroach_tpu/lint/faultcoverage.py): every
+site registered in utils/faults.py SITES must be exercised by at least
+one chaos-marked test, or the matrix REFUSES to run — sweeping seeds
+over a suite that never reaches a registered failure path is false
+confidence. ``--matrix`` prints the full site↔test mapping.
+
+Every seed also runs sanitizer-armed: the chaos suite's autouse
+fixtures (tests/test_chaos.py) switch on ``debug.lock_order.enabled``
+AND ``debug.race_detector.enabled``, so an inverted lock acquisition or
+a lockset-disjoint shared-state access anywhere under fault injection
+fails the offset with a stack trace instead of a hang or a corruption.
+
 Usage:
     python scripts/run_chaos_matrix.py [--seeds N] [--offset-base K]
+                                       [--matrix]
 
-Exit code is non-zero if ANY seed fails; the failing offsets print so
-the exact schedule can be replayed with
+Exit code is non-zero if coverage is incomplete or ANY seed fails; the
+failing offsets print so the exact schedule can be replayed with
     CHAOS_SEED_OFFSET=<off> pytest -m 'chaos and not slow'
 """
 
@@ -23,6 +37,34 @@ import subprocess
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def coverage_matrix() -> dict[str, list[str]]:
+    """site -> chaos tests exercising it, from the fault-coverage pass
+    (pure AST — nothing is imported, runs without jax)."""
+    sys.path.insert(0, _REPO_ROOT)
+    from cockroach_tpu.lint.core import load_files
+    from cockroach_tpu.lint.faultcoverage import site_matrix
+
+    files = load_files([os.path.join(_REPO_ROOT, "cockroach_tpu"),
+                        os.path.join(_REPO_ROOT, "tests")])
+    return site_matrix(files)
+
+
+def check_coverage(verbose: bool = False) -> list[str]:
+    """Returns the registered sites no chaos test exercises (empty =
+    every failure mode in the registry is reachable by this matrix)."""
+    matrix = coverage_matrix()
+    uncovered = sorted(s for s, tests in matrix.items() if not tests)
+    if verbose:
+        width = max(len(s) for s in matrix) if matrix else 0
+        for site in sorted(matrix):
+            tests = matrix[site]
+            status = f"{len(tests)} test(s)" if tests else "UNCOVERED"
+            print(f"  {site:<{width}}  {status}")
+            for t in tests:
+                print(f"  {'':<{width}}    {t}")
+    return uncovered
 
 
 def run_matrix(offsets, extra_args=(), quiet: bool = False) -> list[int]:
@@ -39,7 +81,7 @@ def run_matrix(offsets, extra_args=(), quiet: bool = False) -> list[int]:
                "-m", "chaos and not slow",
                "-p", "no:cacheprovider", *extra_args]
         proc = subprocess.run(
-            cmd, cwd=_REPO_ROOT,
+            cmd, cwd=_REPO_ROOT, env=env,
             stdout=subprocess.PIPE if quiet else None,
             stderr=subprocess.STDOUT if quiet else None)
         if proc.returncode != 0:
@@ -57,7 +99,22 @@ def main(argv=None) -> int:
                     help="number of seed offsets to sweep (default 4)")
     ap.add_argument("--offset-base", type=int, default=0,
                     help="first CHAOS_SEED_OFFSET (default 0)")
+    ap.add_argument("--matrix", action="store_true",
+                    help="print the full site<->test coverage matrix")
     args = ap.parse_args(argv)
+    if args.matrix:
+        print("[chaos-matrix] site -> test coverage:")
+    uncovered = check_coverage(verbose=args.matrix)
+    if uncovered:
+        print("[chaos-matrix] REFUSING to run: registered fault sites "
+              "with no chaos test:", file=sys.stderr)
+        for site in uncovered:
+            print(f"  {site}", file=sys.stderr)
+        print("  (add a chaos test naming each site, or unregister it "
+              "in utils/faults.py SITES)", file=sys.stderr)
+        return 1
+    print(f"[chaos-matrix] coverage closed: every registered fault site "
+          f"has a chaos test")
     offsets = range(args.offset_base, args.offset_base + args.seeds)
     failed = run_matrix(offsets)
     if failed:

@@ -354,3 +354,288 @@ def test_ordered_condition_wait_notify(lock_order_on):
         c.notify_all()
     th.join(timeout=5)
     assert hits == ["set", "woke"]
+
+
+# ----------------------------------------------------------- shared-state
+
+_RACY = (
+    "import threading\n"
+    "class W:\n"
+    "    def __init__(self):\n"
+    "        self.counter = 0\n"
+    "        self._t = threading.Thread(target=self._loop, daemon=True)\n"
+    "        self._t.start()\n"
+    "    def _loop(self):\n"
+    "        for _ in range(10):\n"
+    "            self.counter += 1\n"
+    "    def bump(self):\n"
+    "        self.counter += 1\n"
+)
+
+
+def test_shared_state_flags_multi_entry_unlocked_rmw(tmp_path):
+    """Live trip: a field RMW-mutated from both a spawned thread and the
+    main entry with no lock anywhere is exactly the race the pass hunts."""
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": _RACY})
+    found = run_lint([root], rules=("shared-state",))
+    assert len(found) == 1, [f.render() for f in found]
+    assert found[0].rule == "shared-state"
+    assert "counter" in found[0].message
+    assert "no common lock" in found[0].message
+
+
+def test_shared_state_lock_guard_is_quiet(tmp_path):
+    """The fix the finding demands, verified quiet: both sites under one
+    OrderedLock."""
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": (
+        "import threading\n"
+        "from ..utils import locks\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._mu = locks.lock('kv.widget')\n"
+        "        self.counter = 0\n"
+        "        self._t = threading.Thread(target=self._loop)\n"
+        "        self._t.start()\n"
+        "    def _loop(self):\n"
+        "        with self._mu:\n"
+        "            self.counter += 1\n"
+        "    def bump(self):\n"
+        "        with self._mu:\n"
+        "            self.counter += 1\n")})
+    assert not run_lint([root], rules=("shared-state",))
+
+
+def test_shared_state_inline_pragma_suppresses(tmp_path):
+    src = _RACY.replace(
+        "    def bump(self):\n",
+        "    def bump(self):\n"
+        "        # crlint: allow-shared-state(single writer by protocol)\n")
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": src})
+    assert not run_lint([root], rules=("shared-state",))
+
+
+def test_shared_state_def_line_waiver_covers_body(tmp_path):
+    src = _RACY.replace(
+        "    def bump(self):\n",
+        "    # crlint: allow-shared-state(test-only mutator, documented)\n"
+        "    def bump(self):\n")
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": src})
+    assert not run_lint([root], rules=("shared-state",))
+
+
+# --------------------------------------------------------- mem-accounting
+
+_HOT_ALLOC = (
+    "import numpy as np\n"
+    "def f(n):\n"
+    "    return np.zeros((n, 1024))\n"
+)
+
+
+def test_mem_accounting_flags_uncharged_hot_path_alloc(tmp_path):
+    """Live trip: a data-sized materialization on a flow hot path with no
+    accounting evidence anywhere in the function."""
+    root = _tree(tmp_path, {_HOT: _HOT_ALLOC})
+    found = run_lint([root], rules=("mem-accounting",))
+    assert len(found) == 1, [f.render() for f in found]
+    assert found[0].rule == "mem-accounting"
+    assert "np.zeros" in found[0].message
+
+
+def test_mem_accounting_evidence_and_scope(tmp_path):
+    # reserve() in the function is evidence; the same alloc in a
+    # non-hot-path module is out of scope entirely
+    root = _tree(tmp_path, {
+        _HOT: ("import numpy as np\n"
+               "def g(mon, n):\n"
+               "    mon.reserve(n * 8192)\n"
+               "    return np.zeros((n, 1024))\n"),
+        "cockroach_tpu/bench/gen.py": _HOT_ALLOC,
+    })
+    assert not run_lint([root], rules=("mem-accounting",))
+
+
+def test_mem_accounting_small_literal_shape_is_quiet(tmp_path):
+    root = _tree(tmp_path, {_HOT: (
+        "import numpy as np\n"
+        "def f():\n"
+        "    return np.zeros((4, 8))\n")})
+    assert not run_lint([root], rules=("mem-accounting",))
+
+
+def test_mem_accounting_inline_pragma_suppresses(tmp_path):
+    root = _tree(tmp_path, {_HOT: (
+        "import numpy as np\n"
+        "def f(n):\n"
+        "    # crlint: allow-mem-accounting(bounded by tile count)\n"
+        "    return np.zeros((n, 1024))\n")})
+    assert not run_lint([root], rules=("mem-accounting",))
+
+
+# --------------------------------------------------------- fault-coverage
+
+_FAULTS_FIXTURE = {
+    "cockroach_tpu/utils/faults.py": (
+        "SITES: dict[str, str] = {\n"
+        "    'a.b': 'site one',\n"
+        "    'c.d': 'site two',\n"
+        "}\n"
+        "def fire(site):\n"
+        "    pass\n"),
+    "cockroach_tpu/kv/thing.py": (
+        "from ..utils import faults\n"
+        "def f(name):\n"
+        "    faults.fire('a.b')\n"),
+    "tests/test_foo.py": (
+        "import pytest\n"
+        "pytestmark = pytest.mark.chaos\n"
+        "def test_x():\n"
+        "    assert 'a.b'\n"),
+}
+
+
+def _fault_tree(tmp_path, files):
+    _tree(tmp_path, files)
+    return [tmp_path / "cockroach_tpu", tmp_path / "tests"]
+
+
+def test_fault_coverage_flags_all_three_gaps(tmp_path):
+    """Live trip of every finding class: a computed site name, a dead
+    registration, and a registered site no chaos test exercises."""
+    files = dict(_FAULTS_FIXTURE)
+    files["cockroach_tpu/kv/thing.py"] = (
+        "from ..utils import faults\n"
+        "def f(name):\n"
+        "    faults.fire('a.b')\n"
+        "    faults.fire(name)\n")
+    found = run_lint(_fault_tree(tmp_path, files),
+                     rules=("fault-coverage",))
+    msgs = [f.message for f in found]
+    assert len(found) == 3, [f.render() for f in found]
+    assert any("not a string literal" in m for m in msgs)
+    assert any("no fire call in product code" in m for m in msgs)
+    assert any("not exercised by any chaos-marked test" in m for m in msgs)
+
+
+def test_fault_coverage_closed_loop_is_quiet(tmp_path):
+    files = dict(_FAULTS_FIXTURE)
+    files["cockroach_tpu/utils/faults.py"] = (
+        "SITES: dict[str, str] = {\n"
+        "    'a.b': 'site one',\n"
+        "}\n"
+        "def fire(site):\n"
+        "    pass\n")
+    assert not run_lint(_fault_tree(tmp_path, files),
+                        rules=("fault-coverage",))
+
+
+def test_fault_coverage_scoped_site_names_count(tmp_path):
+    """A test naming the node-scoped '<site>.n<id>' variant covers the
+    base registration (fire_scoped's contract)."""
+    files = dict(_FAULTS_FIXTURE)
+    files["cockroach_tpu/utils/faults.py"] = (
+        "SITES: dict[str, str] = {\n"
+        "    'a.b': 'site one',\n"
+        "}\n"
+        "def fire(site):\n"
+        "    pass\n")
+    files["tests/test_foo.py"] = (
+        "import pytest\n"
+        "pytestmark = pytest.mark.chaos\n"
+        "def test_x():\n"
+        "    assert 'a.b.n3'\n")
+    assert not run_lint(_fault_tree(tmp_path, files),
+                        rules=("fault-coverage",))
+
+
+def test_fault_coverage_registry_pragma_suppresses(tmp_path):
+    files = dict(_FAULTS_FIXTURE)
+    files["cockroach_tpu/utils/faults.py"] = (
+        "SITES: dict[str, str] = {\n"
+        "    'a.b': 'site one',\n"
+        "    # crlint: allow-fault-coverage(planned site, test in flight)\n"
+        "    'c.d': 'site two',\n"
+        "}\n"
+        "def fire(site):\n"
+        "    pass\n")
+    files["cockroach_tpu/kv/thing.py"] = (
+        "from ..utils import faults\n"
+        "def f():\n"
+        "    faults.fire('a.b')\n"
+        "    faults.fire('c.d')\n")
+    assert not run_lint(_fault_tree(tmp_path, files),
+                        rules=("fault-coverage",))
+
+
+# --------------------------------------------------------- unknown-pragma
+
+def test_unknown_rule_pragma_is_a_finding(tmp_path):
+    """A typo'd pragma suppresses nothing — and saying so is itself a
+    finding, so the near-miss can't silently convince anyone a waiver is
+    in force."""
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": (
+        "def f():\n"
+        "    # crlint: allow-mem-acounting(typo never suppresses)\n"
+        "    return 1\n")})
+    found = run_lint([root])
+    assert [f.rule for f in found] == ["unknown-pragma"]
+    assert "mem-acounting" in found[0].message
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_cli_exit_codes_clean_findings_internal(tmp_path):
+    from cockroach_tpu.lint.__main__ import main
+
+    clean = tmp_path / "cockroach_tpu" / "ok.py"
+    clean.parent.mkdir(parents=True, exist_ok=True)
+    clean.write_text("X = 1\n")
+    assert main([str(clean)]) == 0
+
+    dirty = tmp_path / "cockroach_tpu" / "dirty.py"
+    dirty.write_text("import jax\nf = jax.jit(lambda x: x)\n")
+    assert main([str(dirty)]) == 1
+
+    broken = tmp_path / "cockroach_tpu" / "broken.py"
+    broken.write_text("def f(:\n")
+    assert main([str(broken)]) == 2  # linter failure, not a finding
+
+
+def test_cli_changed_only_filters_report(tmp_path):
+    from cockroach_tpu.lint.__main__ import main
+
+    root = _tree(tmp_path, {
+        "cockroach_tpu/kv/a.py": "import jax\nf = jax.jit(lambda x: x)\n",
+        "cockroach_tpu/kv/b.py": "import jax\ng = jax.jit(lambda x: x)\n",
+    })
+    lst = tmp_path / "changed.txt"
+    lst.write_text("cockroach_tpu/kv/a.py\n")
+    # both files dirty, but only a.py is in the changed list
+    assert main([str(root), "--changed-only", str(lst)]) == 1
+    lst.write_text("cockroach_tpu/kv/other.py\n")
+    assert main([str(root), "--changed-only", str(lst)]) == 0
+
+
+def test_cli_json_is_stable_and_location_sorted(tmp_path):
+    import json as _json
+
+    from cockroach_tpu.lint.__main__ import main
+
+    root = _tree(tmp_path, {
+        "cockroach_tpu/kv/b.py": "import jax\ng = jax.jit(lambda x: x)\n",
+        "cockroach_tpu/kv/a.py": "import jax\nf = jax.jit(lambda x: x)\n",
+    })
+    import io
+    import contextlib
+
+    bufs = []
+    for _ in range(2):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert main([str(root), "--json"]) == 1
+        bufs.append(buf.getvalue())
+    assert bufs[0] == bufs[1]  # byte-stable across runs
+    recs = _json.loads(bufs[0])
+    locs = [(r["path"], r["line"]) for r in recs]
+    assert locs == sorted(locs)
+    assert locs[0][0].endswith("a.py")
