@@ -51,6 +51,7 @@ from .operators import (
     ScanOp,
     SmallGroupAggregateOp,
     SortOp,
+    TopKOp,
     UnionOp,
     WindowOp,
     _identity_fn,
@@ -61,14 +62,17 @@ from . import dispatch
 _CHAIN = (FilterOp, ProjectOp, HashBucketOp)
 # buffering consumers that already fuse their own spool chain (_consume);
 # their children are never wrapped — the consumer drives the composition
-_CONSUMERS = (AggregateOp, ScalarAggregateOp, SortOp, WindowOp,
+_CONSUMERS = (AggregateOp, ScalarAggregateOp, SortOp, TopKOp, WindowOp,
               SmallGroupAggregateOp)
 
 
 def _is_chain_link(op) -> bool:
     if isinstance(op, _CHAIN):
         return True
-    return isinstance(op, HashJoinOp) and op._fusable
+    # general (duplicate-key inner/left) joins are chain members too: they
+    # run source-mode, driving the chain below through their speculative
+    # emit kernel, and the chain above composes on their compacted tiles
+    return isinstance(op, HashJoinOp) and (op._fusable or op._gen_fusable)
 
 
 class _BarrierSource(Operator):
@@ -76,10 +80,14 @@ class _BarrierSource(Operator):
     pulls the barrier per batch, so the per-tile chain ABOVE it still
     composes into one kernel. Pure delegation otherwise."""
 
+    # a segment boundary: joins below it never share the jit composed above
+    # it, so chain walks (HashJoinOp.fused_depth) stop counting here
+    _chain_split = True
+
     def __init__(self, inner: Operator):
         super().__init__()
         self.inner = inner
-        self.child = inner  # chain walks (fused_depth) see through it
+        self.child = inner  # chain walks see through it for metadata
         self.output_schema = inner.output_schema
         self.dictionaries = inner.dictionaries
         self.col_stats = inner.col_stats
@@ -193,29 +201,46 @@ def _wrap(op: Operator) -> FusedPipeline:
     return FusedPipeline(op, members)
 
 
-def _chain_child(child: Operator) -> Operator:
+def _chain_child(child: Operator, jrun: int = 0) -> Operator:
     """Rewrite an input that a fusing parent composes through: recurse
     (never wrap — the parent drives the chain), then adapt a barrier
-    child into a chain source so composition does not stop there."""
-    child = _rewrite(child, parent_fuses=True)
+    child into a chain source so composition does not stop there.
+
+    ``jrun`` counts join probes already committed to the jit being composed
+    above this point. When admitting one more fusable join would push the
+    program past sql.distsql.max_fused_joins, the chain splits HERE — the
+    deeper part becomes its own FusedPipeline segment behind a barrier
+    source — instead of the runtime valve de-fusing the whole pipeline."""
+    from ..utils import settings
+
+    if (isinstance(child, HashJoinOp) and child._fusable
+            and jrun >= settings.get("sql.distsql.max_fused_joins")):
+        return _BarrierSource(_rewrite(child, parent_fuses=False))
+    child = _rewrite(child, parent_fuses=True, jrun=jrun)
     if _is_chain_link(child) or isinstance(child, ScanOp):
         return child
     return _BarrierSource(child)
 
 
-def _rewrite(op: Operator, parent_fuses: bool) -> Operator:
+def _rewrite(op: Operator, parent_fuses: bool, jrun: int = 0) -> Operator:
     if isinstance(op, _CHAIN):
-        op.child = _chain_child(op.child)
+        op.child = _chain_child(op.child, jrun)
         return op if parent_fuses else _wrap(op)
     if isinstance(op, HashJoinOp):
         if op._fusable:
-            op.child = _chain_child(op.child)
+            # this probe joins the composed jit: one more toward the budget
+            op.child = _chain_child(op.child, jrun + 1)
+        elif op._gen_fusable:
+            # source-mode: the chain below composes into THIS join's emit
+            # kernel (own jit, own budget), not the parent's
+            op.child = _chain_child(op.child, 1)
         else:
             op.child = _rewrite(op.child, parent_fuses=False)
         # build sides already spool through one fused jit (_consume_op)
         # and _plan_analytic walks their concrete types — never wrap them
         op.build = _rewrite(op.build, parent_fuses=True)
-        return op if (not op._fusable or parent_fuses) else _wrap(op)
+        fusy = op._fusable or op._gen_fusable
+        return op if (not fusy or parent_fuses) else _wrap(op)
     if isinstance(op, MergeJoinOp):
         op.child = _rewrite(op.child, parent_fuses=False)
         op.build = _rewrite(op.build, parent_fuses=True)
@@ -264,13 +289,20 @@ def plan_fusion_groups(plan) -> dict[int, int]:
     from ..plan import spec as S
 
     links = (S.Filter, S.Project, S.HashBucket)
-    heads = (S.Aggregate, S.ScalarAggregate, S.Sort, S.Window, S.Distinct)
+    heads = (S.Aggregate, S.ScalarAggregate, S.Sort, S.TopK, S.Window,
+             S.Distinct)
     groups: dict[int, int] = {}
     next_group = [1]
 
     def fusable_join(n) -> bool:
-        return isinstance(n, S.HashJoin) and (
-            n.spec.build_unique or n.spec.join_type in ("semi", "anti"))
+        from ..utils import settings
+
+        if not isinstance(n, S.HashJoin):
+            return False
+        if n.spec.build_unique or n.spec.join_type in ("semi", "anti"):
+            return True
+        return (n.spec.join_type in ("inner", "left")
+                and settings.get("sql.distsql.fusion.general_probe"))
 
     def assign(members) -> None:
         if len(members) < 2:

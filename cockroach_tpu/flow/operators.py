@@ -1198,6 +1198,81 @@ class SortOp(OneInputOperator):
         return self._fn(tuple(tiles), cap=_spool_cap(tiles))
 
 
+class TopKOp(OneInputOperator):
+    """Device top-k (sorttopk.go analog): fold a per-tile stable
+    k-selection over the input — each step keeps the first k rows of the
+    stable sort order at a static accumulator capacity — so ORDER BY ...
+    LIMIT k neither spools the input nor sorts more than O(k) rows per
+    tile. The accumulator merge rides inside the fused step kernel
+    (_fold), so a fused chain still pays ONE dispatch per tile. Output is
+    the single sorted top-k tile, bit-identical to SortOp + LimitOp (the
+    oracle plan/topkopt.py rewrites away)."""
+
+    def __init__(self, child: Operator, keys: tuple[sort_ops.SortKey, ...],
+                 k: int):
+        super().__init__(child)
+        self.output_schema = child.output_schema
+        self.keys = keys
+        self.k = int(k)
+        self._emitted = False
+
+    def init(self):
+        super().init()
+        self._emitted = False
+        if hasattr(self, "_tile_raw"):
+            return
+        rank_tables = {
+            k.col: self.child.dictionaries[k.col].ranks
+            for k in self.keys
+            if k.col in self.child.dictionaries
+        }
+        for k in self.keys:
+            if getattr(self.child.dictionaries.get(k.col), "_runtime",
+                       False):
+                raise ValueError(
+                    "ORDER BY a string_agg result is not supported"
+                )
+        schema = self.output_schema
+        keys = self.keys
+        col_stats = dict(self.child.col_stats)
+        kk = self.k
+        cap = self._acc_cap = _canonical_cap(kk)
+
+        def tile_raw(b):
+            return sort_ops.topk_batch(b, schema, keys, kk, cap,
+                                       rank_tables, col_stats)
+
+        def merge_raw(acc, new):
+            # concat compacts acc's live rows BEFORE new's, so the stable
+            # re-selection keeps earlier-tile rows first among equal keys
+            # — global stable order survives the fold
+            big = concat([acc, new], capacity=2 * cap)
+            return sort_ops.topk_batch(big, schema, keys, kk, cap,
+                                       rank_tables, col_stats)
+
+        self._tile_raw = tile_raw
+        self._tile_fn = dispatch.jit(tile_raw)
+        self._merge_raw = merge_raw
+        self._merge_fn = dispatch.jit(merge_raw)
+
+    def _next(self):
+        from .memory import Allocator, batch_bytes
+
+        if self._emitted:
+            return None
+        acc = _fold(self, "topk", self._tile_raw, self._tile_fn,
+                    self._merge_raw, self._merge_fn)
+        self._emitted = True
+        if acc is None:
+            return None
+        # the accumulator is the operator's whole resident state — O(k),
+        # but account it so EXPLAIN ANALYZE max-mem tells the truth
+        alloc = Allocator("topk accumulator", stats=self.stats)
+        alloc.reserve(batch_bytes(acc), force=True)
+        alloc.close()
+        return acc
+
+
 class DistinctOp(OneInputOperator):
     """DISTINCT via grouped aggregation with no aggregates."""
 
@@ -1317,10 +1392,21 @@ class HashJoinOp(OneInputOperator):
         #               materialization, no counts)
         from ..utils import settings as _settings
 
+        # general duplicate-key inner/left probes fuse too, as speculative
+        # streaming emitters: the probe runs at a learned static out-capacity
+        # inside the (chain o probe) kernel, per-tile totals record as device
+        # futures, and post_run_update validates them once per query — an
+        # overflow (truncated rows) grows the capacity and re-runs. Replaces
+        # the per-tile int(total) host-sync retry loop as the streaming path.
+        self._gen_fusable = (
+            not self._fusable
+            and spec.join_type in ("inner", "left")
+            and _settings.get("sql.distsql.fusion.general_probe")
+        )
         self._emit_mode = (
             "learn" if (self._fusable and _settings.get(
                 "sql.distsql.join_compact_emit"))
-            else "transparent"
+            else ("general" if self._gen_fusable else "transparent")
         )
         self._emit_cap = None
         self._emit_counts: list = []
@@ -1422,14 +1508,16 @@ class HashJoinOp(OneInputOperator):
             remaps = self.build_code_remaps or None
             spec = self.spec
 
-            @functools.partial(dispatch.jit, static_argnames=("out_cap",))
-            def probe_gen_fn(p, build, index, out_cap):
+            def probe_gen_raw(p, build, index, out_cap):
                 return join_ops.hash_join_general(
                     p, pschema, pkeys, build, bschema, bkeys, spec, out_cap,
                     pht, bht, remaps, index=index, exact_layout=layout,
                 )
 
-            self._probe_gen_fn = probe_gen_fn
+            self._probe_gen_raw = probe_gen_raw
+            self._probe_gen_fn = functools.partial(
+                dispatch.jit, static_argnames=("out_cap",)
+            )(probe_gen_raw)
             self._out_cap = 0
 
     def _set_probe(self, kind: str):
@@ -1579,10 +1667,19 @@ class HashJoinOp(OneInputOperator):
         return [self.child, self.build]
 
     def fused_depth(self) -> int:
+        """Join probes sharing ONE composed jit below (and including) this
+        join. The count stops where composition actually splits: at a
+        fusion-pass segment boundary (_chain_split barrier source) and at
+        source-mode joins (learn/compact/general emission), which drive
+        their own kernel — joins below those never enter this jit."""
         d = 1
         op = self.child
         while op is not None:
+            if getattr(op, "_chain_split", False):
+                break
             if isinstance(op, (HashJoinOp, MergeJoinOp)):
+                if getattr(op, "_emit_mode", "transparent") != "transparent":
+                    break
                 d += 1
             op = getattr(op, "child", None)
         return d
@@ -1590,7 +1687,7 @@ class HashJoinOp(OneInputOperator):
     def stream_parts(self):
         from ..utils import settings
 
-        if not self._fusable:
+        if not (self._fusable or self._gen_fusable):
             return None
         if getattr(self, "_grace", None) is not None:
             return None  # spilled: the Grace join drives the probe itself
@@ -1633,22 +1730,36 @@ class HashJoinOp(OneInputOperator):
 
     def _emit_kernel(self, cfn, nc):
         """(chain o probe o count [o compact]) jit for source-mode emission,
-        cached on (chain fn, probe fn, emission cap)."""
+        cached on (chain fn, probe fn, emission cap). General duplicate-key
+        probes emit speculatively at the learned static capacity — the
+        kernel's second output is the TRUE total, so a truncating overflow
+        is detectable at query end without a per-tile host sync."""
         from ..coldata.batch import compact as compact_batch
 
-        key = (cfn, self._probe_raw, self._emit_cap)
-        if getattr(self, "_emit_kern_key", None) == key:
-            return self._emit_kern
-        raw = self._probe_raw
         cap = self._emit_cap
+        if self._emit_mode == "general":
+            graw = self._probe_gen_raw
+            key = (cfn, graw, cap)
+            if getattr(self, "_emit_kern_key", None) == key:
+                return self._emit_kern
 
-        def kern(t, *a):
-            out = raw(cfn(t, *a[:nc]) if cfn is not None else t,
-                      a[nc], a[nc + 1])
-            cnt = jnp.sum(out.mask, dtype=jnp.int64)
-            if cap is not None:
-                out = compact_batch(out, capacity=cap)
-            return out, cnt
+            def kern(t, *a):
+                p = cfn(t, *a[:nc]) if cfn is not None else t
+                return graw(p, a[nc], a[nc + 1], cap)
+
+        else:
+            raw = self._probe_raw
+            key = (cfn, raw, cap)
+            if getattr(self, "_emit_kern_key", None) == key:
+                return self._emit_kern
+
+            def kern(t, *a):
+                out = raw(cfn(t, *a[:nc]) if cfn is not None else t,
+                          a[nc], a[nc + 1])
+                cnt = jnp.sum(out.mask, dtype=jnp.int64)
+                if cap is not None:
+                    out = compact_batch(out, capacity=cap)
+                return out, cnt
 
         self._emit_kern = dispatch.jit(kern)
         self._emit_kern_key = key
@@ -1668,8 +1779,17 @@ class HashJoinOp(OneInputOperator):
         parts = self.child.stream_parts()
         if parts is not None:
             src, cfn, cargs = parts
-            kern = self._emit_kernel(cfn, len(cargs))
             args = cargs + (self._build_batch, self._index)
+            if self._emit_mode == "general" and self._emit_cap is None:
+                # initial speculation: FK-ish fanout <= 1 per probe row at
+                # full scan tiles (the _next estimate — source tiles are raw
+                # tuples here, so the setting stands in for their capacity);
+                # post_run_update corrects in either direction
+                from ..utils import settings
+
+                self._emit_cap = max(4096, _canonical_cap(
+                    settings.get("sql.distsql.tile_size")))
+            kern = self._emit_kernel(cfn, len(cargs))
             for t in src.stream_tiles():
                 out, cnt = kern(t, *args)
                 self._emit_counts.append(cnt)
@@ -1677,11 +1797,15 @@ class HashJoinOp(OneInputOperator):
                     self._emit_tilecap = max(self._emit_tilecap, out.capacity)
                 yield out
             return
-        kern = self._emit_kernel(None, 0)
+        kern = None
         while True:
             b = self.child.next_batch()
             if b is None:
                 return
+            if kern is None:
+                if self._emit_mode == "general" and self._emit_cap is None:
+                    self._emit_cap = max(4096, _canonical_cap(b.capacity))
+                kern = self._emit_kernel(None, 0)
             out, cnt = kern(b, self._build_batch, self._index)
             self._emit_counts.append(cnt)
             if self._emit_cap is None:
@@ -1697,13 +1821,36 @@ class HashJoinOp(OneInputOperator):
         ))
         self._emit_counts = []
         mx = int(counts.max()) if counts.size else 0
+        if self._emit_mode == "general":
+            # speculative duplicate-key probe: a total past the emission
+            # capacity means that tile's rows were truncated — grow (with
+            # headroom: every retry recompiles) and re-run the query
+            if mx > self._emit_cap:
+                from ..utils import log
+
+                self._emit_cap = _canonical_cap(2 * mx)
+                log.warning(log.SQL_EXEC,
+                            "general join emission cap overflowed; re-running",
+                            max_rows=mx)
+                return True
+            if mx * 8 <= self._emit_cap and self._emit_cap > 4096:
+                # learned fanout far below speculation: shrink (keeping 2x
+                # headroom) so steady-state tiles stop carrying dead rows
+                self._emit_cap = max(4096, _canonical_cap(2 * mx))
+            return False
         overflow = (
             self._emit_mode == "compact" and self._emit_cap is not None
             and mx > self._emit_cap
         )
         tile = self._emit_tilecap
-        if tile and mx * 4 <= tile:
-            self._emit_cap = max(1024, _canonical_cap(2 * mx))
+        cap = max(1024, _canonical_cap(2 * mx))
+        if tile and mx * 4 <= tile and cap < tile:
+            # compacting only pays when the learned cap actually SHRINKS the
+            # tile — at small tile sizes the cap floor equals the tile and
+            # "compact" degenerates to one extra kernel per tile for nothing
+            # (every join in a chain then self-drives: q9's five-join run
+            # used to pay 5 kernels/tile instead of composing into 2)
+            self._emit_cap = cap
             self._emit_mode = "compact"
         else:
             self._emit_mode = "transparent"

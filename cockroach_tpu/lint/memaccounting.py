@@ -41,6 +41,9 @@ HOT_PATHS = (
     "cockroach_tpu/flow/runtime.py",
     "cockroach_tpu/flow/fuse.py",
     "cockroach_tpu/flow/external.py",
+    "cockroach_tpu/ops/merge_join.py",
+    "cockroach_tpu/ops/sort.py",
+    "cockroach_tpu/parallel/shuffle.py",
     "cockroach_tpu/storage/ingest.py",
     "cockroach_tpu/storage/blockcache.py",
     "cockroach_tpu/storage/lsm.py",

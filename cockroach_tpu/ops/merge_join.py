@@ -101,7 +101,7 @@ def rank_tables_for(probe_schema: Schema, probe_key, probe_dicts,
             ranks.append(pd.ranks[code] if code >= 0
                          else len(pd.values) + i)
         # crlint: allow-host-sync(ranks is a host python list, not a device array)
-        build_ranks.append(np.array(ranks, dtype=np.int32))
+        build_ranks.append(np.array(ranks, dtype=np.int32))  # crlint: allow-mem-accounting(dictionary-sized rank table, metadata not query data)
     return tuple(probe_ranks), tuple(build_ranks)
 
 
@@ -157,7 +157,7 @@ def lex_bsearch(sorted_lanes: tuple[jax.Array, ...],
     return pos
 
 
-def merge_join(
+def merge_join(  # crlint: allow-mem-accounting(traced kernel: buffers are XLA transients sized by out_capacity, which the dispatching operator reserves)
     probe: Batch,
     probe_schema: Schema,
     probe_key,
@@ -244,7 +244,7 @@ def merge_join(
     return Batch(cols=pcols + bcols, mask=out_live), total
 
 
-def build_merge_index(build: Batch, schema: Schema, key, rank_table=None):
+def build_merge_index(build: Batch, schema: Schema, key, rank_table=None):  # crlint: allow-mem-accounting(traced kernel: index lanes are shaped like the build batch the operator already charged)
     """Sort build rows by exact (composite) key order -> (sorted_key_lanes,
     orig_index, active_prefix). Inactive (dead/NULL-key) rows sort AFTER
     actives within an equal-key run, and active_prefix[i] counts active rows

@@ -112,7 +112,7 @@ def pack_sort_operands(
     return key_ops.pack_operands(segs)
 
 
-def sort_perm(
+def sort_perm(  # crlint: allow-mem-accounting(traced kernel: permutation lanes shaped like the charged input tile)
     batch: Batch,
     schema: Schema,
     keys: tuple[SortKey, ...],
@@ -148,6 +148,29 @@ def sort_batch(
     return apply_perm(
         batch, sort_perm(batch, schema, keys, rank_tables, col_stats)
     )
+
+
+def topk_batch(  # crlint: allow-mem-accounting(traced kernel: k-selection transients shaped like the charged input tile)
+    batch: Batch,
+    schema: Schema,
+    keys: tuple[SortKey, ...],
+    k: int,
+    capacity: int,
+    rank_tables: dict[int, np.ndarray] | None = None,
+    col_stats: dict[int, tuple] | None = None,
+) -> Batch:
+    """Stable k-selection: the first ``k`` live rows of the stable sort
+    order, re-materialized at static ``capacity`` (>= k). Equal keys at
+    the k boundary resolve by original row position — exactly the rows a
+    full sort + LIMIT k keeps — so folding per-tile selections through
+    concat (earlier tiles first) stays bit-identical with the full-sort
+    oracle. Output is sorted and compacted (dead rows masked off)."""
+    perm = sort_perm(batch, schema, keys, rank_tables, col_stats)
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    take = perm[jnp.minimum(idx, batch.capacity - 1)]
+    out = apply_perm(batch, take)
+    keep = out.mask & (idx < batch.capacity) & (idx < k)
+    return out.with_mask(keep)
 
 
 def limit_mask(batch: Batch, limit: int, offset: int = 0) -> Batch:

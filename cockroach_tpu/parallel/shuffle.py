@@ -33,12 +33,25 @@ from ..ops.hashing import hash_columns
 from .mesh import AXIS
 
 
-def _local_shuffle(batch: Batch, keys, types, hash_tables, D, send_cap, out_cap):
+def _local_shuffle(batch: Batch, keys, types, hash_tables, D, send_cap,  # crlint: allow-mem-accounting(shard_map kernel: send/recv buffers are [D, send_cap] statics from make_shuffle capacities the planner budgets)
+                   out_cap, hot=None):
     """Per-device half of the shuffle (runs inside shard_map)."""
     cap = batch.capacity
     cols = [batch.cols[i] for i in keys]
     h = hash_columns(cols, types, hash_tables)
     bucket = (h % np.uint64(D)).astype(jnp.int32)
+    keep = None
+    if hot is not None:
+        # heavy-hitter keys keep their rows LOCAL instead of funneling the
+        # key's entire row mass through one destination device — the skew
+        # escape hatch of the hash router. Kept rows never enter the send
+        # buffers (zero interconnect cost, no send-cap pressure); they
+        # merge into the output tile after the all_to_all. The caller must
+        # pair this with a REPLICATED build table for the hot keys (every
+        # device holds their build rows), which keeps local joins exact.
+        pos = jnp.clip(jnp.searchsorted(hot, h), 0, hot.shape[0] - 1)
+        keep = batch.mask & (hot[pos] == h)
+        bucket = jnp.where(keep, D, bucket)
     bucket = jnp.where(batch.mask, bucket, D)  # dead rows sort last
 
     # slot within destination bucket, via sort (stable rank-in-bucket)
@@ -48,8 +61,9 @@ def _local_shuffle(batch: Batch, keys, types, hash_tables, D, send_cap, out_cap)
     pos_sorted = iota - first
     slot = jnp.zeros((cap,), jnp.int32).at[si].set(pos_sorted)
 
-    live = batch.mask & (slot < send_cap)
-    overflow = jnp.sum(batch.mask & (slot >= send_cap), dtype=jnp.int32)
+    send_live = batch.mask if keep is None else (batch.mask & ~keep)
+    live = send_live & (slot < send_cap)
+    overflow = jnp.sum(send_live & (slot >= send_cap), dtype=jnp.int32)
     dest = jnp.where(live, bucket * send_cap + slot, D * send_cap)
 
     def scatter_col(c: Column) -> Column:
@@ -80,8 +94,17 @@ def _local_shuffle(batch: Batch, keys, types, hash_tables, D, send_cap, out_cap)
     flat = jax.tree_util.tree_map(
         lambda x: x.reshape((D * send_cap,) + x.shape[2:]), recv
     )
-    # compact received rows into the output tile
-    m = flat.mask
+    # compact received rows (plus locally-kept hot rows) into the output
+    if keep is None:
+        m = flat.mask
+        srcs = flat.cols
+    else:
+        m = jnp.concatenate([flat.mask, keep])
+        srcs = tuple(
+            Column(data=jnp.concatenate([fc.data, bc.data]),
+                   valid=jnp.concatenate([fc.valid, bc.valid]))
+            for fc, bc in zip(flat.cols, batch.cols)
+        )
     rdest = jnp.cumsum(m.astype(jnp.int32)) - 1
     rdest = jnp.where(m, rdest, out_cap)
     received = jnp.sum(m, dtype=jnp.int32)
@@ -96,7 +119,7 @@ def _local_shuffle(batch: Batch, keys, types, hash_tables, D, send_cap, out_cap)
         return Column(data=data, valid=valid)
 
     out_mask = jnp.arange(out_cap, dtype=jnp.int32) < jnp.minimum(received, out_cap)
-    out = Batch(cols=tuple(compact_col(c) for c in flat.cols), mask=out_mask)
+    out = Batch(cols=tuple(compact_col(c) for c in srcs), mask=out_mask)
     dropped = jnp.maximum(received - out_cap, 0)
     return out, (overflow + dropped)[None]  # [1] per device -> [D] global
 
@@ -109,17 +132,27 @@ def make_shuffle(
     hash_tables: dict[int, np.ndarray] | None = None,
     send_factor: float = 2.0,
     out_capacity: int | None = None,
+    hot_hashes: np.ndarray | None = None,
 ):
     """Build a jitted shuffle: (row-sharded Batch) -> (row-sharded Batch
     repartitioned by key hash, plus per-device overflow counts).
 
     After the shuffle, every row whose keys hash equal lives on the same
     device — the precondition for local final aggregation / joins, exactly
-    what the reference's hash router guarantees per consumer flow."""
+    what the reference's hash router guarantees per consumer flow.
+
+    ``hot_hashes`` (sorted or not; 64-bit key hashes) marks heavy-hitter
+    keys whose rows stay on their producing device instead of shuffling to
+    ``hash % D`` — the planner supplies them from build-side sampling
+    (GraceHashJoinOp's reservoir) and replicates those keys' build rows so
+    device-local joins stay exact. Every other row routes normally."""
     D = mesh.shape[AXIS]
     types = [schema.types[i] for i in keys]
     send_cap = max(128, int(local_capacity / D * send_factor) // 128 * 128)
     out_cap = out_capacity or local_capacity
+    hot = None
+    if hot_hashes is not None and len(hot_hashes) > 0:
+        hot = jnp.asarray(np.sort(np.asarray(hot_hashes, dtype=np.uint64)))
 
     fn = functools.partial(
         _local_shuffle,
@@ -129,6 +162,7 @@ def make_shuffle(
         D=D,
         send_cap=send_cap,
         out_cap=out_cap,
+        hot=hot,
     )
     sharded = shard_map(
         fn,

@@ -175,6 +175,9 @@ def _build(plan: S.PlanNode, catalog: Catalog, params=None) -> Operator:
         return ops.ScalarAggregateOp(_build(plan.input, catalog, params), plan.aggs)
     if isinstance(plan, S.Sort):
         return ops.SortOp(_build(plan.input, catalog, params), plan.keys)
+    if isinstance(plan, S.TopK):
+        return ops.TopKOp(_build(plan.input, catalog, params), plan.keys,
+                          plan.k)
     if isinstance(plan, S.Limit):
         return ops.LimitOp(_build(plan.input, catalog, params), plan.limit, plan.offset)
     if isinstance(plan, S.Distinct):

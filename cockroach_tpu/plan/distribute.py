@@ -35,6 +35,8 @@ def estimated_rows(plan: S.PlanNode, catalog: Catalog) -> int:
     if isinstance(plan, S.Limit):
         return min(plan.limit + plan.offset,
                    estimated_rows(plan.input, catalog))
+    if isinstance(plan, S.TopK):
+        return min(plan.k, estimated_rows(plan.input, catalog))
     if isinstance(plan, S.Union):
         return sum(estimated_rows(k, catalog) for k in plan.inputs)
     if hasattr(plan, "input"):
@@ -131,6 +133,27 @@ def _rewrite(plan, catalog, broadcast_rows):
         return (S.MergeJoin(probe, _broadcast(build, brep), plan.probe_key,
                             plan.build_key, plan.spec), prep)
 
+    if isinstance(plan, S.Limit) and isinstance(plan.input, S.TopK):
+        # distributed top-k with the device k-selection: each device folds
+        # its shard down to k rows, the gather moves D*k rows, and one
+        # final replicated TopK + Limit merges them (sorttopk.go +
+        # OrderedSynchronizer roles)
+        tk = plan.input
+        child, rep = _rewrite(tk.input, catalog, broadcast_rows)
+        if rep:
+            return S.Limit(S.TopK(child, tk.keys, tk.k), plan.limit,
+                           plan.offset), True
+        local = S.TopK(child, tk.keys, tk.k)
+        merged = S.TopK(S.Gather(local), tk.keys, tk.k)
+        return S.Limit(merged, plan.limit, plan.offset), True
+
+    if isinstance(plan, S.TopK):
+        child, rep = _rewrite(plan.input, catalog, broadcast_rows)
+        if rep:
+            return S.TopK(child, plan.keys, plan.k), True
+        local = S.TopK(child, plan.keys, plan.k)
+        return S.TopK(S.Gather(local), plan.keys, plan.k), True
+
     if isinstance(plan, S.Limit) and isinstance(plan.input, S.Sort):
         # distributed top-k (sorttopk.go + OrderedSynchronizer roles): each
         # device sorts ITS shard and keeps only limit+offset rows, the
@@ -203,7 +226,7 @@ def schema_of(plan: S.PlanNode, catalog: Catalog):
         t = catalog.get(plan.table)
         names = plan.columns or t.schema.names
         return t.schema.select(tuple(t.schema.index(n) for n in names))
-    if isinstance(plan, (S.Filter, S.Sort, S.Limit,
+    if isinstance(plan, (S.Filter, S.Sort, S.Limit, S.TopK,
                          S.Exchange, S.Broadcast, S.Gather)):
         return schema_of(plan.input, catalog)
     if isinstance(plan, S.Union):

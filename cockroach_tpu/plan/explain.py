@@ -44,6 +44,9 @@ def _node_label(n: S.PlanNode) -> str:
     if isinstance(n, S.Limit):
         off = f" offset={n.offset}" if n.offset else ""
         return f"limit {n.limit}{off}"
+    if isinstance(n, S.TopK):
+        keys = [f"{k.col}{' desc' if k.desc else ''}" for k in n.keys]
+        return f"top-k k={n.k} keys={keys}"
     if isinstance(n, S.Distinct):
         return f"distinct on={list(n.cols) if n.cols else 'all'}"
     if isinstance(n, S.Exchange):

@@ -136,6 +136,18 @@ class Limit(PlanNode):
 
 
 @dataclass(frozen=True)
+class TopK(PlanNode):
+    """ORDER BY ... LIMIT k as a device k-selection (sorttopk.go analog):
+    fold a per-tile stable top-k over the input instead of spooling and
+    fully sorting it. Output is the sorted first-k rows — bit-identical
+    to Sort + Limit, which plan/topkopt.py rewrites into this node."""
+
+    input: PlanNode
+    keys: tuple[SortKey, ...]
+    k: int
+
+
+@dataclass(frozen=True)
 class Distinct(PlanNode):
     input: PlanNode
     cols: tuple[int, ...] | None = None  # None = all columns

@@ -1,0 +1,70 @@
+"""Top-k pushdown — rewrite Limit(Sort) into Limit(TopK).
+
+Reference: the optimizer's GenerateLimitedScans / ordering-aware limit
+rules let a LIMIT under an ORDER BY plan as a top-k sorter
+(pkg/sql/colexec/sorttopk.go keeps a K-row heap) instead of a full sort
+followed by truncation.
+
+Here the rewrite swaps the Sort under a Limit for a TopK node carrying
+k = limit + offset; flow/operators.TopKOp folds a per-tile stable
+k-selection over the input so the query neither spools nor fully sorts
+it. The Limit stays on top and applies the OFFSET over the sorted top-k
+tile — bit-identical to the Sort + Limit plan it replaces (TopK's output
+is the stable sort order's first k rows, exactly the rows Limit keeps).
+
+Gate: k must stay under ``sql.opt.topk.max_k`` — a huge LIMIT makes the
+O(k) accumulator no better than the sort spool it replaces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..utils import settings
+from . import spec as S
+
+TOPK_ENABLED = settings.register_bool(
+    "sql.opt.topk.enabled", True,
+    "plan ORDER BY ... LIMIT k as a device top-k selection instead of a "
+    "full sort + truncate", metamorphic=True,
+)
+TOPK_MAX_K = settings.register_int(
+    "sql.opt.topk.max_k", 65536,
+    "largest limit+offset planned as a top-k selection; beyond this the "
+    "O(k) accumulator loses to the sort spool", lo=1,
+)
+
+
+def push_topk(plan: S.PlanNode) -> S.PlanNode:
+    """Recursively rewrite eligible Limit(Sort) subtrees."""
+    if not settings.get("sql.opt.topk.enabled"):
+        return plan
+    return _rewrite(plan)
+
+
+def _rewrite(plan):
+    if (isinstance(plan, S.Limit)
+            and isinstance(plan.input, S.Sort)
+            and plan.limit + plan.offset <= settings.get(
+                "sql.opt.topk.max_k")):
+        srt = plan.input
+        return S.Limit(
+            S.TopK(_rewrite(srt.input), srt.keys,
+                   plan.limit + plan.offset),
+            plan.limit, plan.offset,
+        )
+    # generic recursion over PlanNode dataclass fields
+    if not dataclasses.is_dataclass(plan):
+        return plan
+    changes = {}
+    for f in dataclasses.fields(plan):
+        v = getattr(plan, f.name)
+        if isinstance(v, S.PlanNode):
+            nv = _rewrite(v)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, tuple) and v and isinstance(v[0], S.PlanNode):
+            nv = tuple(_rewrite(x) for x in v)
+            if any(a is not b for a, b in zip(nv, v)):
+                changes[f.name] = nv
+    return dataclasses.replace(plan, **changes) if changes else plan

@@ -401,10 +401,12 @@ class Rel:
 
     def optimized_plan(self) -> S.PlanNode:
         """Plan after local optimization passes (index selection —
-        plan/indexopt.py). Distribution has its own rewrite."""
+        plan/indexopt.py; top-k pushdown — plan/topkopt.py). Distribution
+        has its own rewrite."""
         from ..plan.indexopt import use_indexes
+        from ..plan.topkopt import push_topk
 
-        return use_indexes(self.plan, self.catalog)
+        return push_topk(use_indexes(self.plan, self.catalog))
 
     def run(self) -> dict[str, np.ndarray]:
         return run_plan(self.optimized_plan(), self.catalog)

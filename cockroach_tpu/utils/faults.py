@@ -53,6 +53,16 @@ Reference mapping (each named site's CockroachDB analogue):
   swap and its cache/bloom bookkeeping: block-cache invalidation for the
   replaced runs must still happen or readers could be served stale
   cached windows.
+- ``flow.spill.partition_write`` — a host spill-partition write failing
+  mid-stage (colcontainer's disk queue enqueue erroring,
+  diskqueue.go's write path): the spilling operator's query fails but
+  the staging account must not retain bytes for rows never staged,
+  and monitors must still drain to zero.
+- ``flow.spill.merge_probe`` — an oversized Grace-join partition's
+  sorted-run merge-probe failing between runs (the external joiner's
+  partition-processing window): partial join output may already have
+  streamed downstream; the query must surface the error and a clean
+  re-run must produce complete, correct output.
 - ``storage.bloom.build``    — bloom filter construction failure.
   `error` models an allocation/build crash (the run serves reads
   filterless — correct, just unpruned); `partial` models silent bit
@@ -101,6 +111,8 @@ SITES: dict[str, str] = {
     "ranger.merge.apply": "merge partially applied before bookkeeping",
     "ranger.lease.transfer": "lease transfer write lost in flight",
     "storage.ingest.link": "bulk-ingest side file durable, link lost",
+    "flow.spill.partition_write": "host spill-partition write failure",
+    "flow.spill.merge_probe": "oversized-partition merge-probe run failure",
     "storage.compaction.swap": "crash between run swap and bookkeeping",
     "storage.bloom.build": "bloom build crash or silent bit corruption",
 }

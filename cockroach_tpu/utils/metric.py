@@ -336,6 +336,15 @@ GRACE_JOIN_SPILLS = DEFAULT.counter(
     "sql_grace_join_spills",
     "hash joins whose build side exceeded workmem and spilled to the "
     "Grace hash join")
+GRACE_JOIN_MERGE_PARTS = DEFAULT.counter(
+    "sql_grace_join_merge_parts",
+    "Grace join partitions whose build side alone exceeded workmem and "
+    "degraded to chunked sorted-run merge probing instead of one "
+    "in-memory hash table")
+GRACE_JOIN_SKEW_ROUTED = DEFAULT.counter(
+    "sql_grace_join_skew_rows",
+    "probe rows routed through the resident heavy-hitter build table "
+    "instead of host partitions during a Grace hash join")
 ADMISSION_SQL_SLOTS = DEFAULT.gauge(
     "admission_sql_slots",
     "configured concurrency slots of the SQL admission WorkQueue "

@@ -200,6 +200,21 @@ WORKMEM_BYTES = register_int(
     "operator variant (disk_spiller.go:103)",
     lo=1 << 16,
 )
+GRACE_SKEW_SAMPLE = register_int(
+    "sql.distsql.grace_skew_sample", 1024,
+    "reservoir size for build-side key-hash sampling while a Grace hash "
+    "join partitions its input; heavy hitters detected in the sample keep "
+    "their build rows resident on device and their probe rows route "
+    "through a dedicated hot lane instead of one oversized partition "
+    "(0 disables sampling)",
+    lo=0, hi=1 << 20,
+)
+GRACE_SKEW_FRAC = register_float(
+    "sql.distsql.grace_skew_frac", 0.05,
+    "fraction of the build-side key sample one key hash must own to count "
+    "as a heavy hitter for Grace-join skew routing (0 disables routing)",
+    lo=0.0, hi=1.0,
+)
 PALLAS_FILTER = register_enum(
     "storage.pallas_filter", "auto",
     "MVCC window scan-filter implementation: 'auto' uses the fused Pallas "
@@ -312,6 +327,13 @@ JOIN_COMPACT_EMIT = register_bool(
     "sql.distsql.join_compact_emit", True,
     "adaptively compact selective join probe output in-kernel (learned "
     "sticky capacity, overflow-checked once per query)",
+    metamorphic=True,
+)
+FUSION_GENERAL_PROBE = register_bool(
+    "sql.distsql.fusion.general_probe", True,
+    "fuse duplicate-key inner/left join probes as speculative streaming "
+    "emitters (static learned capacity, totals validated once per query) "
+    "instead of per-tile host-synced capacity retries",
     metamorphic=True,
 )
 DENSE_AGG_STATES = register_int(

@@ -28,6 +28,14 @@ import sys
 # slice+filter+project+group+merge dispatches + finalize + sort. The
 # unfused engine measures 31.
 BUDGET_STEADY = 10
+# the join-plane queries, same harness: q9's part|supplier|orders chain
+# probes its build tables inside the fused per-tile step kernel (measured
+# 20 warm at sf 0.001 / 6 lineitem tiles; an unfused chain pays one
+# dispatch + readback per join per tile and blows well past this), and
+# q18's ORDER BY ... LIMIT runs as a folded device top-k instead of a
+# full sort spool (measured 23).
+BUDGET_STEADY_Q9 = 24
+BUDGET_STEADY_Q18 = 27
 # ONE fused pre-aggregation kernel per extra input tile (acceptance
 # criterion of the fusion work; measured exactly 1.0) — the accumulator
 # merge rides inside the fold step kernel. The unfused engine pays 5.
@@ -43,7 +51,7 @@ _SF = 0.001
 _TILE = 1024
 
 
-def _steady_dispatches(cat, tile: int) -> int:
+def _steady_dispatches(cat, tile: int, qname: str = "q1") -> int:
     from cockroach_tpu.bench import queries as Q
     from cockroach_tpu.flow import dispatch
     from cockroach_tpu.flow.runtime import run_operator
@@ -51,7 +59,7 @@ def _steady_dispatches(cat, tile: int) -> int:
     from cockroach_tpu.utils import settings
 
     settings.set("sql.distsql.tile_size", tile)
-    root = plan_builder.build(Q.QUERIES["q1"](cat).optimized_plan(), cat)
+    root = plan_builder.build(Q.QUERIES[qname](cat).optimized_plan(), cat)
     run_operator(root)  # warm: compile + adaptive capacity learning
     d0 = dispatch.total()
     run_operator(root)
@@ -120,6 +128,15 @@ def check() -> list[str]:
                 f"({steady} -> {halved} when tiles double from {tiles}) "
                 f"exceed the budget {BUDGET_PER_TILE} — the per-tile "
                 "chain is no longer one fused kernel")
+        for qname, budget in (("q9", BUDGET_STEADY_Q9),
+                              ("q18", BUDGET_STEADY_Q18)):
+            got = _steady_dispatches(cat, _TILE, qname)
+            if got > budget:
+                problems.append(
+                    f"{qname} steady-state kernel dispatches {got} exceed "
+                    f"the recorded budget {budget} — the multiway fused "
+                    "probe (q9) or device top-k fold (q18) stopped "
+                    "covering the join plane's per-tile work")
         spmd = _spmd_dispatches()
         if spmd < 1:
             problems.append(
@@ -144,7 +161,9 @@ def main() -> int:
     if not problems:
         print("dispatch budget clean: fused pipeline within "
               f"{BUDGET_STEADY} steady / {BUDGET_PER_TILE}-per-tile, "
-              f"distributed plan within {BUDGET_SPMD}")
+              f"q9 within {BUDGET_STEADY_Q9}, q18 within "
+              f"{BUDGET_STEADY_Q18}, distributed plan within "
+              f"{BUDGET_SPMD}")
     return 1 if problems else 0
 
 

@@ -1110,3 +1110,87 @@ def test_race_sanitizer_single_thread_unlocked_is_quiet():
     for _ in range(5):
         racesan.note_write(o, "field")
         racesan.note_read(o, "field")
+
+
+# -- spill-join fault sites -------------------------------------------------
+
+
+def _spill_join_catalog(seed=23) -> Catalog:
+    """Two tables big enough that the join Grace-partitions under a tiny
+    workmem AND at least one partition's build side alone exceeds it
+    (forcing the merge-probe run path)."""
+    rng = np.random.default_rng(seed)
+    cat = Catalog()
+    cat.add(Table(
+        name="probe",
+        schema=Schema(("k", "w"), (INT64, INT64)),
+        columns={"k": rng.integers(0, 1200, 4000, dtype=np.int64),
+                 "w": rng.integers(0, 100, 4000, dtype=np.int64)},
+    ))
+    cat.add(Table(
+        name="build",
+        schema=Schema(("bk", "v"), (INT64, INT64)),
+        columns={"bk": rng.integers(0, 1500, 36000, dtype=np.int64),
+                 "v": rng.integers(0, 100, 36000, dtype=np.int64)},
+    ))
+    return cat
+
+
+def _run_spill_join(cat: Catalog, workmem: int) -> dict:
+    from cockroach_tpu.sql.rel import Rel
+
+    prev = settings.get("sql.distsql.workmem_bytes")
+    settings.set("sql.distsql.workmem_bytes", workmem)
+    try:
+        return (Rel.scan(cat, "probe")
+                .join(Rel.scan(cat, "build"), on=[("k", "bk")],
+                      how="inner", build_unique=False)
+                .groupby(["k"], [("n", "count_rows", None),
+                                 ("sv", "sum", "v")])
+                .run())
+    finally:
+        settings.set("sql.distsql.workmem_bytes", prev)
+
+
+def test_spill_partition_write_fault_surfaces_then_clean_rerun():
+    """A host spill-partition write failure mid-staging surfaces as a
+    typed QueryError carrying the injected fault (not silent row loss),
+    every staging reservation drains (fire precedes the account), and a
+    clean re-run equals the no-fault oracle."""
+    from cockroach_tpu.utils.errors import QueryError
+
+    cat = _spill_join_catalog()
+    want = _run_spill_join(cat, workmem=2 << 30)
+    faults.arm(31, {"flow.spill.partition_write":
+                    FaultSpec(kind="error", p=1.0, max_fires=1)})
+    try:
+        with pytest.raises(QueryError) as ei:
+            _run_spill_join(cat, workmem=1 << 16)
+        assert isinstance(ei.value.__cause__, InjectedFault)
+        assert faults.fired(), "spill staging never hit the fault site"
+    finally:
+        faults.disarm()
+    _assert_equal(_run_spill_join(cat, workmem=1 << 16), want)
+
+
+def test_spill_merge_probe_fault_surfaces_then_clean_rerun():
+    """An oversized-partition merge-probe run failure surfaces mid-query
+    (as a typed QueryError) after partial output may already have
+    streamed; monitors drain and a clean re-run is exact."""
+    from cockroach_tpu.utils.errors import QueryError
+
+    cat = _spill_join_catalog()
+    want = _run_spill_join(cat, workmem=2 << 30)
+    merge0 = metric.GRACE_JOIN_MERGE_PARTS.value
+    faults.arm(37, {"flow.spill.merge_probe":
+                    FaultSpec(kind="error", p=1.0, max_fires=1)})
+    try:
+        with pytest.raises(QueryError) as ei:
+            _run_spill_join(cat, workmem=1 << 16)
+        assert isinstance(ei.value.__cause__, InjectedFault)
+        assert faults.fired(), "join never reached the merge-probe path"
+    finally:
+        faults.disarm()
+    got = _run_spill_join(cat, workmem=1 << 16)
+    assert metric.GRACE_JOIN_MERGE_PARTS.value > merge0
+    _assert_equal(got, want)

@@ -184,3 +184,44 @@ def test_explain_analyze_reports_dispatches(hcat):
     assert compiles.startswith("kernel compiles: ")
     assert "[pipeline" in text
     assert len(res["l_returnflag"]) > 0
+
+
+def test_general_probe_fusion_equivalence(hcat):
+    """Non-unique (fan-out) inner probes fuse as speculative streaming
+    emitters under sql.distsql.fusion.general_probe; the gated-off run —
+    the probe breaking the chain like pre-fusion engines — is the oracle."""
+    from cockroach_tpu.sql.rel import Rel
+
+    rel = (Rel.scan(hcat, "orders")
+           .join(Rel.scan(hcat, "lineitem"),
+                 on=[("o_orderkey", "l_orderkey")], how="inner",
+                 build_unique=False)
+           .groupby(["o_orderkey"], [("n", "count_rows", None)]))
+    settings.set("sql.distsql.fusion.general_probe", False)
+    try:
+        want = _run(rel, fusion=True)
+    finally:
+        settings.reset("sql.distsql.fusion.general_probe")
+    _assert_identical(_run(rel, fusion=True), want)
+
+
+@pytest.mark.parametrize("qname", ["q9", "q18"])
+def test_spill_and_skew_forced_tpch_equivalence(hcat, qname):
+    """The join-plane escape hatches must not change a single bit: q9/q18
+    re-run with workmem forced down (Grace spill + hybrid partition
+    degrade) and the skew sampler armed aggressively, against the
+    in-memory fused oracle."""
+    from cockroach_tpu.utils import metric
+
+    rel = Q.QUERIES[qname](hcat)
+    want = _run(rel, fusion=True)
+    spills0 = metric.GRACE_JOIN_SPILLS.value
+    settings.set("sql.distsql.workmem_bytes", 1 << 16)
+    settings.set("sql.distsql.grace_skew_frac", 0.02)
+    try:
+        got = _run(rel, fusion=True)
+    finally:
+        settings.reset("sql.distsql.workmem_bytes")
+        settings.reset("sql.distsql.grace_skew_frac")
+    assert metric.GRACE_JOIN_SPILLS.value > spills0, "never spilled"
+    _assert_identical(got, want)

@@ -1,0 +1,103 @@
+"""Device top-k pushdown (plan/topkopt.py + flow/operators.TopKOp).
+
+ORDER BY ... LIMIT plans as a per-tile k-selection instead of a full
+sort + truncate (sorttopk.go's K-row heap). The contract under test is
+bit-identity: the TopK plan must return exactly the rows — in exactly
+the order — of the stable full sort it replaces, including when
+duplicate sort keys straddle the k boundary, under OFFSET, with k > n,
+with composite keys, and with pipeline fusion on or off.
+"""
+
+import numpy as np
+import pytest
+
+import cockroach_tpu.plan.topkopt  # crlint: allow-unused-import(registers sql.opt.topk.* settings before tests set them)
+from cockroach_tpu import catalog as catalog_mod
+from cockroach_tpu.coldata.types import INT64, Schema
+from cockroach_tpu.sql.rel import Rel
+from cockroach_tpu.utils import settings
+
+
+@pytest.fixture(scope="module")
+def cat():
+    rng = np.random.default_rng(42)
+    n = 5000
+    c = catalog_mod.Catalog()
+    c.add(catalog_mod.Table.from_strings(
+        "t", Schema.of(a=INT64, b=INT64, row=INT64),
+        # ~125 duplicates per value of a: any small k cuts mid-tie-run
+        {"a": rng.integers(0, 40, n).astype(np.int64),
+         "b": rng.integers(0, 1000, n).astype(np.int64),
+         "row": np.arange(n, dtype=np.int64)}))
+    return c
+
+
+def _run(cat, keys, k, offset, topk, fusion=True):
+    settings.set("sql.opt.topk.enabled", topk)
+    settings.set("sql.distsql.fusion.enabled", fusion)
+    try:
+        return Rel.scan(cat, "t").sort(keys).limit(k, offset=offset).run()
+    finally:
+        settings.reset("sql.opt.topk.enabled")
+        settings.reset("sql.distsql.fusion.enabled")
+
+
+def _assert_identical(got, want):
+    assert sorted(got) == sorted(want)
+    for name in want:
+        np.testing.assert_array_equal(
+            np.asarray(got[name]), np.asarray(want[name]), err_msg=name)
+
+
+CASES = [
+    ([("a", False)], 50, 0),        # ascending, cut inside a tie run
+    ([("a", True)], 64, 10),        # descending + OFFSET
+    ([("a", False), ("b", True)], 100, 0),  # composite asc/desc
+    ([("b", False)], 1, 0),         # k = 1
+    ([("a", False)], 10000, 0),     # k > n: whole table
+    ([("a", False)], 100, 4990),    # offset reaches past most of k
+]
+
+
+@pytest.mark.parametrize("keys,k,offset", CASES)
+def test_topk_bit_identical_to_full_sort(cat, keys, k, offset):
+    want = _run(cat, keys, k, offset, topk=False)
+    got = _run(cat, keys, k, offset, topk=True)
+    _assert_identical(got, want)
+
+
+@pytest.mark.parametrize("fusion", [True, False])
+def test_topk_fusion_on_off(cat, fusion):
+    keys, k = [("a", False), ("b", True)], 77
+    want = _run(cat, keys, k, 0, topk=False, fusion=False)
+    got = _run(cat, keys, k, 0, topk=True, fusion=fusion)
+    _assert_identical(got, want)
+
+
+def test_topk_values_against_numpy(cat):
+    """Independent oracle: the sort-key values of the top-k rows equal the
+    numpy-sorted prefix (tie order aside, the selected multiset of keys
+    is forced)."""
+    k = 123
+    res = _run(cat, [("a", False), ("b", False)], k, 0, topk=True)
+    tbl = cat.get("t")
+    a = np.asarray(tbl.columns["a"])
+    b = np.asarray(tbl.columns["b"])
+    order = np.lexsort((b, a))[:k]
+    np.testing.assert_array_equal(np.asarray(res["a"]), a[order])
+    np.testing.assert_array_equal(np.asarray(res["b"]), b[order])
+
+
+def test_topk_plan_label_and_gates(cat):
+    rel = Rel.scan(cat, "t").sort([("a", False)]).limit(20)
+    settings.set("sql.opt.topk.enabled", True)
+    try:
+        assert "top-k" in rel.explain()
+        settings.set("sql.opt.topk.max_k", 10)
+        assert "top-k" not in rel.explain()  # k over the cap: keep the sort
+        settings.reset("sql.opt.topk.max_k")
+        settings.set("sql.opt.topk.enabled", False)
+        assert "top-k" not in rel.explain()
+    finally:
+        settings.reset("sql.opt.topk.enabled")
+        settings.reset("sql.opt.topk.max_k")
