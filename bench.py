@@ -356,10 +356,18 @@ def _worker(job: str) -> None:
         # door, measuring throughput, admission queue-wait, and peak HBM
         from cockroach_tpu.bench.load import run_mixed_load
 
+        from cockroach_tpu.bench.load import run_tenant_overload
+
         r = run_mixed_load(
             sessions=int(os.environ.get("BENCH_LOAD_SESSIONS", "4")),
             duration_s=float(os.environ.get("BENCH_LOAD_S", "10")),
             sf=float(os.environ.get("BENCH_LOAD_SF", "0.01")),
+        )
+        # multi-tenant overload oracle rides the same worker: well-behaved
+        # vs noisy tenant past saturation — goodput must stay flat, every
+        # refusal typed (53300), per-tenant p99 isolation must hold
+        ovl = run_tenant_overload(
+            duration_s=float(os.environ.get("BENCH_OVERLOAD_S", "8")),
         )
         print("RESULT " + json.dumps({
             "job": job, "platform": platform,
@@ -377,6 +385,9 @@ def _worker(job: str) -> None:
             "peak_hbm_bytes": r["peak_hbm_bytes"],
             "spills": r["spills"],
             "drain_failures": r["drain_failures"],
+            "shed": r["shed"],
+            **{f"overload_{k}": v for k, v in ovl.items()
+               if k not in ("last_error", "rejections_by_reason")},
         }), flush=True)
         return
     from cockroach_tpu.bench import tpch

@@ -33,6 +33,12 @@ from .txn import DB
 _PREFIX = b"\x01tnt"
 
 SYSTEM_TENANT_ID = 1
+# utils/admission.py hardcodes this id (the utils layer must not import
+# kv); keep the two pinned together
+from ..utils.admission import SYSTEM_TENANT_ID as _ADM_SYSTEM_ID  # noqa: E402
+
+assert _ADM_SYSTEM_ID == SYSTEM_TENANT_ID
+
 _SYSTEM_RANGE = (1, 127)
 _RANGE_WIDTH = 16
 _FIRST_SECONDARY_LO = 128

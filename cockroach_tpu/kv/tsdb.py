@@ -61,6 +61,15 @@ class TimeSeriesDB:
                 self.db.put(_key(mname + "_sum", wall),
                             _SAMPLE.pack(wall, float(m.sum)))
                 n += 2
+            elif isinstance(m, (metric_mod.LabeledCounter,
+                                metric_mod.LabeledGauge)):
+                # one series per observed label value (per-tenant tokens,
+                # per-lane queue depth): name.<label_value>, charted like
+                # any scalar series
+                for k, v in m.items():
+                    self.db.put(_key(f"{mname}.{k}", wall),
+                                _SAMPLE.pack(wall, float(v)))
+                    n += 1
         return n
 
     def query(self, name: str, start_ms: int = 0,

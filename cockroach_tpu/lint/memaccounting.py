@@ -35,7 +35,10 @@ RULE = "mem-accounting"
 
 # flow/storage hot paths: the modules whose allocations move query- or
 # ingest-sized data. Cold paths (planner, catalog, pgwire) stay out of
-# scope — their arrays are row-count-of-metadata sized.
+# scope — their arrays are row-count-of-metadata sized. utils/admission
+# stays out too: the serving plane queues WAITERS (events + per-tenant
+# scalars, a bounded float list of wait samples), never batches/tiles —
+# there is nothing monitor-sized to account.
 HOT_PATHS = (
     "cockroach_tpu/flow/operators.py",
     "cockroach_tpu/flow/runtime.py",

@@ -127,9 +127,15 @@ def load_payload(node=None) -> dict:
             "slots": q.slots,
             "slotsInUse": q.in_use,
             "queueDepth": q.queue_depth,
+            "maxQueueDepth": q.max_queue_depth,
             "admitted": q.admitted,
             "waited": q.waited,
             "timeouts": q.timeouts,
+            "rejected": q.rejected,
+            "rejectionsByReason": dict(q.rejections_by_reason),
+            "laneQueueDepth": q.lane_depths(),
+            "shedFloor": admission.shed_floor(),
+            "tenants": q.tenant_rows(),
         },
         "activity": {
             "sessions": len(activity.sessions()),

@@ -32,7 +32,7 @@ from ..kv.jobs import Registry, register_builtin_jobs
 from ..kv.liveness import LeaseManager, NodeLiveness
 from ..kv.tsdb import TimeSeriesDB
 from ..storage.lsm import Engine
-from ..utils import log, metric, settings
+from ..utils import admission, log, metric, settings
 
 
 class Node:
@@ -116,6 +116,12 @@ class Node:
         ran = run_upgrades(self.db)
         for name in ran:
             log.info(log.OPS, "upgrade migration complete", name=name)
+
+        # the serving engine's L0 health feeds the admission shed ladder:
+        # a badly-behind LSM sheds analytical statements before the write
+        # path inverts (io_load_listener -> GrantCoordinator shape)
+        if getattr(eng, "governor", None) is not None:
+            admission.set_io_health_provider(eng.governor.l0_overload)
 
         self._spawn(self._heartbeat_loop, "liveness-heartbeat")
         self._spawn(self._metrics_loop, "tsdb-poller")
@@ -207,6 +213,7 @@ class Node:
 
     def stop(self) -> None:
         self._stop.set()
+        admission.set_io_health_provider(None)
         if self.ranger is not None:
             self.ranger.stop()
             self.ranger = None
@@ -389,7 +396,6 @@ class Node:
                 # when nothing ran since the last tick
                 from ..flow import memory as flowmem
                 from ..storage import blockcache
-                from ..utils import admission
 
                 flowmem.refresh_gauges()
                 admission.refresh_gauges()

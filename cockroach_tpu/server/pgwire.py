@@ -414,12 +414,17 @@ def _inline_params(sql: str, params: list) -> str:
 def _sqlstate_for(e: Exception) -> str:
     from ..kv.txn import TransactionRetryError
     from ..storage.lsm import WriteIntentError
-    from ..utils.errors import QueryError
+    from ..utils.errors import AdmissionRejectedError, QueryError
 
     if isinstance(e, QueryError) and e.__cause__ is not None:
         return _sqlstate_for(e.__cause__)
     if isinstance(e, (TransactionRetryError, WriteIntentError)):
         return "40001"  # serialization_failure: clients retry
+    if isinstance(e, AdmissionRejectedError):
+        # insufficient_resources class: the node is shedding load (queue
+        # full / rate limit / overload). The message carries the
+        # retry-after hint; clients back off instead of hammering
+        return "53300"
     return "XX000"
 
 

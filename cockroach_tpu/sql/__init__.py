@@ -48,6 +48,19 @@ def explain(catalog, text: str) -> str:
         # storage read-path health alongside the plan status: how much of
         # this node's point/seek traffic the block cache absorbed
         out += f"\nblock cache: {blockcache.node_cache().describe()}"
+        # serving-plane health: what admission a normal execution of this
+        # statement would face right now (its lane, the queue, shed state)
+        from ..utils import admission
+
+        aq = admission.sql_queue()
+        pri = admission.classify_statement(t)
+        lanes = aq.lane_depths()
+        out += (f"\nadmission: lane={admission.lane_for(pri)} "
+                f"slots={aq.in_use}/{aq.slots} "
+                f"queued={lanes[admission.LANE_INTERACTIVE]}i"
+                f"+{lanes[admission.LANE_ANALYTICAL]}a "
+                f"shed_floor={admission.shed_floor()} "
+                f"rejected={aq.rejected}")
         if debug:
             from . import diagnostics
             from ..flow.runtime import last_trace_span

@@ -150,6 +150,35 @@ def _cluster_load(catalog) -> Table:
     ])
 
 
+def _node_tenant_admission(catalog) -> Table:
+    """Per-tenant admission state (the tenant rate-limiter / fair-share
+    surface): token bucket level + config, stride-scheduler virtual
+    time, and admit/reject counters, one row per tenant the queue has
+    seen. Shed state and per-lane queue depth ride along so one query
+    answers "who is being refused, and why"."""
+    from ..utils import admission
+
+    q = admission.sql_queue()
+    rows = q.tenant_rows()
+    lanes = q.lane_depths()
+    floor = admission.shed_floor()
+    return _table("crdb_internal.node_tenant_admission", [
+        ("tenant_id", T.INT64, _ints(r["tenant_id"] for r in rows)),
+        ("tokens", T.FLOAT64, _floats(r["tokens"] for r in rows)),
+        ("rate", T.FLOAT64, _floats(r["rate"] for r in rows)),
+        ("burst", T.FLOAT64, _floats(r["burst"] for r in rows)),
+        ("vtime", T.FLOAT64, _floats(r["vtime"] for r in rows)),
+        ("weight", T.FLOAT64, _floats(r["weight"] for r in rows)),
+        ("admitted", T.INT64, _ints(r["admitted"] for r in rows)),
+        ("rejected", T.INT64, _ints(r["rejected"] for r in rows)),
+        ("queue_interactive", T.INT64,
+         _ints([lanes.get(admission.LANE_INTERACTIVE, 0)] * len(rows))),
+        ("queue_analytical", T.INT64,
+         _ints([lanes.get(admission.LANE_ANALYTICAL, 0)] * len(rows))),
+        ("shed_floor", T.INT64, _ints([floor] * len(rows))),
+    ])
+
+
 def _cluster_queries(catalog) -> Table:
     from . import activity
 
@@ -267,6 +296,7 @@ _BUILDERS = {
     "crdb_internal.hot_ranges": _hot_ranges,
     "crdb_internal.node_memory_monitors": _memory_monitors,
     "crdb_internal.cluster_load": _cluster_load,
+    "crdb_internal.node_tenant_admission": _node_tenant_admission,
 }
 
 

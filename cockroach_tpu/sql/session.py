@@ -100,6 +100,20 @@ class Session:
             reg = TenantRegistry(self.db)
             reg.bootstrap()
             self.tenant = reg.get(tenant)
+            # admission capabilities bind here: a tenant carrying
+            # admission_rate / admission_burst / admission_weight caps
+            # gets its token bucket / fair-share weight configured past
+            # the cluster defaults (tenant rate-limiter shape)
+            caps = self.tenant.caps
+            if any(k in caps for k in ("admission_rate", "admission_burst",
+                                       "admission_weight")):
+                from ..utils import admission as _adm
+
+                _adm.sql_queue().configure_tenant(
+                    self.tenant.tenant_id,
+                    rate=caps.get("admission_rate"),
+                    burst=caps.get("admission_burst"),
+                    weight=caps.get("admission_weight"))
         if db is not None and bootstrap:
             # opening over an existing store: rediscover persisted tables
             # from their descriptors (the catalog bootstrap path), plus any
@@ -182,7 +196,16 @@ class Session:
             # session's tier, then the root span of the statement's trace:
             # everything below — parse/bind, plan-cache lookup, flow pull,
             # KV batches, WAL appends — nests under them via contextvars
-            with admission.sql_slot(), \
+            # the slot request carries this session's tenant, the
+            # statement's lane (analytical sheds first under overload),
+            # and the statement deadline — queue-wait counts against
+            # statement_timeout, so a full queue is a fast typed 53300
+            # instead of a silent stall
+            with admission.sql_slot(
+                    admission.classify_statement(text),
+                    tenant_id=(None if self.tenant is None
+                               else self.tenant.tenant_id),
+                    deadline=self._statement_deadline()), \
                     flowmem.query_scope(self._mem_mon) as qmon, \
                     tracing.span("sql.execute",
                                  stmt=text.strip()[:120]) as sp:
@@ -220,6 +243,25 @@ class Session:
                                 mem_bytes=mem_peak, spills=mem_spills)
         self._maybe_slow_query(text, elapsed, sp)
         return out
+
+    def _statement_deadline(self) -> float | None:
+        """time.monotonic() deadline from the statement_timeout session
+        var (milliseconds, postgres convention; 0/unset = none). Handed
+        to admission so queue-wait spends the same budget as execution —
+        a statement must not wait out its whole timeout in the queue and
+        then start running."""
+        sv = getattr(self, "_session_vars", None)
+        if not sv:
+            return None
+        try:
+            ms = float(sv.get("statement_timeout", 0) or 0)
+        except (TypeError, ValueError):
+            return None
+        if ms <= 0:
+            return None
+        import time as _time
+
+        return _time.monotonic() + ms / 1e3
 
     def _maybe_slow_query(self, text: str, elapsed_s: float, span,
                           error: bool = False) -> None:

@@ -25,9 +25,30 @@ class QueryError(Exception):
         )
 
 
+class AdmissionRejectedError(Exception):
+    """A statement was refused admission — wait queue at
+    admission.sql.max_queue_depth, tenant token bucket empty, the node
+    shedding this priority lane under overload, or the queue-wait
+    deadline ran out. Maps to SQLSTATE 53300 ("too many connections" /
+    server busy) at the pgwire boundary; ``retry_after_s`` is the hint
+    clients should back off by (the tenant bucket's refill time when
+    rate-limited, a queue-drain estimate otherwise)."""
+
+    def __init__(self, reason: str, retry_after_s: float = 0.0,
+                 tenant_id: int | None = None):
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.tenant_id = tenant_id
+        msg = f"admission rejected: {reason}"
+        if retry_after_s > 0:
+            msg += f" (retry after {retry_after_s:.3f}s)"
+        super().__init__(msg)
+
+
 # exception types that are NOT engine failures and must pass through the
 # boundary untouched (user-facing or control-flow exceptions)
-_PASSTHROUGH: tuple[type, ...] = (QueryError, KeyboardInterrupt, SystemExit)
+_PASSTHROUGH: tuple[type, ...] = (QueryError, KeyboardInterrupt, SystemExit,
+                                  AdmissionRejectedError)
 
 
 def register_passthrough(exc_type: type) -> None:

@@ -115,6 +115,10 @@ SITES: dict[str, str] = {
     "flow.spill.merge_probe": "oversized-partition merge-probe run failure",
     "storage.compaction.swap": "crash between run swap and bookkeeping",
     "storage.bloom.build": "bloom build crash or silent bit corruption",
+    "admission.grant.stall": "queued admission grant stalls (delay) or is "
+                             "lost (error: waiter withdraws, typed busy)",
+    "admission.bucket.refill": "tenant token-bucket refill failure "
+                               "(typed busy with retry-after hint)",
 }
 
 

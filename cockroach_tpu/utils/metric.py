@@ -108,6 +108,38 @@ class LabeledCounter:
             return sorted((k, c.value) for k, c in self._children.items())
 
 
+class LabeledGauge:
+    """Gauge family keyed by one label (the gauge half of the labeled
+    families: per-tenant admission tokens, per-lane queue depth). One
+    logical name, one label dimension, a child Gauge per observed label
+    value. scrape() renders ``name{label="v"} n`` lines."""
+
+    def __init__(self, name: str, label: str, help: str = ""):
+        self.name = name
+        self.label = label
+        self.help = help
+        self._children: dict[str, Gauge] = {}
+        self._lock = threading.Lock()
+
+    def child(self, label_value) -> Gauge:
+        key = str(label_value)
+        with self._lock:
+            g = self._children.get(key)
+            if g is None:
+                g = self._children[key] = Gauge(self.name)
+            return g
+
+    def set(self, label_value, v: float) -> None:
+        self.child(label_value).set(v)
+
+    def value(self, label_value) -> float:
+        return self.child(label_value).value
+
+    def items(self) -> list[tuple[str, float]]:
+        with self._lock:
+            return sorted((k, g.value) for k, g in self._children.items())
+
+
 class Registry:
     """Named metric collection (metric.Registry). Subsystems register at
     construction; scrape() renders prometheus text exposition."""
@@ -130,6 +162,11 @@ class Registry:
         return self._get_or_add(
             name, lambda: LabeledCounter(name, label, help))
 
+    def labeled_gauge(self, name: str, label: str,
+                      help: str = "") -> LabeledGauge:
+        return self._get_or_add(
+            name, lambda: LabeledGauge(name, label, help))
+
     def _get_or_add(self, name: str, make):
         with self._lock:
             m = self._metrics.get(name)
@@ -150,6 +187,10 @@ class Registry:
                 out.append(f"{name} {m.value:g}")
             elif isinstance(m, LabeledCounter):
                 out.append(f"# TYPE {name} counter")
+                for k, v in m.items():
+                    out.append(f'{name}{{{m.label}="{k}"}} {v:g}')
+            elif isinstance(m, LabeledGauge):
+                out.append(f"# TYPE {name} gauge")
                 for k, v in m.items():
                     out.append(f'{name}{{{m.label}="{k}"}} {v:g}')
             elif isinstance(m, Histogram):
@@ -365,3 +406,17 @@ ADMISSION_SQL_TIMEOUTS = DEFAULT.counter(
     "admission_sql_timeouts",
     "admission waits that hit their timeout and withdrew (any "
     "concurrently granted slot is handed back, never leaked)")
+ADMISSION_LANE_QUEUE_DEPTH = DEFAULT.labeled_gauge(
+    "admission_lane_queue_depth", "lane",
+    "statements waiting in the SQL admission queue by priority lane "
+    "(interactive = point/DML at NORMAL/HIGH, analytical = LOW — the "
+    "lane shed first under overload)")
+ADMISSION_TENANT_TOKENS = DEFAULT.labeled_gauge(
+    "admission_tenant_tokens", "tenant",
+    "admission token-bucket level by tenant id (admission.tenant.rate/"
+    "burst); -1 when the tenant is not rate-limited")
+ADMISSION_REJECTIONS = DEFAULT.labeled_counter(
+    "admission_rejections", "tenant",
+    "statements refused admission by tenant id (queue full, rate "
+    "limit, overload shed, or queue-wait deadline) — surfaced to "
+    "clients as SQLSTATE 53300 'server busy' with a retry-after hint")

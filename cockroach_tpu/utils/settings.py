@@ -246,6 +246,52 @@ SQL_ADMISSION_SLOTS = register_int(
     "arrival) order as slots free up",
     lo=1,
 )
+SQL_ADMISSION_MAX_QUEUE_DEPTH = register_int(
+    "admission.sql.max_queue_depth", 512,
+    "bound on the SQL admission wait queue: past this many queued "
+    "statements, admit fails fast with AdmissionRejectedError (SQLSTATE "
+    "53300 'server busy' at pgwire) instead of queuing toward collapse. "
+    "0 = unbounded",
+    lo=0,
+)
+SQL_ADMISSION_QUEUE_TIMEOUT = register_float(
+    "admission.sql.queue_timeout_s", 30.0,
+    "backstop deadline on SQL admission queue-wait for statements with "
+    "no statement_timeout: past it the wait converts to a typed 53300 "
+    "rejection with a retry-after hint (statements WITH a timeout count "
+    "queue-wait against it instead). 0 = wait forever",
+    lo=0.0,
+)
+TENANT_RATE = register_float(
+    "admission.tenant.rate", 0.0,
+    "per-tenant admission token refill rate (statements/s): each tenant "
+    "id consumes one token per admitted statement from a bucket "
+    "refilling at this rate; an empty bucket rejects with SQLSTATE "
+    "53300 + retry-after = refill time. 0 = unlimited (no per-tenant "
+    "rate limiting; the fair-share scheduler still applies)",
+    lo=0.0,
+)
+TENANT_BURST = register_int(
+    "admission.tenant.burst", 64,
+    "per-tenant admission token bucket capacity: an idle tenant banks "
+    "up to this many statements' worth of tokens before "
+    "admission.tenant.rate throttles it",
+    lo=1,
+)
+SHED_MEM_LOW = register_float(
+    "admission.shed.mem_low", 0.90,
+    "memory-pressure fraction (flow/memory.py mem_pressure) past which "
+    "admission sheds the analytical lane: LOW-priority statements are "
+    "rejected with 53300 while interactive traffic still lands",
+    lo=0.0, hi=1.0,
+)
+SHED_MEM_HIGH = register_float(
+    "admission.shed.mem_high", 0.97,
+    "memory-pressure fraction past which admission sheds NORMAL "
+    "priority too — only HIGH (txn control: COMMIT/ROLLBACK) is still "
+    "admitted, so in-flight transactions can wind down",
+    lo=0.0, hi=1.0,
+)
 SQL_MEM_ROOT_BUDGET = register_int(
     "sql.mem.root_budget_bytes", 0,
     "node-level logical-byte budget for the root memory monitor "

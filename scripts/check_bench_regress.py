@@ -95,6 +95,35 @@ def compare(fresh: dict, baseline: dict, threshold: float = 0.2
             flags.append(
                 f"regression: {name} {bval:g} -> {fval:g} "
                 f"(-{drop:.1f}% > {threshold:.0%} threshold)")
+    flags.extend(overload_oracle_flags(fresh))
+    return flags
+
+
+def overload_oracle_flags(fresh: dict) -> list[str]:
+    """The multi-tenant overload oracle is pass/fail, not a trend: when
+    the fresh run carries ``mixed_load.overload_*`` figures, a false
+    oracle bool flags regardless of any throughput threshold (goodput
+    collapsing past saturation, untyped errors, or a noisy neighbor
+    breaking per-tenant p99 isolation are correctness failures)."""
+    ml = (fresh.get("detail") or {}).get("mixed_load")
+    if not isinstance(ml, dict) or "overload_oracle_ok" not in ml:
+        return []
+    flags = []
+    for key, what in (
+            ("overload_oracle_goodput_ok",
+             "goodput fell below 80% of saturation past the knee"),
+            ("overload_oracle_typed_ok",
+             "untyped errors (or zero shed) under overload"),
+            ("overload_oracle_isolation_ok",
+             "noisy neighbor pushed well-behaved p99 queue-wait past "
+             "2x its solo baseline"),
+    ):
+        if not ml.get(key, True):
+            flags.append(f"overload oracle: {what} "
+                         f"(mixed_load.{key} = false)")
+    if not ml["overload_oracle_ok"] and not flags:
+        flags.append("overload oracle: mixed_load.overload_oracle_ok = "
+                     "false")
     return flags
 
 

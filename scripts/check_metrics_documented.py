@@ -24,7 +24,8 @@ import pathlib
 import re
 import sys
 
-_KINDS = ("counter", "gauge", "histogram", "labeled_counter")
+_KINDS = ("counter", "gauge", "histogram", "labeled_counter",
+          "labeled_gauge")
 # README metrics-table rows: | `metric_name` | ... |
 _ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|", re.MULTILINE)
 

@@ -96,3 +96,27 @@ def test_hist_quantile_from_bucket_deltas():
     # overflow bucket reports the last finite bound (a floor)
     assert hist_quantile_from_deltas(buckets, before,
                                      [0, 0, 0, 0, 5], 0.99) == 1.0
+
+
+def test_compare_flags_failed_overload_oracle_regardless_of_threshold():
+    """The multi-tenant overload oracle is pass/fail: a false oracle
+    bool flags even when every throughput series is flat, and each
+    failed sub-oracle names itself; a passing oracle adds nothing."""
+    base = json.loads(json.dumps(_BASE))
+    base["detail"]["mixed_load"].update({
+        "overload_goodput_per_sec": 90.0, "overload_oracle_ok": True,
+        "overload_oracle_goodput_ok": True, "overload_oracle_typed_ok": True,
+        "overload_oracle_isolation_ok": True})
+    fresh = json.loads(json.dumps(base))
+    assert compare(fresh, base, threshold=0.2) == []
+    fresh["detail"]["mixed_load"].update({
+        "overload_oracle_ok": False,
+        "overload_oracle_goodput_ok": False,
+        "overload_oracle_isolation_ok": False})
+    flags = compare(fresh, base, threshold=0.99)
+    assert any("overload_oracle_goodput_ok" in f for f in flags)
+    assert any("overload_oracle_isolation_ok" in f for f in flags)
+    assert not any("typed" in f for f in flags)
+    assert all(f.startswith("overload oracle:") for f in flags)
+    # runs without overload figures (old baselines, --job q1) don't flag
+    assert compare(_BASE, _BASE, threshold=0.2) == []

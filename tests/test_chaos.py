@@ -1194,3 +1194,138 @@ def test_spill_merge_probe_fault_surfaces_then_clean_rerun():
     got = _run_spill_join(cat, workmem=1 << 16)
     assert metric.GRACE_JOIN_MERGE_PARTS.value > merge0
     _assert_equal(got, want)
+
+
+# -- admission chaos (admission.grant.stall / admission.bucket.refill) ------
+
+
+def test_admission_grant_lost_withdraws_waiter_and_leaks_no_slot():
+    """Error-kind admission.grant.stall: a queued waiter's grant is lost.
+    The waiter must withdraw cleanly (typed busy, cause = the injected
+    fault), lane depth returns to zero, no slot leaks, and a clean rerun
+    admits — the grant/withdraw race discipline under injected failure."""
+    from cockroach_tpu.utils import admission
+    from cockroach_tpu.utils.errors import AdmissionRejectedError
+
+    q = admission.WorkQueue(slots=1, max_queue_depth=8)
+    assert q.admit(tenant_id=2)  # park the slot so the next admit queues
+    faults.arm(79, {"admission.grant.stall":
+                    FaultSpec(kind="error", p=1.0, max_fires=1)})
+    try:
+        with pytest.raises(AdmissionRejectedError) as ei:
+            q.admit(tenant_id=3, timeout=5.0)
+        assert isinstance(ei.value.__cause__, InjectedFault)
+        assert ei.value.retry_after_s > 0.0
+        assert faults.fired(), "admit never reached the queued-grant path"
+    finally:
+        faults.disarm()
+    assert q.queue_depth == 0
+    assert q.lane_depths() == {admission.LANE_INTERACTIVE: 0,
+                               admission.LANE_ANALYTICAL: 0}
+    q.release()
+    assert q.admit(tenant_id=3, timeout=5.0)  # clean rerun admits
+    q.release()
+    assert q.in_use == 0
+
+
+def test_admission_grant_stall_delay_still_lands_grant():
+    """Delay-kind admission.grant.stall only holds the stalled waiter's
+    thread — the grant itself (decided under the queue lock by the
+    releasing thread) still lands, and the slot accounting stays exact."""
+    from cockroach_tpu.utils import admission
+
+    q = admission.WorkQueue(slots=1)
+    assert q.admit(tenant_id=2)
+    faults.arm(83, {"admission.grant.stall":
+                    FaultSpec(kind="delay", p=1.0, delay_s=0.2,
+                              max_fires=1)})
+    got = []
+
+    def waiter():
+        got.append(q.admit(tenant_id=3, timeout=10.0))
+        q.release()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    try:
+        t.start()
+        deadline = time.time() + 5.0
+        while not faults.fired() and time.time() < deadline:
+            time.sleep(0.005)
+        assert faults.fired(), "waiter never queued into the stall site"
+        q.release()  # grant races the stalled waiter: must land anyway
+        t.join(timeout=10.0)
+        assert got == [True]
+    finally:
+        faults.disarm()
+    assert q.in_use == 0 and q.queue_depth == 0
+
+
+def test_admission_bucket_refill_failure_is_typed_busy():
+    """admission.bucket.refill error-kind: the tenant's token refill
+    fails — the admit surfaces the typed 53300-shaped busy (cause = the
+    injected fault, retry-after hint attached), the tenant's rejection
+    counter moves, and the very next admit (fault spent) succeeds."""
+    from cockroach_tpu.utils import admission
+    from cockroach_tpu.utils.errors import AdmissionRejectedError
+
+    q = admission.WorkQueue(slots=2)
+    q.configure_tenant(5, rate=1000.0, burst=4)
+    faults.arm(89, {"admission.bucket.refill":
+                    FaultSpec(kind="error", p=1.0, max_fires=1)})
+    try:
+        with pytest.raises(AdmissionRejectedError) as ei:
+            q.admit(tenant_id=5)
+        assert isinstance(ei.value.__cause__, InjectedFault)
+        assert "refill" in str(ei.value)
+        assert faults.fired()
+    finally:
+        faults.disarm()
+    row = next(r for r in q.tenant_rows() if r["tenant_id"] == 5)
+    assert row["rejected"] == 1
+    assert q.admit(tenant_id=5)  # clean rerun admits
+    q.release()
+    assert q.in_use == 0
+
+
+def test_admission_grant_stall_under_concurrent_load_converges():
+    """Probabilistic stall/loss sweep under real contention: N threads ×
+    M admits against 2 slots with admission.grant.stall armed at p=0.3.
+    Every admit either holds-then-releases or surfaces the typed busy;
+    afterwards zero slots are in use and the queue is empty (no grant is
+    ever both counted and lost — the sanitizer-armed shared-state check
+    rides the autouse fixtures)."""
+    from cockroach_tpu.utils import admission
+    from cockroach_tpu.utils.errors import AdmissionRejectedError
+
+    q = admission.WorkQueue(slots=2, max_queue_depth=64)
+    ok = []
+    shed = []
+    lock = threading.Lock()
+
+    def worker(tid):
+        for _ in range(12):
+            try:
+                if q.admit(tenant_id=tid, timeout=10.0):
+                    time.sleep(0.001)
+                    q.release()
+                    with lock:
+                        ok.append(tid)
+            except AdmissionRejectedError:
+                with lock:
+                    shed.append(tid)
+
+    faults.arm(97, {"admission.grant.stall":
+                    FaultSpec(kind="error", p=0.3, max_fires=8)})
+    try:
+        threads = [threading.Thread(target=worker, args=(tid,),
+                                    daemon=True) for tid in (2, 3, 4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+    finally:
+        faults.disarm()
+    assert len(ok) + len(shed) == 36
+    assert q.in_use == 0 and q.queue_depth == 0
+    assert q.lane_depths() == {admission.LANE_INTERACTIVE: 0,
+                               admission.LANE_ANALYTICAL: 0}

@@ -3,9 +3,12 @@
 stay within its recorded dispatch budget, and the marginal cost of an
 extra input tile must stay one fused kernel."""
 
+import pytest
+
 from scripts.check_dispatch_budget import check
 
 
+@pytest.mark.slow
 def test_dispatch_budget():
     problems = check()
     assert not problems, "\n".join(problems)

@@ -48,7 +48,7 @@ def _unordered(res: dict, keys: list[str]) -> dict:
 
 # minutes of XLA compile on the CPU-emulated 8-device mesh (q13's
 # right-join + grouped-count plan); tier-1 skips it, `-m slow` covers it
-_COMPILE_HEAVY = {"q13"}
+_COMPILE_HEAVY = {"q13", "q2", "q18", "q21"}
 
 
 @pytest.mark.parametrize("qname", [
@@ -197,6 +197,7 @@ def test_explain_distributed_stages(cat):
     assert "gather" in txt  # final ordered fan-in
 
 
+@pytest.mark.slow
 def test_distributed_topk_avoids_full_gather(cat, mesh):
     """ORDER BY + LIMIT distributes as per-device top-k + small gather +
     sorted merge — the sorttopk.go/OrderedSynchronizer pattern. The plan

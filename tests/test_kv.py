@@ -133,6 +133,7 @@ def test_txn_rewrite_last_write_wins():
     assert db.get(b"rw") == b"final"
 
 
+@pytest.mark.slow
 def test_bank_transfer_invariant():
     """Total balance is conserved across random transfer txns."""
     db = mkdb()
@@ -157,6 +158,7 @@ def test_bank_transfer_invariant():
     assert total == n * 100
 
 
+@pytest.mark.slow
 def test_kvnemesis_lite():
     """Randomized serial-equivalence: run sequential txns doing random
     read-modify-writes over a small keyspace against a python dict model."""

@@ -273,6 +273,7 @@ def test_bulk_load_and_import_job(tmp_path):
     assert res["qty"][0] is None
 
 
+@pytest.mark.slow
 def test_sharded_scan_covers_kv_tables():
     """Shard masks select by LIVE-ROW RANK: a KVTable's live rows sit at
     scattered merged-view positions (often past num_rows), so positional

@@ -34,6 +34,8 @@ _COMPILE_HEAVY = {
     "matrix_window", "matrix_agg", "setop_precedence",
     "setops_filter_distinctfrom", "join_edges", "matrix_order_limit",
     "setop_chains", "agg_grouping",
+    "matrix_join", "joins_subqueries", "window", "distinct_limit",
+    "subqueries", "select_list_subqueries", "case_cast_cte",
 }
 
 

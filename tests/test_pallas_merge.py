@@ -57,7 +57,14 @@ def _live_tuples(blk):
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
-@pytest.mark.parametrize("sizes", [(30, 50), (64, 64), (5, 120), (1, 1)])
+@pytest.mark.parametrize("sizes", [
+    # each size shape is its own 15-20s XLA compile on this host: tier-1
+    # keeps the square case, the odd shapes ride the slow tier
+    pytest.param((30, 50), marks=pytest.mark.slow),
+    (64, 64),
+    pytest.param((5, 120), marks=pytest.mark.slow),
+    pytest.param((1, 1), marks=pytest.mark.slow),
+])
 def test_merge_pair_matches_sort(seed, sizes):
     rng = np.random.default_rng(seed)
     a = _random_sorted_run(rng, sizes[0])

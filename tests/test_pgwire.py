@@ -341,3 +341,56 @@ def test_pgwire_extended_rebind_rides_plan_cache():
         c.close()
     finally:
         srv.close()
+
+
+def test_pgwire_overload_sheds_typed_53300_not_hang_or_drop():
+    """Overload at the wire: with one slot held and a depth-1 queue, a
+    first client queues (not dropped) and a second is refused with
+    SQLSTATE 53300 on an open, still-usable connection (never a hang,
+    never a connection teardown). Once the slot frees, the queued
+    statement completes and the refused client's retry succeeds."""
+    import time
+
+    from cockroach_tpu.utils import admission
+
+    sess = Session()
+    srv = PgServer(catalog=sess.catalog, db=sess.db).serve_background()
+    saved = admission._SQL_QUEUE
+    q = admission.WorkQueue(slots=1, max_queue_depth=1)
+    admission._SQL_QUEUE = q
+    c1 = c2 = None
+    try:
+        assert q.admit(tenant_id=1)  # the test parks the only slot
+        c1 = MiniPg(srv.addr)
+        c2 = MiniPg(srv.addr)
+        # c1 issues a statement but we don't read the reply yet: its
+        # server thread must be sitting in the admission queue
+        body = b"select 1\x00"
+        c1.sock.sendall(b"Q" + struct.pack("!I", len(body) + 4) + body)
+        deadline = time.time() + 10.0
+        while q.queue_depth < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        assert q.queue_depth == 1, "first statement never queued"
+        # queue at its bound: c2 gets the typed busy, 53300 on the wire
+        _, _, _, err = c2.query("select 1")
+        assert err is not None and "53300" in err
+        assert "admission" in err or "busy" in err or "full" in err
+        # the refusal did not tear down c2: protocol still in sync
+        assert c2.txn_status == b"I"
+        # free the slot: the queued c1 statement is granted and completes
+        q.release()
+        msgs = c1._drain_until_ready()
+        assert any(t == b"D" for t, _ in msgs), "queued stmt lost"
+        assert not any(t == b"E" for t, _ in msgs)
+        # and c2's retry now admits normally
+        rows, _, _, err = c2.query("select 1")
+        assert err is None and rows == [["1"]]
+        assert q.in_use == 0 and q.queue_depth == 0
+    finally:
+        if c1 is not None:
+            c1.close()
+        if c2 is not None:
+            c2.close()
+        admission._SQL_QUEUE = saved
+        srv.close()
+        sess.close()

@@ -400,6 +400,7 @@ def test_admin_hot_ranges_payload_and_degraded_fallbacks():
     assert AdminServer(node3).hot_ranges() == {"hotRanges": []}
 
 
+@pytest.mark.slow
 def test_node_runs_lifecycle_and_serves_hot_ranges_http(capsys):
     """Full integration: a Node over a 2-store DistSender runs the
     lifecycle in the BACKGROUND (no synchronous ticks) — the seeded

@@ -3,9 +3,12 @@
 per-query budgets, adaptation settles in one run, and a warmed repeat
 with different literals triggers zero new XLA traces."""
 
+import pytest
+
 from scripts.check_recompiles import check
 
 
+@pytest.mark.slow
 def test_recompiles():
     problems = check()
     assert not problems, "\n".join(problems)

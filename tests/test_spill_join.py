@@ -71,7 +71,9 @@ def _canon(res):
     return sorted(recs, key=lambda t: tuple((x is None, x) for x in t))
 
 
-@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+@pytest.mark.parametrize("how", [
+    "inner", pytest.param("left", marks=pytest.mark.slow), "semi",
+    pytest.param("anti", marks=pytest.mark.slow)])
 def test_hybrid_spill_merge_runs_match_oracle(how):
     """Forced spill with partitions past workmem: the build side reloads
     as sorted runs and merge-probes; output equals the in-memory join."""
@@ -86,7 +88,9 @@ def test_hybrid_spill_merge_runs_match_oracle(how):
     assert _canon(got) == _canon(oracle)
 
 
-@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+@pytest.mark.parametrize("how", [
+    "inner", pytest.param("left", marks=pytest.mark.slow), "semi",
+    pytest.param("anti", marks=pytest.mark.slow)])
 def test_skew_hot_lane_matches_oracle(how):
     """Heavy-hitter probe rows route through the resident hot build table;
     results stay identical and the routed-row metric moves."""

@@ -16,6 +16,7 @@ def sess():
     return s
 
 
+@pytest.mark.slow
 def test_new_order_allocates_sequential_ids(sess):
     ids = [tpcc.new_order(sess, 1, 2, 3, ol_cnt=5, entry_day=20000 + i,
                           items=20)
@@ -38,6 +39,7 @@ def test_payment_maintains_w_ytd_invariant(sess):
     assert abs(float(res["s"][0]) - (2 * 4 * 6 * 10.0 + 210.0)) < 1e-6
 
 
+@pytest.mark.slow
 def test_delivery_pops_oldest_and_credits_customer(sess):
     # three orders in district (1,1) for customer 2; one in (1,2)
     for i in range(3):
@@ -72,6 +74,7 @@ def test_delivery_pops_oldest_and_credits_customer(sess):
     tpcc.check_consistency(sess, warehouses=2, districts=4)
 
 
+@pytest.mark.slow
 def test_stock_level_counts_low_stock_items(sess):
     for i in range(5):
         tpcc.new_order(sess, 1, 3, 1, ol_cnt=8, entry_day=20000 + i,
@@ -94,6 +97,7 @@ def test_order_status_reads_latest_order(sess):
     assert st["latest_o_id"] == 2 and st["latest_lines"] == 9
 
 
+@pytest.mark.slow
 def test_full_mix_and_invariants(sess):
     out = tpcc.run_mix(sess, txns=30, warehouses=2, districts=4,
                        customers=6, items=20)
