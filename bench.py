@@ -350,6 +350,20 @@ def _worker(job: str) -> None:
             "compactions": y["compactions"],
         }), flush=True)
         return
+    if job == "fanout":
+        # changefeed fan-out plane: ~1k mixed subscribers (fast / slow /
+        # flapping) against one hub — sustained delivery, end-to-end lag,
+        # eviction counts, peak fan-out memory, exactly-once oracle
+        from cockroach_tpu.bench.fanout import run_fanout
+
+        f = run_fanout(
+            subscribers=int(os.environ.get("BENCH_FANOUT_SUBS", "1000")),
+            duration_s=float(os.environ.get("BENCH_FANOUT_S", "10")),
+        )
+        print("RESULT " + json.dumps({
+            "job": job, "platform": platform, **f,
+        }), flush=True)
+        return
     if job == "load":
         # mixed-workload serving load (ROADMAP 3(c)): N concurrent sessions
         # x (YCSB point ops + TPC-H analytics) through the full SQL front
@@ -503,6 +517,8 @@ def main(only_job: str | None = None) -> None:
         jobs.append("ycsb")
     if os.environ.get("BENCH_LOAD", "1") != "0":
         jobs.append("load")
+    if os.environ.get("BENCH_FANOUT", "1") != "0":
+        jobs.append("fanout")
     if only_job is not None:
         # --job <name>: run exactly that ladder item (e.g. `bench.py --job
         # load` for the mixed-workload serving run) with the same worker

@@ -193,11 +193,17 @@ ROOT = BytesMonitor("root", level="root")
 _STAGING: dict[str, BytesMonitor] = {}
 
 
-def staging_monitor(name: str) -> BytesMonitor:
+def staging_monitor(name: str, budget: int = 0) -> BytesMonitor:
+    """Get-or-create the named cache-level account. ``budget`` (when
+    non-zero) installs/updates a cap on the account — the changefeed
+    fan-out plane bounds its whole buffer pool this way while its
+    per-subscriber children carry their own budgets."""
     with _TREE_LOCK:
         m = _STAGING.get(name)
         if m is None or m.closed:
             m = _STAGING[name] = ROOT.child(name, level="cache")
+        if budget:
+            m.budget = int(budget)
         return m
 
 

@@ -6,14 +6,19 @@ sink (kafka/cloud/webhook), and checkpoint a RESOLVED timestamp frontier
 into the job record so restarts resume without loss or duplication. Here
 the same loop over the engine's retained MVCC versions:
 
-- ``Engine`` history IS the feed source: ``changes_between(lo, hi)`` lists
-  committed versions in (lo, hi] for a span (the catch-up scan shape,
-  kvserver/rangefeed/catchup_scan.go — polling stands in for the push
-  plumbing until the DCN server carries subscriptions);
+- ``Engine`` history IS the feed source: ``_scan(lo, hi)`` lists committed
+  versions in (lo, hi] for a span plus the unresolved intents that hold
+  the resolved frontier back (the catch-up scan shape,
+  kvserver/rangefeed/catchup_scan.go);
 - events encode as JSON lines {key, value|null, ts} (the wire envelope);
 - the feed runs as a JOB: each poll emits events then checkpoints
   ``resolved`` — crash + re-adoption resumes from the frontier, exactly
-  once per version (verified in tests).
+  once per version (verified in tests);
+- ``RangefeedServer`` pushes events over the DCN framing, demuxed through
+  the bounded fan-out plane in :mod:`.fanout`: one poll loop feeds every
+  subscriber's budgeted buffer, slow consumers walk the backpressure
+  ladder (coalesce → shed-to-catch-up-scan → typed eviction), and a
+  dropped client reconnects from its resolved frontier without loss.
 """
 
 from __future__ import annotations
@@ -23,27 +28,39 @@ import json
 
 import numpy as np
 
+from ..flow import memory as flowmem
 from ..storage import keys as K
 from ..utils import locks
 from .jobs import Job, Registry
 from .txn import DB
 
 
-def changes_between(db: DB, lo_ts: int, hi_ts: int,
-                    start: bytes | None = None,
-                    end: bytes | None = None,
-                    raw: bool = False) -> tuple[list[dict], int]:
-    """Committed versions with lo_ts < ts <= RESOLVED in [start, end),
-    ordered by (ts, key), plus the RESOLVED frontier itself — the catch-up
-    scan with the closed-timestamp discipline (kvserver/closedts): the
-    frontier must not advance past an UNRESOLVED intent in the span, or its
-    eventual commit timestamp would fall behind an already-emitted resolved
-    checkpoint and the event would be skipped forever. Tombstones emit
-    value None. Returns (events, resolved)."""
+def _scan(db: DB, lo_ts: int, hi_ts: int,
+          start: bytes | None = None,
+          end: bytes | None = None,
+          ) -> tuple[list[tuple[int, bytes, bytes | None]],
+                     list[tuple[int, bytes]]]:
+    """Committed versions with lo_ts < ts <= hi_ts in [start, end) as
+    (ts, key, value|None) tuples ordered by (ts, key) — value None is a
+    tombstone — plus the span's UNRESOLVED intents as (ts, key). This is
+    the raw demux feed for the fan-out hub; :func:`changes_between` folds
+    the intent list into the resolved frontier (kvserver/closedts): the
+    frontier must not advance past an unresolved intent, or its eventual
+    commit would fall behind an already-emitted resolved checkpoint and
+    the event would be skipped forever."""
     eng = db.engine
-    view = eng._merged_view()  # overlays the memtable; read-only
+    # Take the snapshot under the store mutex (reentrant), like every
+    # public Engine reader: _merged_view() consults and REFILLS the
+    # overlay cache, so building it against a concurrent memtable append
+    # or resolve_intents run-set rewrite doesn't just return a torn view
+    # — it poisons the cache for every later reader (observed as
+    # committed versions vanishing and an orphaned intent pinning the
+    # resolved frontier forever). The returned block is immutable once
+    # built; the mutex is released before the numpy crunching below.
+    with eng.mu:
+        view = eng._merged_view()  # overlays the memtable; read-only
     if view is None:
-        return [], hi_ts
+        return [], []
     mask = np.asarray(view.mask)
     ts = np.asarray(view.ts)
     txn = np.asarray(view.txn)
@@ -52,67 +69,92 @@ def changes_between(db: DB, lo_ts: int, hi_ts: int,
         # vectorized bound compare: pack key bytes into big-endian uint64
         # word lanes (the engine's own key-order encoding) and compare
         # lexicographically word by word — no per-row Python loop on the
-        # hot poll path
+        # hot poll path. The packed-word scratch is the scan's big
+        # transient allocation; charge it to the changefeed staging
+        # account for the computation's lifetime.
         keys_np = np.ascontiguousarray(np.asarray(view.key))
         n, kw = keys_np.shape
-        shifts = (np.arange(7, -1, -1, dtype=np.uint64)
-                  * np.uint64(8))
-        words = (keys_np.reshape(n, kw // 8, 8).astype(np.uint64)
-                 << shifts).sum(axis=-1, dtype=np.uint64)
+        with flowmem.staged("changefeed", int(keys_np.size)):
+            shifts = (np.arange(7, -1, -1, dtype=np.uint64)
+                      * np.uint64(8))
+            words = (keys_np.reshape(n, kw // 8, 8).astype(np.uint64)
+                     << shifts).sum(axis=-1, dtype=np.uint64)
 
-        def bound_words(b: bytes):
-            bb = np.frombuffer(b.ljust(kw, b"\x00"), dtype=np.uint8)
-            return (bb.reshape(kw // 8, 8).astype(np.uint64)
-                    << shifts).sum(axis=-1, dtype=np.uint64)
+            def bound_words(b: bytes):
+                bb = np.frombuffer(b.ljust(kw, b"\x00"), dtype=np.uint8)
+                return (bb.reshape(kw // 8, 8).astype(np.uint64)
+                        << shifts).sum(axis=-1, dtype=np.uint64)
 
-        def cmp_ge(bw):
-            ge = np.zeros(n, dtype=bool)
-            eq = np.ones(n, dtype=bool)
-            for j in range(words.shape[1]):
-                ge |= eq & (words[:, j] > bw[j])
-                eq &= words[:, j] == bw[j]
-            return ge | eq
+            def cmp_ge(bw):
+                ge = np.zeros(n, dtype=bool)
+                eq = np.ones(n, dtype=bool)
+                for j in range(words.shape[1]):
+                    ge |= eq & (words[:, j] > bw[j])
+                    eq &= words[:, j] == bw[j]
+                return ge | eq
 
-        if start is not None:
-            in_span = in_span & cmp_ge(bound_words(bytes(start)))
-        if end is not None:
-            in_span = in_span & ~cmp_ge(bound_words(bytes(end)))
-    # the resolved frontier holds below the oldest unresolved intent
-    intents = in_span & (txn != 0)
-    resolved = int(hi_ts)
-    if intents.any():
-        resolved = min(resolved, int(ts[intents].min()) - 1)
-    sel = in_span & (txn == 0) & (ts > lo_ts) & (ts <= resolved)
+            if start is not None:
+                in_span = in_span & cmp_ge(bound_words(bytes(start)))
+            if end is not None:
+                in_span = in_span & ~cmp_ge(bound_words(bytes(end)))
+    intent_sel = in_span & (txn != 0)
+    intents: list[tuple[int, bytes]] = []
+    if intent_sel.any():
+        ikeys = K.decode_keys(np.asarray(view.key)[intent_sel])
+        intents = [(int(t), bytes(k))
+                   for t, k in zip(ts[intent_sel], ikeys)]
+    sel = in_span & (txn == 0) & (ts > lo_ts) & (ts <= hi_ts)
     idx = np.nonzero(sel)[0]
     if len(idx) == 0:
-        return [], resolved
+        return [], intents
     keys = K.decode_keys(np.asarray(view.key)[idx])
     vals = np.asarray(view.value)[idx]
     vlens = np.asarray(view.vlen)[idx]
     tombs = np.asarray(view.tomb)[idx]
-    out = []
+    out: list[tuple[int, bytes, bytes | None]] = []
     for k, v, n, tomb, t in zip(keys, vals, vlens, tombs, ts[idx]):
-        if raw:
-            # byte-exact encoding (base64): physical replication must
-            # reproduce keys/values verbatim, not a lossy utf-8 view
-            ev = {
-                "k64": base64.b64encode(k).decode("ascii"),
-                "v64": (None if tomb
-                        else base64.b64encode(bytes(v[:n])).decode("ascii")),
-                "ts": int(t),
-            }
-        else:
-            ev = {
-                "key": k.decode("utf-8", "replace"),
-                "value": None if tomb else bytes(v[:n]).decode("utf-8",
-                                                               "replace"),
-                "ts": int(t),
-            }
-        # sort on the ORIGINAL key bytes (base64's ascii order does not
-        # preserve byte order, and a b"" key is falsy)
-        out.append((int(t), bytes(k), ev))
+        out.append((int(t), bytes(k),
+                    None if tomb else bytes(v[:n])))
     out.sort(key=lambda e: e[:2])
-    return [ev for _, _, ev in out], resolved
+    return out, intents
+
+
+def encode_event(ts: int, key: bytes, value: bytes | None,
+                 raw: bool = False) -> dict:
+    """The wire envelope for one committed version. raw=True gives the
+    byte-exact base64 encoding (physical replication must reproduce
+    keys/values verbatim, not a lossy utf-8 view)."""
+    if raw:
+        return {
+            "k64": base64.b64encode(key).decode("ascii"),
+            "v64": (None if value is None
+                    else base64.b64encode(value).decode("ascii")),
+            "ts": int(ts),
+        }
+    return {
+        "key": key.decode("utf-8", "replace"),
+        "value": (None if value is None
+                  else value.decode("utf-8", "replace")),
+        "ts": int(ts),
+    }
+
+
+def changes_between(db: DB, lo_ts: int, hi_ts: int,
+                    start: bytes | None = None,
+                    end: bytes | None = None,
+                    raw: bool = False) -> tuple[list[dict], int]:
+    """Committed versions with lo_ts < ts <= RESOLVED in [start, end),
+    ordered by (ts, key), plus the RESOLVED frontier itself — the catch-up
+    scan with the closed-timestamp discipline. Tombstones emit value None.
+    Returns (events, resolved)."""
+    versions, intents = _scan(db, lo_ts, hi_ts, start, end)
+    # the resolved frontier holds below the oldest unresolved intent
+    resolved = int(hi_ts)
+    for its, _ikey in intents:
+        resolved = min(resolved, int(its) - 1)
+    events = [encode_event(t, k, v, raw)
+              for t, k, v in versions if t <= resolved]
+    return events, resolved
 
 
 class FileSink:
@@ -132,6 +174,8 @@ def register_changefeed_job(registry: Registry, polls: int = 1) -> None:
     to the sink then checkpoints the new resolved frontier."""
 
     def resume(reg: Registry, job: Job):
+        from ..utils import faults
+
         sink = FileSink(job.payload["sink"])
         start = job.payload.get("start")
         end = job.payload.get("end")
@@ -148,6 +192,11 @@ def register_changefeed_job(registry: Registry, polls: int = 1) -> None:
             # last checkpoint may lay intents below it, but re-emitting
             # (old_resolved, new_resolved] would duplicate events
             job.progress["resolved"] = max(resolved, new_resolved)
+            # chaos site: the frontier checkpoint write is lost — the
+            # job fails here with events already emitted; re-adoption
+            # resumes from the stale frontier and re-emits (the sink
+            # dedups by (ts, key)), never skips
+            faults.fire("changefeed.frontier.checkpoint")
             reg.checkpoint(job)  # frontier checkpoint: resume point
         return {"resolved": job.progress["resolved"]}
 
@@ -157,14 +206,24 @@ def register_changefeed_job(registry: Registry, polls: int = 1) -> None:
 class RangefeedServer:
     """Push rangefeed events over the DCN framing — the MuxRangeFeed
     reduction (kvpb api.proto:3700): a subscriber names a span and a start
-    timestamp; the server streams JSON event frames as new versions commit
-    (poll-driven tailer standing in for the raft-apply hook), interleaved
-    with resolved-timestamp checkpoints."""
+    timestamp; the server streams JSON event frames as new versions commit,
+    interleaved with resolved-timestamp checkpoints.
+
+    Since the fan-out rebuild, connections are demuxed through ONE
+    :class:`~.fanout.FanoutHub` poll loop instead of a per-connection
+    tail thread: each subscriber gets a budgeted buffer charged to the
+    node's changefeed staging account, slow consumers walk the
+    backpressure ladder, dead sockets are heartbeat-reaped within the
+    send deadline, and an evicted client receives a typed
+    ``{"error": "slow_consumer", "frontier": N}`` frame naming its exact
+    reconnect point."""
 
     def __init__(self, db: DB, poll_interval_s: float = 0.05,
                  port: int = 0):
         import socket
         import threading
+
+        from .fanout import FanoutHub
 
         self.db = db
         self.poll_interval_s = poll_interval_s
@@ -174,6 +233,8 @@ class RangefeedServer:
         self._srv = socket.create_server(("127.0.0.1", port))
         self._srv.settimeout(0.2)
         self.addr = self._srv.getsockname()
+        self.hub = FanoutHub(db, poll_interval_s=poll_interval_s,
+                             name=f"{self.addr[0]}:{self.addr[1]}")
         self._stop = threading.Event()
         # track accepted conns so close() severs them: a restart on the
         # same port must not collide with a previous incarnation's
@@ -187,8 +248,6 @@ class RangefeedServer:
     def _serve(self):
         import socket
         import threading
-
-        from ..flow.dcn import _recv_msg
 
         while not self._stop.is_set():
             try:
@@ -220,37 +279,38 @@ class RangefeedServer:
             conn.settimeout(None)
         except (OSError, ValueError, ConnectionError):
             conn.close()
-            with self._conns_lock:
-                self._conns.discard(conn)
+            self._discard(conn)
             return
-        self._tail(conn, req)
+        self._register(conn, req)
 
-    def _tail(self, conn, req):
+    def _register(self, conn, req):
+        """Hand the connection to the fan-out hub (replaces the old
+        per-connection ``_tail`` poll loop, which had no liveness bound —
+        a dead socket held its thread and poll budget forever)."""
         from ..flow.dcn import _send_msg
 
         start = req.get("start")
         end = req.get("end")
         s = start.encode() if isinstance(start, str) else start
         e = end.encode() if isinstance(end, str) else end
-        resolved = int(req.get("since", 0))
-        raw = bool(req.get("raw", False))
-        try:
-            while not self._stop.is_set():
-                now = self.db.clock.now()
-                events, new_resolved = changes_between(
-                    self.db, resolved, now, s, e, raw=raw)
-                for ev in events:
-                    _send_msg(conn, json.dumps(ev).encode("utf-8"))
-                resolved = max(resolved, new_resolved)  # never regress
+        sub = self.hub.add_subscriber(
+            conn, start=s, end=e, since=int(req.get("since", 0)),
+            raw=bool(req.get("raw", False)),
+            on_close=lambda: self._discard(conn))
+        if sub is None:
+            # bounded subscriber tree: refuse the newcomer with a typed
+            # frame rather than degrade every existing registration
+            try:
                 _send_msg(conn, json.dumps(
-                    {"resolved": resolved}).encode("utf-8"))
-                self._stop.wait(self.poll_interval_s)
-        except OSError:
-            pass  # subscriber went away
-        finally:
+                    {"error": "subscriber_limit"}).encode("utf-8"))
+            except OSError:
+                pass  # client already gone
             conn.close()
-            with self._conns_lock:
-                self._conns.discard(conn)
+            self._discard(conn)
+
+    def _discard(self, conn):
+        with self._conns_lock:
+            self._conns.discard(conn)
 
     def close(self):
         import socket
@@ -263,6 +323,9 @@ class RangefeedServer:
         # restart on the same port would EADDRINUSE until it exits
         if self._accept_thread is not threading.current_thread():
             self._accept_thread.join(timeout=5)
+        # the hub severs registered subscribers and joins their senders
+        self.hub.close()
+        # handshake-phase stragglers never reached the hub
         with self._conns_lock:
             conns = list(self._conns)
             self._conns.clear()
@@ -278,7 +341,9 @@ def subscribe_rangefeed(addr, start=None, end=None, since: int = 0,
                         raw: bool = False):
     """Dial a RangefeedServer; returns (socket, iterator of frames).
     Frames are events ({key, value, ts} — or byte-exact {k64, v64, ts}
-    with raw=True) or checkpoints ({resolved})."""
+    with raw=True), checkpoints ({resolved}), or a terminal typed error
+    ({error, frontier} — e.g. a slow-consumer eviction naming the exact
+    ``since`` to reconnect with)."""
     import socket
 
     from ..flow.dcn import _recv_msg, _send_msg
@@ -303,6 +368,12 @@ def subscribe_rangefeed(addr, start=None, end=None, since: int = 0,
                 return  # server closed the stream: end of feed
             if msg is None:
                 return
-            yield json.loads(msg.decode("utf-8"))
+            try:
+                yield json.loads(msg.decode("utf-8"))
+            except ValueError:
+                # torn frame (the server's send deadline fired mid-write
+                # before it evicted us): the stream is dead; resume by
+                # reconnecting from the last checkpoint
+                return
 
     return sock, frames()

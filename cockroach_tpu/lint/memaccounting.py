@@ -50,6 +50,11 @@ HOT_PATHS = (
     "cockroach_tpu/storage/ingest.py",
     "cockroach_tpu/storage/blockcache.py",
     "cockroach_tpu/storage/lsm.py",
+    # the changefeed fan-out plane buffers and coalesces event frames
+    # sized by the write stream — its scans and per-subscriber queues
+    # must charge the node's changefeed staging account
+    "cockroach_tpu/kv/changefeed.py",
+    "cockroach_tpu/kv/fanout.py",
 )
 
 # materializing constructors: allocate fresh host/device buffers sized by

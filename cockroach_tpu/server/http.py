@@ -267,6 +267,20 @@ class AdminServer:
         """Resource/serving-load snapshot (/_status/load)."""
         return load_payload(self.node)
 
+    def changefeeds(self) -> dict:
+        """Fan-out plane snapshot (/_status/changefeeds): one row per
+        rangefeed subscriber — span, frontier, buffered bytes, ladder
+        counters — plus the node-wide changefeed staging account."""
+        from ..flow import memory as flowmem
+        from ..kv import fanout
+
+        mon = flowmem.staging_monitor("changefeed")
+        return {
+            "subscribers": fanout.subscriber_rows(),
+            "buffer_bytes": int(mon.used),
+            "buffer_high_water": int(mon.high_water),
+        }
+
     def ts_query(self, name: str, start_ms: int, end_ms: int) -> dict:
         pts = self.node.tsdb.query(name, start_ms=start_ms, end_ms=end_ms)
         return {"name": name,
@@ -332,6 +346,8 @@ class AdminServer:
                         self._json(admin.spans())
                     elif u.path == "/_status/load":
                         self._json(admin.load())
+                    elif u.path == "/_status/changefeeds":
+                        self._json(admin.changefeeds())
                     elif u.path == "/ts/query":
                         q = parse_qs(u.query)
                         name = (q.get("name") or [""])[0]

@@ -395,11 +395,13 @@ class Node:
                 # admission queue) so each scrape records live values even
                 # when nothing ran since the last tick
                 from ..flow import memory as flowmem
+                from ..kv import fanout
                 from ..storage import blockcache
 
                 flowmem.refresh_gauges()
                 admission.refresh_gauges()
                 blockcache.refresh_gauges()
+                fanout.refresh_gauges()
                 self.tsdb.record(metric.DEFAULT)
                 retention = settings.get("ts.retention_seconds")
                 # prune at ~1/10 the scrape cadence: a retention trim scans

@@ -287,6 +287,34 @@ def _hot_ranges(catalog) -> Table:
     ])
 
 
+def _node_changefeed_subscribers(catalog) -> Table:
+    """Per-registration fan-out state (the changefeed observability
+    surface): span, resolved frontier, buffered bytes/events, and the
+    backpressure-ladder counters (coalesced, sheds), one row per live
+    subscriber across every rangefeed hub on this node — so one query
+    answers "who is behind, by how much, and what has the ladder already
+    done about it"."""
+    from ..kv import fanout
+
+    rows = fanout.subscriber_rows()
+    return _table("crdb_internal.node_changefeed_subscribers", [
+        ("hub", T.STRING, _strs(r["hub"] for r in rows)),
+        ("subscriber_id", T.INT64, _ints(r["subscriber_id"] for r in rows)),
+        ("state", T.STRING, _strs(r["state"] for r in rows)),
+        ("span_start", T.STRING, _strs(r["span_start"] for r in rows)),
+        ("span_end", T.STRING, _strs(r["span_end"] for r in rows)),
+        ("frontier", T.INT64, _ints(r["frontier"] for r in rows)),
+        ("buffered_bytes", T.INT64,
+         _ints(r["buffered_bytes"] for r in rows)),
+        ("buffered_events", T.INT64,
+         _ints(r["buffered_events"] for r in rows)),
+        ("sent_events", T.INT64, _ints(r["sent_events"] for r in rows)),
+        ("coalesced", T.INT64, _ints(r["coalesced"] for r in rows)),
+        ("sheds", T.INT64, _ints(r["sheds"] for r in rows)),
+        ("age_s", T.FLOAT64, _floats(r["age_s"] for r in rows)),
+    ])
+
+
 _BUILDERS = {
     "crdb_internal.node_statement_statistics": _stmt_statistics,
     "crdb_internal.cluster_queries": _cluster_queries,
@@ -297,6 +325,7 @@ _BUILDERS = {
     "crdb_internal.node_memory_monitors": _memory_monitors,
     "crdb_internal.cluster_load": _cluster_load,
     "crdb_internal.node_tenant_admission": _node_tenant_admission,
+    "crdb_internal.node_changefeed_subscribers": _node_changefeed_subscribers,
 }
 
 

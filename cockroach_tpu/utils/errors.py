@@ -45,6 +45,24 @@ class AdmissionRejectedError(Exception):
         super().__init__(msg)
 
 
+class SlowConsumerError(Exception):
+    """A changefeed subscriber fell too far behind and was evicted from
+    the fan-out plane (kvserver/rangefeed's BufferedSender eviction: the
+    processor never blocks raft apply on one stuck registration). The
+    error carries the subscriber's last durably-delivered resolved
+    timestamp — ``frontier`` — which is the exact ``since`` a reconnect
+    must present to resume without loss; events after the frontier may
+    re-deliver and are deduplicated by (ts, key)."""
+
+    def __init__(self, subscriber_id: int, reason: str, frontier: int = 0):
+        self.subscriber_id = subscriber_id
+        self.reason = reason
+        self.frontier = frontier
+        super().__init__(
+            f"slow consumer {subscriber_id} evicted ({reason}); "
+            f"reconnect with since={frontier}")
+
+
 # exception types that are NOT engine failures and must pass through the
 # boundary untouched (user-facing or control-flow exceptions)
 _PASSTHROUGH: tuple[type, ...] = (QueryError, KeyboardInterrupt, SystemExit,

@@ -70,6 +70,18 @@ Reference mapping (each named site's CockroachDB analogue):
   must disable the filter on its first negative answer, preserving the
   zero-false-negative guarantee.
 
+- ``changefeed.fanout.enqueue`` — fan-out buffer enqueue failure
+  (kvserver/rangefeed's BufferedSender overflow path): the batch never
+  reaches the subscriber's buffer; the subscriber sheds to a
+  catch-up-scan from its frontier, so nothing is lost and no bytes leak.
+- ``changefeed.subscriber.send`` — subscriber stream send failure
+  mid-event (the MuxRangeFeed per-stream error discipline): the
+  subscriber is evicted and resumes by reconnecting from its frontier.
+- ``changefeed.frontier.checkpoint`` — resolved-timestamp checkpoint
+  write/send failure (changefeedccl's frontier persistence): the
+  frontier stays stale, so a resume re-delivers (idempotent by (ts,
+  key)) rather than ever skipping events.
+
 Discipline: everything is OFF unless ``fault.injection.enabled`` is set
 AND the test armed specs via :func:`arm`. Firing decisions come from ONE
 seeded ``random.Random`` so a chaos run replays exactly given its seed.
@@ -119,6 +131,18 @@ SITES: dict[str, str] = {
                              "lost (error: waiter withdraws, typed busy)",
     "admission.bucket.refill": "tenant token-bucket refill failure "
                                "(typed busy with retry-after hint)",
+    "changefeed.fanout.enqueue": "fan-out buffer enqueue failure: the "
+                                 "batch is not buffered, the subscriber "
+                                 "sheds to catch-up-scan (no gap, no "
+                                 "leaked bytes)",
+    "changefeed.subscriber.send": "subscriber socket send failure "
+                                  "mid-stream: the consumer is evicted "
+                                  "and must reconnect from its frontier",
+    "changefeed.frontier.checkpoint": "resolved-frontier checkpoint "
+                                      "failure (job progress write or "
+                                      "subscriber checkpoint frame): "
+                                      "resume re-delivers past the stale "
+                                      "frontier, never skips",
 }
 
 

@@ -415,6 +415,36 @@ ADMISSION_TENANT_TOKENS = DEFAULT.labeled_gauge(
     "admission_tenant_tokens", "tenant",
     "admission token-bucket level by tenant id (admission.tenant.rate/"
     "burst); -1 when the tenant is not rate-limited")
+CHANGEFEED_SUBSCRIBERS = DEFAULT.gauge(
+    "changefeed_subscribers",
+    "rangefeed fan-out subscribers currently registered across all hubs "
+    "on this node (live + in catch-up)")
+CHANGEFEED_EVENTS_EMITTED = DEFAULT.counter(
+    "changefeed_events_emitted",
+    "event frames delivered to fan-out subscribers (catch-up scan "
+    "events included; checkpoints excluded)")
+CHANGEFEED_EVENTS_COALESCED = DEFAULT.counter(
+    "changefeed_events_coalesced",
+    "buffered events dropped by duplicate-key coalescing — rung one of "
+    "the slow-consumer backpressure ladder (the subscriber still sees "
+    "the newest version of every key)")
+CHANGEFEED_SHEDS = DEFAULT.counter(
+    "changefeed_sheds",
+    "subscriber buffers shed to catch-up-scan — rung two of the ladder: "
+    "the buffer is dropped and the subscriber is re-fed by an engine "
+    "scan from its frontier instead of from memory")
+CHANGEFEED_EVICTIONS = DEFAULT.counter(
+    "changefeed_evictions",
+    "subscribers evicted with SlowConsumerError (send deadline "
+    "exceeded, dead socket, or repeated sheds without draining)")
+CHANGEFEED_BUFFER_BYTES = DEFAULT.gauge(
+    "changefeed_buffer_bytes",
+    "bytes currently buffered across all fan-out subscribers (the "
+    "changefeed staging account under the node monitor root)")
+CHANGEFEED_SEND_LAG_SECONDS = DEFAULT.histogram(
+    "changefeed_send_lag_seconds",
+    "per-event delay from hub enqueue to subscriber socket send — the "
+    "fan-out plane's delivery-lag distribution")
 ADMISSION_REJECTIONS = DEFAULT.labeled_counter(
     "admission_rejections", "tenant",
     "statements refused admission by tenant id (queue full, rate "

@@ -577,6 +577,48 @@ TS_RETENTION_SECONDS = register_float(
     "each scrape tick; 0 disables pruning",
     lo=0.0,
 )
+CHANGEFEED_FANOUT_BUFFER_BYTES = register_int(
+    "changefeed.fanout.buffer_bytes", 1 << 20,
+    "per-subscriber fan-out buffer budget (bytes), charged to the "
+    "node's changefeed staging account; the backpressure ladder "
+    "(coalesce -> shed -> evict) engages against this bound",
+    lo=4096,
+)
+CHANGEFEED_FANOUT_HIGHWATER_FRAC = register_float(
+    "changefeed.fanout.highwater_frac", 0.5,
+    "fraction of the per-subscriber buffer budget at which duplicate-key "
+    "events start coalescing to newest-version-per-key",
+    lo=0.05, hi=1.0,
+)
+CHANGEFEED_FANOUT_SEND_DEADLINE_S = register_float(
+    "changefeed.fanout.send_deadline_s", 5.0,
+    "liveness bound on a subscriber connection: a send that blocks "
+    "longer than this, or a subscriber with pending work and no "
+    "successful send within it, is evicted (SlowConsumerError) and its "
+    "sender thread reaped",
+    lo=0.05,
+)
+CHANGEFEED_FANOUT_HEARTBEAT_S = register_float(
+    "changefeed.fanout.heartbeat_s", 1.0,
+    "idle-connection heartbeat: a subscriber with no new events still "
+    "receives a resolved-timestamp checkpoint this often, so a dead "
+    "socket is detected within heartbeat + send deadline",
+    lo=0.05,
+)
+CHANGEFEED_FANOUT_MAX_SUBSCRIBERS = register_int(
+    "changefeed.fanout.max_subscribers", 4096,
+    "bound on concurrently registered fan-out subscribers per hub; "
+    "past it new subscriptions are refused with a typed error frame "
+    "instead of degrading everyone",
+    lo=1,
+)
+CHANGEFEED_FANOUT_MAX_SHEDS = register_int(
+    "changefeed.fanout.max_consecutive_sheds", 3,
+    "a subscriber whose buffer is shed to catch-up-scan this many times "
+    "in a row without ever draining is evicted (the terminal rung of "
+    "the backpressure ladder)",
+    lo=1,
+)
 TS_SCRAPE_INTERVAL = register_float(
     "ts.scrape_interval_seconds", 10.0,
     "seconds between background metrics-scraper ticks on a server node "

@@ -96,6 +96,7 @@ def compare(fresh: dict, baseline: dict, threshold: float = 0.2
                 f"regression: {name} {bval:g} -> {fval:g} "
                 f"(-{drop:.1f}% > {threshold:.0%} threshold)")
     flags.extend(overload_oracle_flags(fresh))
+    flags.extend(fanout_oracle_flags(fresh))
     return flags
 
 
@@ -125,6 +126,22 @@ def overload_oracle_flags(fresh: dict) -> list[str]:
         flags.append("overload oracle: mixed_load.overload_oracle_ok = "
                      "false")
     return flags
+
+
+def fanout_oracle_flags(fresh: dict) -> list[str]:
+    """The changefeed fan-out oracle is pass/fail, not a trend: when the
+    fresh run carries ``fanout.*`` figures, a false oracle bool flags
+    regardless of any throughput threshold (a subscriber losing or
+    duplicating a version after dedup, or buffer bytes leaking past hub
+    close, are correctness failures)."""
+    fo = (fresh.get("detail") or {}).get("fanout")
+    if not isinstance(fo, dict) or "fanout_oracle_ok" not in fo:
+        return []
+    if not fo["fanout_oracle_ok"]:
+        return ["fanout oracle: a subscriber lost or duplicated a version "
+                "after (ts, key) dedup, or fan-out buffer bytes leaked "
+                "past hub close (detail.fanout.fanout_oracle_ok = false)"]
+    return []
 
 
 def main(argv: list[str] | None = None) -> int:

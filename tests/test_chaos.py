@@ -1329,3 +1329,227 @@ def test_admission_grant_stall_under_concurrent_load_converges():
     assert q.in_use == 0 and q.queue_depth == 0
     assert q.lane_depths() == {admission.LANE_INTERACTIVE: 0,
                                admission.LANE_ANALYTICAL: 0}
+
+
+# -- changefeed fan-out plane under injected faults --------------------------
+
+
+def _feed_db():
+    from cockroach_tpu.kv.hlc import ManualClock
+
+    return DB(Engine(key_width=16, val_width=64, memtable_size=64),
+              ManualClock())
+
+
+def _feed_oracle(db):
+    """(ts, key) -> value of the full committed history — the exactly-once
+    reference every faulted stream must dedup to."""
+    from cockroach_tpu.kv.changefeed import changes_between
+
+    events, _resolved = changes_between(db, 0, db.clock.now())
+    return {(e["ts"], e["key"]): e["value"] for e in events}
+
+
+def _feed_drain(sock, frames, until_resolved, deadline_s=15):
+    """Deduped event frames until the frontier reaches `until_resolved`,
+    an error frame, or end-of-stream. Returns (events, resolved, err)."""
+    sock.settimeout(deadline_s)
+    events, resolved = {}, 0
+    deadline = time.time() + deadline_s
+    for f in frames:
+        if "error" in f:
+            return events, resolved, f
+        if "resolved" in f:
+            resolved = max(resolved, f["resolved"])
+            if resolved >= until_resolved:
+                break
+        else:
+            events[(f["ts"], f["key"])] = f["value"]
+        if time.time() > deadline:
+            break
+    return events, resolved, None
+
+
+def test_fanout_injected_send_fault_evicts_then_reconnect_exactly_once():
+    """Site ``changefeed.subscriber.send``: the sender's first
+    transmission dies mid-stream. The subscriber is evicted with a typed
+    slow_consumer frame carrying its frontier, the emit loop survives,
+    and a reconnect from that frontier replays the feed so the deduped
+    union is bit-identical to the no-fault catch-up scan — exactly once
+    per version."""
+    from cockroach_tpu.kv.changefeed import (
+        RangefeedServer, subscribe_rangefeed,
+    )
+
+    db = _feed_db()
+    for i in range(6):
+        db.txn(lambda t, i=i: t.put(b"sf%d" % i, b"v%d" % i))
+    srv = RangefeedServer(db, poll_interval_s=0.02)
+    faults.arm(79, {
+        "changefeed.subscriber.send": FaultSpec(kind="drop", p=1.0,
+                                                max_fires=1),
+    })
+    try:
+        sock, frames = subscribe_rangefeed(srv.addr)
+        first, _ckpt, err = _feed_drain(sock, frames, db.clock.now())
+        sock.close()
+        assert err is not None and err["error"] == "slow_consumer", \
+            "faulted send must evict with a typed goodbye"
+        assert "frontier" in err
+        assert metric.CHANGEFEED_EVICTIONS.value >= 1
+        # the fault fired before any frame hit the wire: nothing was
+        # checkpointed, so the carried frontier is the join point
+        since = err["frontier"]
+        assert since == 0
+        sock2, frames2 = subscribe_rangefeed(srv.addr, since=since)
+        hi = db.clock.now()
+        second, ckpt2, err2 = _feed_drain(sock2, frames2, hi)
+        sock2.close()
+        assert err2 is None and ckpt2 >= hi, "emit loop wedged by fault"
+        merged = dict(first)
+        merged.update(second)
+        assert merged == _feed_oracle(db), \
+            "reconnect after injected send fault lost/duplicated a version"
+    finally:
+        faults.disarm()
+        srv.close()
+
+
+def test_fanout_injected_enqueue_fault_converges_without_buffer_leak():
+    """Site ``changefeed.fanout.enqueue``: every other buffer append dies
+    under a write stream. Each hit sheds the subscriber to catch-up (the
+    engine re-feeds from the frontier, dedup by (ts, key)), so the stream
+    still converges to the full history — and the changefeed staging
+    account drains to zero after close: no leaked buffer bytes."""
+    from cockroach_tpu.flow import memory as flowmem
+    from cockroach_tpu.kv.changefeed import (
+        RangefeedServer, subscribe_rangefeed,
+    )
+
+    db = _feed_db()
+    # poll SLOWER than one cold overlay rebuild (~0.4s with dozens of
+    # runs): each commit rewrites the run set, so a poller that fires
+    # faster than it can rebuild serializes the writer to one txn per
+    # rebuild under the store mutex and the test crawls
+    srv = RangefeedServer(db, poll_interval_s=0.25)
+    sheds0 = metric.CHANGEFEED_SHEDS.value
+    # this test pins the SHED rung: transient fault (max_fires — the
+    # retrying-caller knob) and a shed ceiling high enough that back-to-
+    # back sheds during one slow rescan can't escalate to eviction (the
+    # terminal rung has its own tests)
+    prev_sheds = settings.get("changefeed.fanout.max_consecutive_sheds")
+    settings.set("changefeed.fanout.max_consecutive_sheds", 100)
+    faults.arm(83, {
+        "changefeed.fanout.enqueue": FaultSpec(kind="error", p=0.5,
+                                               max_fires=6),
+    })
+    try:
+        sock, frames = subscribe_rangefeed(srv.addr)
+        # spread the writes over a few poll intervals so enqueue runs
+        # (and coin-flips) repeatedly while the consumer is live; after
+        # each injected shed the sender rescans and returns LIVE, so the
+        # next batch coin-flips again
+        for i in range(36):
+            db.txn(lambda t, i=i: t.put(b"eq%02d" % (i % 12),
+                                        b"w%02d" % i))
+            time.sleep(0.002)
+        hi = db.clock.now()
+        events, resolved, err = _feed_drain(sock, frames, hi)
+        sock.close()
+        assert err is None, f"enqueue fault must shed, not evict: {err}"
+        assert resolved >= hi, "frontier stalled under injected sheds"
+        assert events == _feed_oracle(db), \
+            "shed/rescan under enqueue faults lost or duplicated a version"
+        assert metric.CHANGEFEED_SHEDS.value > sheds0, \
+            "seed 83 at p=0.5 over ~dozens of enqueues must shed"
+    finally:
+        faults.disarm()
+        srv.close()
+        settings.set("changefeed.fanout.max_consecutive_sheds",
+                     prev_sheds)
+    assert flowmem.staging_monitor("changefeed").used == 0, \
+        "fan-out buffer bytes leaked past hub close"
+
+
+def test_fanout_injected_checkpoint_fault_resume_never_skips():
+    """Site ``changefeed.frontier.checkpoint``: the first checkpoint
+    write dies AFTER events reached the wire. The frontier must not
+    advance past the failed checkpoint — the typed eviction carries the
+    pre-fault frontier, and reconnecting from it re-delivers (dedup)
+    rather than skips: resolved never runs ahead of delivery."""
+    from cockroach_tpu.kv.changefeed import (
+        RangefeedServer, subscribe_rangefeed,
+    )
+
+    db = _feed_db()
+    for i in range(4):
+        db.txn(lambda t, i=i: t.put(b"cp%d" % i, b"v%d" % i))
+    srv = RangefeedServer(db, poll_interval_s=0.02)
+    faults.arm(89, {
+        "changefeed.frontier.checkpoint": FaultSpec(kind="error", p=1.0,
+                                                    max_fires=1),
+    })
+    try:
+        sock, frames = subscribe_rangefeed(srv.addr)
+        first, ckpt, err = _feed_drain(sock, frames, db.clock.now())
+        sock.close()
+        assert err is not None and err["error"] == "slow_consumer"
+        assert ckpt == 0, "a checkpoint frame arrived despite the fault"
+        assert err["frontier"] == 0, \
+            "frontier advanced past a checkpoint that never hit the wire"
+        sock2, frames2 = subscribe_rangefeed(srv.addr,
+                                             since=err["frontier"])
+        hi = db.clock.now()
+        second, ckpt2, err2 = _feed_drain(sock2, frames2, hi)
+        sock2.close()
+        assert err2 is None and ckpt2 >= hi
+        merged = dict(first)
+        merged.update(second)
+        assert merged == _feed_oracle(db), \
+            "resume after failed checkpoint skipped a version"
+    finally:
+        faults.disarm()
+        srv.close()
+
+
+def test_race_sanitizer_guards_fanout_frontier():
+    """The fan-out plane's shared state is racesan-tracked: a subscriber
+    frontier write under some OTHER lock (not the hub's
+    ``kv.fanout.state`` lock every product access holds) refines the
+    candidate lockset to empty and raises deterministically — the seeded
+    two-thread schedule for the new subscriber tree."""
+    import socket as _socket
+
+    from cockroach_tpu.kv import fanout
+
+    db = _feed_db()
+    hub = fanout.FanoutHub(db, poll_interval_s=3600)
+    a, b = _socket.socketpair()
+    try:
+        sub = hub.add_subscriber(a, start_sender=False)
+        with hub._mu:
+            racesan.note_write(sub, "frontier")  # product-path lockset
+        rogue = locks.lock("chaos.race.fanout")
+        transfer_errs = []
+
+        def writer_rogue():
+            try:
+                with rogue:
+                    racesan.note_write(sub, "frontier")
+            except racesan.DataRaceError as e:  # pragma: no cover
+                transfer_errs.append(e)
+
+        t = threading.Thread(target=writer_rogue,
+                             name="chaos-fanout-rogue")
+        t.start()
+        t.join(5)
+        assert not t.is_alive()
+        assert not transfer_errs  # transfer access only seeds C = {rogue}
+        # the next product-path write proves disjointness: {mu} ∩ {rogue}
+        with pytest.raises(racesan.DataRaceError, match="frontier"):
+            with hub._mu:
+                racesan.note_write(sub, "frontier")
+    finally:
+        hub.close()
+        a.close()
+        b.close()
