@@ -364,6 +364,21 @@ def _worker(job: str) -> None:
             "job": job, "platform": platform, **f,
         }), flush=True)
         return
+    if job == "views":
+        # matview maintenance plane: ~1k standing views (one shape class)
+        # against a mixed write stream — refresh lag p50/p99, fused
+        # dispatches per flush (O(kernels), not O(views)), delta-vs-
+        # rescan ratio, sampled bit-identity oracle
+        from cockroach_tpu.bench.views import run_views
+
+        v = run_views(
+            views=int(os.environ.get("BENCH_VIEWS_N", "1000")),
+            rounds=int(os.environ.get("BENCH_VIEWS_ROUNDS", "8")),
+        )
+        print("RESULT " + json.dumps({
+            "job": job, "platform": platform, **v,
+        }), flush=True)
+        return
     if job == "load":
         # mixed-workload serving load (ROADMAP 3(c)): N concurrent sessions
         # x (YCSB point ops + TPC-H analytics) through the full SQL front
@@ -519,6 +534,8 @@ def main(only_job: str | None = None) -> None:
         jobs.append("load")
     if os.environ.get("BENCH_FANOUT", "1") != "0":
         jobs.append("fanout")
+    if os.environ.get("BENCH_VIEWS", "1") != "0":
+        jobs.append("views")
     if only_job is not None:
         # --job <name>: run exactly that ladder item (e.g. `bench.py --job
         # load` for the mixed-workload serving run) with the same worker

@@ -1,0 +1,169 @@
+"""Materialized-view maintenance bench — the 1k-standing-views oracle.
+
+One base table, a fleet of ~1k registered views all sharing ONE shape
+class (same parameterized q1 shape, distinct date literals), refreshed
+against a sustained write stream of inserts, updates and deletes. What
+the BENCH ``detail["views"]`` payload must show:
+
+- **refresh lag** p50/p99 (wall-clock age of the oldest buffered event
+  when its flush lands) stays bounded while every flush refreshes the
+  whole fleet;
+- **dispatches per flush** is O(shape classes), NOT O(views): the delta
+  kernel folds the staged event tiles into every view's accumulator row
+  in one vmapped fused dispatch (``views_dispatch_ok``);
+- **delta vs rescan**: the steady path does delta work only — zero
+  base-table rescans after the create-time population
+  (``delta_vs_rescan`` = events applied incrementally per rescan);
+- **bit-identity** (``views_oracle_ok``): sampled views equal a fresh
+  full rescan of their defining query with the planner rewrite off —
+  enforced as pass/fail by scripts/check_bench_regress.py.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+_FLAGS = "ABCDEFGH"
+
+
+def _dates(n: int) -> list[str]:
+    out = []
+    for y in range(1995, 1999):
+        for mo in range(1, 13):
+            for dd in range(1, 29):
+                out.append(f"{y}-{mo:02d}-{dd:02d}")
+    step = max(1, len(out) // n)
+    return (out[::step] * ((n // len(out[::step])) + 1))[:n]
+
+
+def _q(date: str) -> str:
+    return ("SELECT flag, sum(qty) AS sq, avg(price) AS ap, count(*) AS n "
+            f"FROM t WHERE d <= DATE '{date}' GROUP BY flag ORDER BY flag")
+
+
+def run_views(views: int = 1000, rounds: int = 8,
+              writes_per_round: int = 64, base_rows: int = 240,
+              sample: int = 5) -> dict:
+    """Run the matview bench; returns the BENCH ``detail["views"]``
+    payload (see module docstring for the oracle contract)."""
+    from ..flow import dispatch
+    from ..sql import Session, matview
+    from ..utils import metric, settings
+
+    s = Session(val_width=160)
+    s.execute("CREATE TABLE t (k INT PRIMARY KEY, flag STRING, "
+              "qty DECIMAL(12,2), price DECIMAL(12,2), d DATE)")
+    rng = np.random.default_rng(7)
+    dates = _dates(max(views, 1))
+    t0 = time.time()
+    for lo in range(0, base_rows, 40):
+        rows = ", ".join(
+            f"({k}, '{_FLAGS[k % len(_FLAGS)]}', {k % 97}.25, "
+            f"{(k * 3) % 89}.50, DATE '{dates[k % len(dates)]}')"
+            for k in range(lo, min(lo + 40, base_rows)))
+        s.execute(f"INSERT INTO t VALUES {rows}")
+    for i in range(views):
+        s.execute(f"CREATE MATERIALIZED VIEW v{i} AS {_q(dates[i])}")
+    setup_s = time.time() - t0
+
+    reg = matview.registry_for(s.catalog)
+    m = reg.maintainers["t"]
+    full0 = metric.MATVIEW_FULL_RESCANS.value
+    mm0 = metric.MATVIEW_MINMAX_RESCANS.value
+    ev0 = metric.MATVIEW_DELTA_EVENTS.value
+
+    live = list(range(base_rows))
+    next_k = base_rows
+    lags_ms: list[float] = []
+    per_flush: list[int] = []
+    t1 = time.time()
+    for _ in range(rounds):
+        stmts = []
+        for _ in range(writes_per_round):
+            op = rng.integers(0, 10)
+            if op < 6 or not live:
+                stmts.append(
+                    f"INSERT INTO t VALUES ({next_k}, "
+                    f"'{_FLAGS[next_k % len(_FLAGS)]}', "
+                    f"{next_k % 53}.75, {next_k % 71}.25, "
+                    f"DATE '{dates[next_k % len(dates)]}')")
+                live.append(next_k)
+                next_k += 1
+            elif op < 9:
+                k = int(live[int(rng.integers(0, len(live)))])
+                stmts.append(f"UPDATE t SET qty = {k % 61}.50, "
+                             f"price = {k % 43}.00 WHERE k = {k}")
+            else:
+                k = live.pop(int(rng.integers(0, len(live))))
+                stmts.append(f"DELETE FROM t WHERE k = {k}")
+        for st in stmts:
+            s.execute(st)
+        m.pump()
+        d0 = dispatch.total()
+        m.flush()
+        per_flush.append(dispatch.total() - d0)
+        vs = m.views()
+        if vs:
+            lags_ms.append(vs[0].last_lag_s * 1e3)
+    steady_s = time.time() - t1
+
+    full_steady = metric.MATVIEW_FULL_RESCANS.value - full0
+    mm_steady = metric.MATVIEW_MINMAX_RESCANS.value - mm0
+    events = metric.MATVIEW_DELTA_EVENTS.value - ev0
+    classes = len(m.classes)
+
+    # sampled bit-identity oracle: standing state vs fresh full rescan,
+    # planner rewrite OFF so the reference cannot serve from the view
+    oracle_ok = True
+    idx = sorted({int(i) for i in
+                  np.linspace(0, views - 1, num=min(sample, views))})
+    prev = settings.get("sql.matview.rewrite.enabled")
+    settings.set("sql.matview.rewrite.enabled", False)
+    try:
+        for i in idx:
+            fresh = s.execute(_q(dates[i]))
+            got = s.execute(f"SELECT * FROM v{i} ORDER BY flag")
+            same = list(fresh) == list(got) and all(
+                np.array_equal(np.asarray(fresh[c]), np.asarray(got[c]))
+                for c in fresh)
+            if not same:
+                oracle_ok = False
+    finally:
+        settings.set("sql.matview.rewrite.enabled", prev)
+    matview.close_all(s.catalog)
+
+    return {
+        "views": views,
+        "rounds": rounds,
+        "writes_per_round": writes_per_round,
+        "shape_classes": classes,
+        "setup_s": round(setup_s, 2),
+        "steady_s": round(steady_s, 2),
+        "events_applied": int(events),
+        "refresh_lag_p50_ms": round(float(np.percentile(lags_ms, 50)), 3),
+        "refresh_lag_p99_ms": round(float(np.percentile(lags_ms, 99)), 3),
+        "dispatches_per_flush_mean": round(float(np.mean(per_flush)), 2),
+        "dispatches_per_flush_max": int(max(per_flush)),
+        "full_rescans_steady": int(full_steady),
+        "minmax_rescans_steady": int(mm_steady),
+        "delta_vs_rescan": round(
+            float(events) / max(1.0, full_steady + mm_steady), 1),
+        # O(kernels), not O(views): every flush refreshed the whole fleet
+        # in at most one fused dispatch per shape class, with no steady-
+        # state base rescans
+        "views_dispatch_ok": bool(
+            max(per_flush) <= classes and full_steady == 0),
+        "views_oracle_ok": bool(oracle_ok),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_views(
+        views=int(os.environ.get("BENCH_VIEWS_N", "1000")),
+        rounds=int(os.environ.get("BENCH_VIEWS_ROUNDS", "8")),
+    ), indent=2))

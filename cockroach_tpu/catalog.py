@@ -166,40 +166,46 @@ class Table:
 
     def device_batch(self, names: tuple[str, ...] | None = None) -> Batch:
         """Device-resident batch of the requested columns. Cached per column,
-        so a query never uploads columns it does not scan."""
+        so a query never uploads columns it does not scan.
+
+        The host source dicts are snapshotted into the cache when it is
+        created: a concurrent re-host that swaps ``columns``/``valids``
+        wholesale (matview materialize) leaves an in-flight reader
+        uploading from the generation its cache was built over — one
+        consistent snapshot, never a torn mix of old and new columns."""
         from .utils import settings
 
         names = names or self.schema.names
-        if self._device is None:
-            self._device = {}
+        dev = self._device
+        if dev is None:
+            dev = self._device = {}
+        host = dev.setdefault("__host__", self.columns)
+        valids = dev.setdefault("__valids__", self.valids)
+        n = len(next(iter(host.values()))) if host else 0
         # pin the padded capacity when the cache is created: tile_size is a
         # live setting, and per-column uploads after a change must match the
         # capacity of already-cached columns
-        cap = self._device.get("__cap__")
+        cap = dev.get("__cap__")
         if cap is None:
-            cap = _pad_cap(self.num_rows,
-                           settings.get("sql.distsql.tile_size"))
-            self._device["__cap__"] = cap
-        n = self.num_rows
-        if "__mask__" not in self._device:
+            cap = _pad_cap(n, settings.get("sql.distsql.tile_size"))
+            dev["__cap__"] = cap
+        if "__mask__" not in dev:
             m = np.zeros((cap,), dtype=np.bool_)
             m[:n] = True
-            self._device["__mask__"] = jnp.asarray(m)
+            dev["__mask__"] = jnp.asarray(m)
         cols = []
         for cname in names:
-            if cname not in self._device:
+            if cname not in dev:
                 t = self.schema.type_of(cname)
                 one = Schema((cname,), (t,))
-                valids = (
-                    {cname: self.valids[cname]} if cname in self.valids else None
-                )
+                v = {cname: valids[cname]} if cname in valids else None
                 b = from_host(
-                    one, {cname: np.asarray(self.columns[cname])},
-                    valids=valids, capacity=cap,
+                    one, {cname: np.asarray(host[cname])},
+                    valids=v, capacity=cap,
                 )
-                self._device[cname] = b.cols[0]
-            cols.append(self._device[cname])
-        return Batch(cols=tuple(cols), mask=self._device["__mask__"])
+                dev[cname] = b.cols[0]
+            cols.append(dev[cname])
+        return Batch(cols=tuple(cols), mask=dev["__mask__"])
 
     @staticmethod
     def from_strings(

@@ -30,6 +30,14 @@ subscriber, drops its buffered (not in-flight) bytes and, for wedged
 sockets, shuts the fd down — the sender thread observes the flag,
 best-effort delivers a final ``{"error": "slow_consumer", "frontier"}``
 frame, and cleans up after itself.
+
+Same-process consumers (the materialized-view maintainer,
+flow/viewmaint.py) register a :class:`LocalSubscriber` instead: no
+socket and no sender thread, the poll loop buffers RAW ``(ts, key,
+value|None)`` tuples under the same monitor accounting and backpressure
+ladder, and the consumer drains with a ``peek()``/``ack()`` two-phase
+protocol so a consumer that crashes mid-apply re-reads the identical
+delta — the reconnect-from-frontier discipline without a wire.
 """
 
 from __future__ import annotations
@@ -230,6 +238,76 @@ class Subscriber:
             self.last_send_s = time.time()
 
 
+class LocalSubscriber(Subscriber):
+    """An in-process registration: no socket, no sender thread. The poll
+    loop buffers raw ``(ts, key, value|None, nbytes, t_enq)`` tuples
+    (monitor-charged like any frame) and the consumer drains them with
+    :meth:`peek` / :meth:`ack` — two-phase so nothing is consumed until
+    the consumer has durably applied it. Joins in CATCHUP like a socket
+    subscriber: the first drain is the consumer's own engine scan from
+    its frontier, after which the buffer takes over."""
+
+    def __init__(self, hub: "FanoutHub", sub_id: int,
+                 start: bytes | None, end: bytes | None, since: int):
+        super().__init__(hub, sub_id, conn=None, start=start, end=end,
+                         since=since, raw=True)
+
+    def peek(self) -> tuple[list | None, int, float | None]:
+        """Snapshot the buffered delta WITHOUT consuming it.
+
+        Returns ``(events, resolved, oldest)`` where events is a list of
+        ``(ts, key, value|None)`` in (ts, key) order, resolved is the
+        span-local watermark they run up to, and oldest is the earliest
+        buffered enqueue wall-time (monotonic) — the consumer's freshness
+        lag anchor — or None when the buffer is empty. ``events is None``
+        means the buffer was shed (or never primed): the engine holds the
+        data, scan ``(frontier, resolved]`` yourself, then :meth:`ack`.
+        """
+        with self.hub._mu:
+            racesan.note_read(self, "frontier")
+            resolved = int(self.enq_frontier)
+            if self.state == LIVE:
+                oldest = self.buf[0][4] if self.buf else None
+                return ([(e[0], e[1], e[2]) for e in self.buf],
+                        resolved, oldest)
+            return None, resolved, None
+
+    def ack(self, upto: int) -> None:
+        """Consume through ``upto`` after the delta has been applied.
+        Buffered events at or below ``upto`` drop (bytes released); a
+        shed/evicted registration rejoins LIVE with its watermark pulled
+        back to exactly ``upto`` so the poll loop re-delivers everything
+        past what was actually applied — never a gap."""
+        with self.hub._mu:
+            racesan.note_write(self, "frontier")
+            self.frontier = max(self.frontier, int(upto))
+            if self.state == LIVE:
+                keep = [e for e in self.buf if e[0] > upto]
+                kept_bytes = sum(e[3] for e in keep)
+                released = self.queued_bytes - kept_bytes
+                if released > 0:
+                    self.mon.release(released)
+                self.buf = keep
+                self.queued_bytes = kept_bytes
+            else:
+                self.state = LIVE
+                self.evict_error = None
+                self.enq_frontier = int(upto)
+            self.sheds_run = 0
+            self.last_send_s = time.time()
+
+    def close(self) -> None:
+        """Deregister: drop buffered bytes, close the monitor, leave the
+        tree. The senderless analog of the sender thread's finally."""
+        with self.hub._mu:
+            self.state = EVICTED
+            self.mon.release(self.queued_bytes)
+            self.buf = []
+            self.queued_bytes = 0
+        self.mon.close()
+        self.hub._remove(self)
+
+
 class FanoutHub:
     """The subscriber tree: ONE poll loop over the engine demuxes
     committed versions to every registration; per-subscriber sender
@@ -286,6 +364,24 @@ class FanoutHub:
             sub.thread = t
             t.start()
         sub.wake.set()  # serve the catch-up scan promptly
+        return sub
+
+    def add_local(self, start: bytes | None = None,
+                  end: bytes | None = None,
+                  since: int = 0) -> LocalSubscriber | None:
+        """Register an in-process consumer (no socket, no sender). Same
+        admission bound as wire subscribers; None when full/closing."""
+        with self._mu:
+            racesan.note_read(self, "_subs")
+            limit = int(settings.get("changefeed.fanout.max_subscribers"))
+            if self._stop.is_set() or len(self._subs) >= limit:
+                return None
+            sub = LocalSubscriber(self, next(self._ids), start, end, since)
+            racesan.note_read(self, "frontier")
+            sub.enq_frontier = max(sub.enq_frontier, self.frontier)
+            racesan.note_write(self, "_subs")
+            self._subs[sub.id] = sub
+            metric.CHANGEFEED_SUBSCRIBERS.set(len(self._subs))
         return sub
 
     def _remove(self, sub: Subscriber) -> None:
@@ -353,6 +449,14 @@ class FanoutHub:
                     ts, key, _val = versions[k]
                     if not sub._in_span(key):
                         continue
+                    if sub.conn is None:
+                        # local consumer: raw tuple, no JSON frame; the
+                        # charge approximates the buffered tuple footprint
+                        val = versions[k][2]
+                        nb = (len(key) + (0 if val is None else len(val))
+                              + 48)
+                        batch.append((ts, key, val, nb, t_enq))
+                        continue
                     ck = (k, sub.raw)
                     payload = enc_cache.get(ck)
                     if payload is None:
@@ -370,7 +474,9 @@ class FanoutHub:
             # healthy sender heartbeats, so a stale last_send means a
             # dead socket or a wedged consumer
             for sub in subs:
-                if sub.state == EVICTED:
+                if sub.state == EVICTED or sub.conn is None:
+                    # local consumers have no socket to go dead; their
+                    # ladder ends at shed->catch-up, never the reaper
                     continue
                 racesan.note_read(sub, "frontier")
                 if tnow - sub.last_send_s > deadline:
@@ -521,6 +627,8 @@ class FanoutHub:
             subs = list(self._subs.values())
         for sub in subs:
             sub.wake.set()
+            if sub.conn is None:
+                continue  # local registration: no socket to sever
             try:
                 sub.conn.shutdown(_socket.SHUT_RDWR)
             except OSError:

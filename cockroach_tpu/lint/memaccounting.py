@@ -55,6 +55,11 @@ HOT_PATHS = (
     # must charge the node's changefeed staging account
     "cockroach_tpu/kv/changefeed.py",
     "cockroach_tpu/kv/fanout.py",
+    # the matview plane stages delta tiles and rebuilds standing [V, G]
+    # state arrays sized by the write stream and the view population —
+    # both must charge the matview staging account
+    "cockroach_tpu/flow/viewmaint.py",
+    "cockroach_tpu/sql/matview.py",
 )
 
 # materializing constructors: allocate fresh host/device buffers sized by

@@ -29,8 +29,12 @@ def explain(catalog, text: str) -> str:
                 debug = True
                 t = t[len("(debug)"):].lstrip()
     rel = sql(catalog, t)
+    from . import matview
+
+    note = matview.explain_note(catalog, rel)
+    prefix = (note + "\n") if note else ""
     if distsql:
-        return rel.explain_distributed()
+        return prefix + rel.explain_distributed()
     if analyze:
         import time as _time
         from types import SimpleNamespace
@@ -70,8 +74,8 @@ def explain(catalog, text: str) -> str:
                 span=last_trace_span(), trigger="explain_analyze_debug",
             )
             out += f"\ndiagnostics bundle: {bundle['id']}"
-        return out
-    return rel.explain()
+        return prefix + out
+    return prefix + rel.explain()
 
 
 __all__ = ["BindError", "Rel", "Session", "explain", "sql"]

@@ -315,6 +315,30 @@ def _node_changefeed_subscribers(catalog) -> Table:
     ])
 
 
+def _node_materialized_views(catalog) -> Table:
+    """Per-view standing state (the incremental-matview observability
+    surface): group count, resolved frontier, last refresh lag, and the
+    two fallback counters — min/max retraction rescans (delta algebra
+    couldn't answer) and full rebuilds (group key outgrew the dense
+    layout) — one row per registered view on this catalog."""
+    from . import matview
+
+    reg = matview.registry_for(catalog)
+    rows = reg.rows() if reg is not None else []
+    return _table("crdb_internal.node_materialized_views", [
+        ("view", T.STRING, _strs(r["view"] for r in rows)),
+        ("base_table", T.STRING, _strs(r["base_table"] for r in rows)),
+        ("groups", T.INT64, _ints(r["groups"] for r in rows)),
+        ("frontier", T.INT64, _ints(r["frontier"] for r in rows)),
+        ("refresh_lag_s", T.FLOAT64,
+         _floats(r["refresh_lag_s"] for r in rows)),
+        ("minmax_rescans", T.INT64,
+         _ints(r["minmax_rescans"] for r in rows)),
+        ("full_rescans", T.INT64, _ints(r["full_rescans"] for r in rows)),
+        ("stale", T.STRING, _strs(r["stale"] for r in rows)),
+    ])
+
+
 _BUILDERS = {
     "crdb_internal.node_statement_statistics": _stmt_statistics,
     "crdb_internal.cluster_queries": _cluster_queries,
@@ -326,6 +350,7 @@ _BUILDERS = {
     "crdb_internal.cluster_load": _cluster_load,
     "crdb_internal.node_tenant_admission": _node_tenant_admission,
     "crdb_internal.node_changefeed_subscribers": _node_changefeed_subscribers,
+    "crdb_internal.node_materialized_views": _node_materialized_views,
 }
 
 

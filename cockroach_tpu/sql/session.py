@@ -294,6 +294,17 @@ class Session:
             handled = self._maybe_session_var_stmt(text)
         if handled is not None:
             return handled
+        from . import matview
+
+        handled = matview.maybe_matview_stmt(self, text)
+        if handled is not None:
+            return handled
+        if self._txn is None:
+            # standing views refresh BEFORE the plan-cache fast path: a
+            # memoized statement over a view must still see the frontier
+            # as of statement start (refresh bumps the catalog version,
+            # which re-keys any plan the refresh staled)
+            matview.refresh_for_text(self.catalog, text)
         if self._txn is None:
             # exact-text fast path: a verbatim repeat SELECT skips even
             # parse/bind and runs its cached prepared plan directly
@@ -480,6 +491,12 @@ class Session:
             self._set_phase("binding")
             with tracing.leaf_span("sql.bind"):
                 rel = Binder(self.catalog).bind(stmt)
+            # a plan matching a standing view's shape + literals serves
+            # from the view's state (autocommit only: an explicit txn
+            # reads at ITS snapshot, not the view frontier)
+            from . import matview
+
+            rel, _mv = matview.maybe_rewrite(self.catalog, rel)
             self._set_phase("executing")
             res, _, fp = plancache.run_cached_ex(rel, text=text)
             self._last_fp = fp or None

@@ -1352,6 +1352,11 @@ class Engine:
         for r in old_runs:
             self._drop_run_meta(r)
         self._gen += 1
+        # the per-commit memtable flush above mints a new run every commit;
+        # without a compaction hook here a commit-heavy workload grows
+        # `runs` without bound and every cold _merged_view() rebuild pays
+        # ~8ms/run — same trigger + IOGovernor pacing as the write path
+        self._maybe_compact()
 
     @_locked
     def has_committed_writes_in(

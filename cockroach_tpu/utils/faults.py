@@ -81,6 +81,13 @@ Reference mapping (each named site's CockroachDB analogue):
   write/send failure (changefeedccl's frontier persistence): the
   frontier stays stale, so a resume re-delivers (idempotent by (ts,
   key)) rather than ever skipping events.
+- ``matview.flush`` / ``matview.delta.apply`` /
+  ``matview.frontier.checkpoint`` — materialized-view maintenance
+  failures at flush start, inside a delta-kernel apply, and between
+  compute and the frontier/state swap. All three leave the buffered
+  delta in place and the standing state untouched, so the retrying
+  flush re-applies the identical delta from the old frontier —
+  bit-identical to a fresh full scan, nothing lost or duplicated.
 
 Discipline: everything is OFF unless ``fault.injection.enabled`` is set
 AND the test armed specs via :func:`arm`. Firing decisions come from ONE
@@ -143,6 +150,16 @@ SITES: dict[str, str] = {
                                       "subscriber checkpoint frame): "
                                       "resume re-delivers past the stale "
                                       "frontier, never skips",
+    "matview.delta.apply": "materialized-view delta kernel failure "
+                           "mid-flush: no state swapped, buffered delta "
+                           "retained, retry from frontier is bit-exact",
+    "matview.flush": "materialized-view flush failure before any "
+                     "apply: events stay buffered at the subscription, "
+                     "next flush resumes from the frontier",
+    "matview.frontier.checkpoint": "materialized-view frontier "
+                                   "checkpoint failure after compute, "
+                                   "before swap: retry re-applies the "
+                                   "same delta, nothing lost or doubled",
 }
 
 

@@ -445,6 +445,39 @@ CHANGEFEED_SEND_LAG_SECONDS = DEFAULT.histogram(
     "changefeed_send_lag_seconds",
     "per-event delay from hub enqueue to subscriber socket send — the "
     "fan-out plane's delivery-lag distribution")
+MATVIEW_VIEWS = DEFAULT.gauge(
+    "matview_views",
+    "materialized views currently registered on this node")
+MATVIEW_FLUSHES = DEFAULT.counter(
+    "matview_flushes",
+    "view-maintenance flushes: each drains a base table's buffered "
+    "changefeed delta into every standing view in a handful of fused "
+    "dispatches and advances the shared resolved frontier")
+MATVIEW_DELTA_EVENTS = DEFAULT.counter(
+    "matview_delta_events",
+    "changefeed events (inserts, updates, tombstones) applied to "
+    "standing view state incrementally — the work a full rescan never "
+    "has to do")
+MATVIEW_FULL_RESCANS = DEFAULT.counter(
+    "matview_full_rescans",
+    "views rebuilt by a base-table rescan instead of delta work: "
+    "initial population at CREATE, restart recovery, and the "
+    "out-of-bounds group-key fallback (a group key outside the dense "
+    "layout minted since CREATE)")
+MATVIEW_MINMAX_RESCANS = DEFAULT.counter(
+    "matview_minmax_rescans",
+    "per-view re-scan fallbacks after a retraction hit a group's "
+    "current min/max extremum — the one aggregate family that cannot "
+    "retract natively")
+MATVIEW_REWRITE_HITS = DEFAULT.counter(
+    "matview_rewrite_hits",
+    "SELECTs whose plan matched a registered view's defining query and "
+    "were served from standing state by the settings-gated planner "
+    "rewrite (sql.matview.rewrite.enabled)")
+MATVIEW_REFRESH_LAG_SECONDS = DEFAULT.histogram(
+    "matview_refresh_lag_seconds",
+    "per-flush staleness closed by view maintenance: wall-clock age of "
+    "the oldest buffered event when its flush lands")
 ADMISSION_REJECTIONS = DEFAULT.labeled_counter(
     "admission_rejections", "tenant",
     "statements refused admission by tenant id (queue full, rate "

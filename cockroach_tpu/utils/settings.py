@@ -612,6 +612,32 @@ CHANGEFEED_FANOUT_MAX_SUBSCRIBERS = register_int(
     "instead of degrading everyone",
     lo=1,
 )
+MATVIEW_ENABLED = register_bool(
+    "sql.matview.enabled", True,
+    "master switch for the materialized-view subsystem: CREATE "
+    "MATERIALIZED VIEW is refused when off (existing views keep "
+    "serving their last refreshed state)",
+)
+MATVIEW_REWRITE_ENABLED = register_bool(
+    "sql.matview.rewrite.enabled", True,
+    "planner rewrite: a SELECT whose parameterized plan matches a "
+    "registered materialized view's defining query is served from the "
+    "standing state (AS OF the view's resolved frontier) instead of "
+    "rescanning the base table",
+)
+MATVIEW_REFRESH_ON_READ = register_bool(
+    "sql.matview.refresh_on_read.enabled", True,
+    "drain pending changefeed deltas into a view's standing state "
+    "before a statement that reads it; off = reads serve the state as "
+    "of the last flush (the AS OF freshness bound is the frontier)",
+)
+MATVIEW_STAGING_BYTES = register_int(
+    "sql.matview.staging_bytes", 4 << 20,
+    "budget for a view maintainer's delta-tile staging account (the "
+    "columnar insert/retract tiles built per flush are charged here "
+    "before the fused maintenance dispatch)",
+    lo=4096,
+)
 CHANGEFEED_FANOUT_MAX_SHEDS = register_int(
     "changefeed.fanout.max_consecutive_sheds", 3,
     "a subscriber whose buffer is shed to catch-up-scan this many times "

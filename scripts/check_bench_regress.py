@@ -97,6 +97,7 @@ def compare(fresh: dict, baseline: dict, threshold: float = 0.2
                 f"(-{drop:.1f}% > {threshold:.0%} threshold)")
     flags.extend(overload_oracle_flags(fresh))
     flags.extend(fanout_oracle_flags(fresh))
+    flags.extend(views_oracle_flags(fresh))
     return flags
 
 
@@ -142,6 +143,27 @@ def fanout_oracle_flags(fresh: dict) -> list[str]:
                 "after (ts, key) dedup, or fan-out buffer bytes leaked "
                 "past hub close (detail.fanout.fanout_oracle_ok = false)"]
     return []
+
+
+def views_oracle_flags(fresh: dict) -> list[str]:
+    """The matview oracle is pass/fail, not a trend: when the fresh run
+    carries ``views.*`` figures, a false oracle bool flags regardless of
+    any throughput threshold (a standing view drifting from its defining
+    query's rescan, or per-view dispatches creeping back into the flush
+    path, are correctness failures)."""
+    vw = (fresh.get("detail") or {}).get("views")
+    if not isinstance(vw, dict) or "views_oracle_ok" not in vw:
+        return []
+    flags = []
+    if not vw["views_oracle_ok"]:
+        flags.append("views oracle: a sampled materialized view was not "
+                     "bit-identical to a fresh rescan of its defining "
+                     "query (detail.views.views_oracle_ok = false)")
+    if not vw.get("views_dispatch_ok", True):
+        flags.append("views oracle: flush cost scaled with the view count "
+                     "or fell back to base rescans on the steady path "
+                     "(detail.views.views_dispatch_ok = false)")
+    return flags
 
 
 def main(argv: list[str] | None = None) -> int:

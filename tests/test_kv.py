@@ -488,3 +488,30 @@ def test_rangefeed_push_subscription():
         assert resolved > 0
     finally:
         srv.close()
+
+
+def test_commit_heavy_intent_resolution_bounds_runs():
+    """resolve_intents rewrites every run AND mints a new one per commit
+    (the per-commit memtable flush); its end-of-resolution compaction
+    hook must keep the run count bounded under a commit-heavy loop —
+    without it, N commits leave ~N runs and every cold merged-view
+    rebuild pays for all of them."""
+    from cockroach_tpu.utils import settings
+
+    db = mkdb()
+    prev = settings.get("storage.compaction.pacing.enabled")
+    settings.set("storage.compaction.pacing.enabled", False)
+    try:
+        n = 40
+        for i in range(n):
+            t = db.new_txn()
+            t.put(b"k%d" % (i % 8), b"v%d" % i)
+            t.commit()
+        eng = db.engine
+        assert len(eng.runs) <= eng.l0_trigger + 1, (
+            f"{len(eng.runs)} runs after {n} commits "
+            f"(trigger {eng.l0_trigger})")
+        for j in range(8):
+            assert db.get(b"k%d" % j) is not None
+    finally:
+        settings.set("storage.compaction.pacing.enabled", prev)

@@ -1,0 +1,350 @@
+"""Materialized views: changefeed-fed standing aggregates (sql/matview.py
++ flow/viewmaint.py). Deterministic contracts — the injected-fault side
+lives in test_matview_chaos.py:
+
+- bit-identity: a q1-shaped view equals a fresh full rescan of its
+  defining query after ANY interleaving of inserts, updates, deletes and
+  commits (the delta algebra is exact: DECIMAL sums are scaled-int64,
+  avg finalizes through the same code path as the scan pipeline);
+- restart: tearing the plane down and re-registering the view resumes
+  from the resolved frontier, bit-identical to the incremental state;
+- retractions: count/sum/avg retract natively; min/max falls back to a
+  per-view rescan ONLY when a retraction hits the group extremum
+  (counted in matview_minmax_rescans);
+- steady path does delta work only: one fused dispatch per shape class,
+  never a base-table rescan;
+- concurrent reads during flush serve a consistent frontier snapshot
+  (never a torn mix of column generations);
+- planner rewrite + EXPLAIN note + vtable introspection surfaces.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.flow import dispatch
+from cockroach_tpu.sql import Session, explain, matview
+from cockroach_tpu.utils import metric, settings
+
+# the canonical q1 shape: grouped sum/avg/count over a date-filtered scan
+Q = ("SELECT flag, sum(qty) AS sq, avg(price) AS ap, count(*) AS n "
+     "FROM t WHERE d <= DATE '1998-06-15' GROUP BY flag ORDER BY flag")
+
+
+def _mk_session():
+    s = Session(val_width=160)
+    s.execute("CREATE TABLE t (k INT PRIMARY KEY, flag STRING, "
+              "qty DECIMAL(12,2), price DECIMAL(12,2), d DATE)")
+    return s
+
+
+def _seed_rows(s, n=40):
+    for i in range(n):
+        s.execute(
+            f"INSERT INTO t VALUES ({i}, '{'ABC'[i % 3]}', {i}.25, "
+            f"{i * 2}.50, DATE '1998-0{1 + i % 8}-0{1 + i % 9}')")
+
+
+@pytest.fixture
+def sess():
+    s = _mk_session()
+    yield s
+    matview.close_all(s.catalog)
+
+
+def _rows(res):
+    return {k: np.asarray(v) for k, v in res.items()}
+
+
+def _assert_same(a, b, ctx=""):
+    a, b = _rows(a), _rows(b)
+    assert list(a) == list(b), (ctx, list(a), list(b))
+    for k in a:
+        assert np.array_equal(a[k], b[k]), (ctx, k, a[k], b[k])
+
+
+def _oracle(s, q=Q):
+    """Fresh full-rescan reference with the planner rewrite OFF, so the
+    oracle can never itself be served from the view under test."""
+    prev = settings.get("sql.matview.rewrite.enabled")
+    settings.set("sql.matview.rewrite.enabled", False)
+    try:
+        return s.execute(q)
+    finally:
+        settings.set("sql.matview.rewrite.enabled", prev)
+
+
+def test_create_matches_rescan(sess):
+    _seed_rows(sess)
+    base = _oracle(sess)
+    out = sess.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    assert out["created_view"] == "mv"
+    _assert_same(base, sess.execute("SELECT * FROM mv ORDER BY flag"))
+
+
+def test_mixed_dml_bit_identity(sess, rng):
+    """The oracle: arbitrary insert/update/delete interleavings, view ==
+    fresh rescan after every round (including rows outside the filter
+    and deletes of never-matching rows)."""
+    _seed_rows(sess)
+    sess.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    live = set(range(40))
+    next_k = 100
+    for rnd in range(6):
+        for _ in range(int(rng.integers(1, 5))):  # inserts (some filtered)
+            f = "ABC"[int(rng.integers(0, 3))]
+            mo = 1 + int(rng.integers(0, 12) % 9) % 8
+            sess.execute(
+                f"INSERT INTO t VALUES ({next_k}, '{f}', "
+                f"{int(rng.integers(0, 50))}.75, "
+                f"{int(rng.integers(0, 99))}.25, "
+                f"DATE '1998-0{mo}-11')")
+            live.add(next_k)
+            next_k += 1
+        for _ in range(int(rng.integers(1, 4))):  # updates
+            k = int(rng.choice(sorted(live)))
+            sess.execute(f"UPDATE t SET qty = {int(rng.integers(0, 80))}.50,"
+                         f" price = {int(rng.integers(0, 80))}.00"
+                         f" WHERE k = {k}")
+        if rnd % 2 == 1:
+            k = int(rng.choice(sorted(live)))
+            sess.execute(f"DELETE FROM t WHERE k = {k}")
+            live.discard(k)
+        _assert_same(_oracle(sess),
+                     sess.execute("SELECT * FROM mv ORDER BY flag"),
+                     ctx=f"round {rnd}")
+
+
+def test_restart_resume_from_frontier(sess):
+    """Tear the matview plane down (crash analog) and re-register the
+    view: the rebuild rescans at the resolved frontier and must be
+    bit-identical to the incremental state it replaces."""
+    _seed_rows(sess)
+    sess.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    sess.execute("INSERT INTO t VALUES (200, 'B', 9.00, 1.50, "
+                 "DATE '1998-01-02')")
+    sess.execute("DELETE FROM t WHERE k = 4")
+    r_inc = sess.execute("SELECT * FROM mv ORDER BY flag")
+    # restart: the registry, maintainers and hub die with the node;
+    # the base table (KV) and its changefeed history survive
+    matview.close_all(sess.catalog)
+    sess.catalog.tables.pop("mv", None)
+    sess.catalog.bump_version()
+    sess._invalidate_plans()
+    sess.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    r_back = sess.execute("SELECT * FROM mv ORDER BY flag")
+    _assert_same(r_inc, r_back, ctx="restart")
+    _assert_same(_oracle(sess), r_back, ctx="restart-vs-rescan")
+
+
+def test_retraction_per_aggregate_kind(sess):
+    """count/sum/avg retract natively; min/max retracts natively UNLESS
+    the retraction hits the group's current extremum — that one case
+    re-scans the view (matview_minmax_rescans)."""
+    q2 = ("SELECT flag, count(*) AS n, count(qty) AS nq, sum(qty) AS sq, "
+          "avg(price) AS ap, min(qty) AS mn, max(qty) AS mx "
+          "FROM t WHERE d <= DATE '1999-01-01' GROUP BY flag ORDER BY flag")
+    _seed_rows(sess)
+    sess.execute(f"CREATE MATERIALIZED VIEW mv AS {q2}")
+    reg = matview.registry_for(sess.catalog)
+
+    def rescans():
+        (row,) = reg.rows()
+        return row["minmax_rescans"]
+
+    # 1) delete strictly inside the extremes: native retraction, no rescan
+    sess.execute("DELETE FROM t WHERE k = 3")  # qty 3.25 in (0.25, 39.25)
+    _assert_same(_oracle(sess, q2),
+                 sess.execute("SELECT * FROM mv ORDER BY flag"),
+                 ctx="interior delete")
+    assert rescans() == 0
+    # 2) update raising a group's max: pure insert-side extremum move
+    sess.execute("UPDATE t SET qty = 99.99 WHERE k = 12")
+    _assert_same(_oracle(sess, q2),
+                 sess.execute("SELECT * FROM mv ORDER BY flag"),
+                 ctx="raise max")
+    assert rescans() == 0
+    # 3) delete the row holding the max: the non-retractable case
+    sess.execute("DELETE FROM t WHERE k = 12")
+    _assert_same(_oracle(sess, q2),
+                 sess.execute("SELECT * FROM mv ORDER BY flag"),
+                 ctx="delete extremum")
+    assert rescans() >= 1
+    # 4) delete the row holding a group's min
+    before = rescans()
+    sess.execute("DELETE FROM t WHERE k = 0")  # qty 0.25 = min of 'A'
+    _assert_same(_oracle(sess, q2),
+                 sess.execute("SELECT * FROM mv ORDER BY flag"),
+                 ctx="delete min")
+    assert rescans() > before
+
+
+def test_steady_path_is_delta_only(sess):
+    """1 flush refreshing N same-shaped views = 1 fused dispatch (per
+    shape class, not per view), and zero base-table rescans."""
+    _seed_rows(sess)
+    for i, d in enumerate(("1998-03-15", "1998-06-15", "1998-08-15")):
+        sess.execute(
+            f"CREATE MATERIALIZED VIEW mv{i} AS "
+            + Q.replace("1998-06-15", d))
+    reg = matview.registry_for(sess.catalog)
+    m = reg.maintainers["t"]
+    assert len(m.classes) == 1  # same parameterized shape -> one class
+    for i in range(6):
+        sess.execute(f"INSERT INTO t VALUES ({300 + i}, 'A', 1.25, 2.50, "
+                     f"DATE '1998-0{2 + i}-03')")
+    sess.execute("DELETE FROM t WHERE k = 7")
+    m.pump()
+    assert m.pending()
+    d0 = dispatch.total()
+    full0 = metric.MATVIEW_FULL_RESCANS.value
+    mm0 = metric.MATVIEW_MINMAX_RESCANS.value
+    fr0 = [v.frontier for v in m.views()]
+    assert m.flush()
+    assert dispatch.total() - d0 <= len(m.classes)  # O(kernels), not O(views)
+    assert metric.MATVIEW_FULL_RESCANS.value == full0  # no base rescan
+    assert metric.MATVIEW_MINMAX_RESCANS.value == mm0
+    assert all(v.frontier > f for v, f in zip(m.views(), fr0))
+    for i, d in enumerate(("1998-03-15", "1998-06-15", "1998-08-15")):
+        _assert_same(_oracle(sess, Q.replace("1998-06-15", d)),
+                     sess.execute(f"SELECT * FROM mv{i} ORDER BY flag"),
+                     ctx=f"view {d}")
+
+
+def test_concurrent_reads_during_flush(sess):
+    """Readers racing the maintainer's flush/re-host must always see one
+    consistent frontier snapshot: with every row's qty fixed at 2.00,
+    sum(qty) == 2 * count(*) holds at EVERY frontier — a torn mix of
+    column generations would break it."""
+    qc = ("SELECT flag, count(*) AS n, sum(qty) AS sq FROM t "
+          "WHERE d <= DATE '1999-01-01' GROUP BY flag ORDER BY flag")
+    for i in range(20):
+        sess.execute(f"INSERT INTO t VALUES ({i}, '{'AB'[i % 2]}', 2.00, "
+                     f"4.00, DATE '1998-01-0{1 + i % 9}')")
+    sess.execute(f"CREATE MATERIALIZED VIEW mv AS {qc}")
+    reader = Session(catalog=sess.catalog, db=sess.db, bootstrap=False)
+    stop = threading.Event()
+    errors = []
+
+    def read_loop():
+        while not stop.is_set():
+            try:
+                res = reader.execute("SELECT * FROM mv ORDER BY flag")
+                n = np.asarray(res["n"], dtype=np.float64)
+                sq = np.asarray(res["sq"], dtype=np.float64)
+                if not np.array_equal(sq, 2.0 * n):
+                    errors.append(("torn", sq.tolist(), n.tolist()))
+            except Exception as e:  # noqa: BLE001 - surface in main thread
+                errors.append(("raise", repr(e)))
+
+    th = threading.Thread(target=read_loop, daemon=True)
+    th.start()
+    try:
+        for i in range(40):
+            sess.execute(f"INSERT INTO t VALUES ({100 + i}, "
+                         f"'{'AB'[i % 2]}', 2.00, 4.00, DATE '1998-02-01')")
+            if i % 5 == 0:
+                sess.execute("REFRESH MATERIALIZED VIEW mv")
+    finally:
+        stop.set()
+        th.join(timeout=30)
+    assert not th.is_alive()
+    assert not errors, errors[:3]
+    _assert_same(_oracle(sess, qc),
+                 sess.execute("SELECT * FROM mv ORDER BY flag"))
+
+
+def test_rewrite_serves_from_view(sess):
+    _seed_rows(sess)
+    sess.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    hits0 = metric.MATVIEW_REWRITE_HITS.value
+    # different text, same bound shape AND literals -> served from state
+    res = sess.execute(Q.replace("SELECT", "select"))
+    assert metric.MATVIEW_REWRITE_HITS.value > hits0
+    _assert_same(res, sess.execute("SELECT * FROM mv ORDER BY flag"))
+    # different literal -> no match, fresh scan (and it must be correct)
+    other = Q.replace("1998-06-15", "1998-04-15")
+    hits1 = metric.MATVIEW_REWRITE_HITS.value
+    _assert_same(_oracle(sess, other), sess.execute(other))
+    assert metric.MATVIEW_REWRITE_HITS.value == hits1
+    # setting gate
+    prev = settings.get("sql.matview.rewrite.enabled")
+    settings.set("sql.matview.rewrite.enabled", False)
+    try:
+        hits2 = metric.MATVIEW_REWRITE_HITS.value
+        sess.execute(Q)
+        assert metric.MATVIEW_REWRITE_HITS.value == hits2
+    finally:
+        settings.set("sql.matview.rewrite.enabled", prev)
+
+
+def test_explain_notes_view(sess):
+    _seed_rows(sess)
+    sess.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    direct = explain(sess.catalog, "EXPLAIN SELECT * FROM mv")
+    assert "served from materialized view mv" in direct
+    rewritten = explain(sess.catalog, "EXPLAIN " + Q)
+    assert "served from materialized view mv" in rewritten
+    assert "rewrite" in rewritten
+    untouched = explain(
+        sess.catalog, "EXPLAIN " + Q.replace("1998-06-15", "1998-04-15"))
+    assert "materialized view" not in untouched
+
+
+def test_vtable_reports_views(sess):
+    _seed_rows(sess)
+    sess.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    res = sess.execute(
+        "SELECT view, base_table, groups, full_rescans FROM "
+        "crdb_internal.node_materialized_views")
+    assert _rows(res)["groups"].tolist() == [3]  # flags A, B, C
+    assert _rows(res)["full_rescans"].tolist() == [1]  # initial population
+    rows = matview.registry_for(sess.catalog).rows()
+    assert [r["view"] for r in rows] == ["mv"]
+    assert rows[0]["base_table"] == "t"
+    assert rows[0]["frontier"] > 0
+
+
+def test_oob_group_key_rebuilds(sess):
+    """A group-key dictionary code minted after CREATE lands outside the
+    view's dense layout: the maintainer falls back to a rebuild (counted
+    in full_rescans) and the new group appears."""
+    _seed_rows(sess)
+    sess.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    reg = matview.registry_for(sess.catalog)
+    (row,) = reg.rows()
+    full0 = row["full_rescans"]
+    sess.execute("INSERT INTO t VALUES (500, 'ZED', 1.00, 2.00, "
+                 "DATE '1998-01-05')")
+    _assert_same(_oracle(sess),
+                 sess.execute("SELECT * FROM mv ORDER BY flag"),
+                 ctx="new dict value")
+    (row,) = reg.rows()
+    assert row["full_rescans"] > full0
+    assert row["groups"] == 4
+
+
+def test_ddl_lifecycle_and_gates(sess):
+    _seed_rows(sess, n=6)
+    prev = settings.get("sql.matview.enabled")
+    settings.set("sql.matview.enabled", False)
+    try:
+        with pytest.raises(Exception, match="disabled"):
+            sess.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    finally:
+        settings.set("sql.matview.enabled", prev)
+    # non-aggregate defining query is refused with a typed error
+    with pytest.raises(Exception, match="grouped aggregate"):
+        sess.execute("CREATE MATERIALIZED VIEW mv AS SELECT k FROM t")
+    sess.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    with pytest.raises(Exception, match="already exists"):
+        sess.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    out = sess.execute("REFRESH MATERIALIZED VIEW mv")
+    assert out["refreshed"] == "mv"
+    assert metric.MATVIEW_VIEWS.value == 1
+    sess.execute("DROP MATERIALIZED VIEW mv")
+    assert metric.MATVIEW_VIEWS.value == 0
+    assert "mv" not in sess.catalog.tables
+    with pytest.raises(Exception, match="unknown materialized view"):
+        sess.execute("DROP MATERIALIZED VIEW mv")

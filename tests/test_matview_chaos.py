@@ -1,0 +1,153 @@
+"""Matview chaos: injected faults at the three maintenance sites
+(utils/faults.py matview.*) must leave the standing state untouched —
+the flush is all-or-nothing against the subscriber's un-acked buffer,
+so a retry resumes from the resolved frontier with no delta lost and
+none applied twice. Every scenario ends with the bit-identity oracle:
+view state after fault + retry == fresh full rescan.
+
+Fast seeds only (the test_chaos.py discipline): deterministic, runs in
+tier-1, excluded with -m 'not chaos'."""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.sql import Session, matview
+from cockroach_tpu.utils import faults, locks, racesan, settings
+from cockroach_tpu.utils.faults import FaultSpec, InjectedFault
+
+pytestmark = pytest.mark.chaos
+
+Q = ("SELECT flag, sum(qty) AS sq, avg(price) AS ap, count(*) AS n "
+     "FROM t WHERE d <= DATE '1998-06-15' GROUP BY flag ORDER BY flag")
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_detector():
+    locks.reset()
+    prev = settings.get("debug.lock_order.enabled")
+    settings.set("debug.lock_order.enabled", True)
+    yield
+    settings.set("debug.lock_order.enabled", prev)
+    locks.reset()
+
+
+@pytest.fixture(autouse=True)
+def _race_sanitizer():
+    racesan.reset()
+    prev = settings.get("debug.race_detector.enabled")
+    settings.set("debug.race_detector.enabled", True)
+    yield
+    settings.set("debug.race_detector.enabled", prev)
+    racesan.reset()
+
+
+def _setup():
+    s = Session(val_width=160)
+    s.execute("CREATE TABLE t (k INT PRIMARY KEY, flag STRING, "
+              "qty DECIMAL(12,2), price DECIMAL(12,2), d DATE)")
+    for i in range(30):
+        s.execute(
+            f"INSERT INTO t VALUES ({i}, '{'AB'[i % 2]}', {i}.25, "
+            f"{i * 2}.50, DATE '1998-0{1 + i % 8}-0{1 + i % 9}')")
+    s.execute(f"CREATE MATERIALIZED VIEW mv AS {Q}")
+    return s
+
+
+def _oracle(s):
+    prev = settings.get("sql.matview.rewrite.enabled")
+    settings.set("sql.matview.rewrite.enabled", False)
+    try:
+        return s.execute(Q)
+    finally:
+        settings.set("sql.matview.rewrite.enabled", prev)
+
+
+def _assert_same(a, b, ctx=""):
+    assert list(a) == list(b), (ctx, list(a), list(b))
+    for k in a:
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), (
+            ctx, k, a[k], b[k])
+
+
+@pytest.mark.parametrize("site", [
+    "matview.flush",
+    "matview.delta.apply",
+    "matview.frontier.checkpoint",
+])
+def test_faulted_flush_resumes_from_frontier(site):
+    """Kill the flush at each stage: nothing commits (frontier, standing
+    state and the un-acked event buffer are all unchanged), and the
+    retried flush applies the SAME delta exactly once."""
+    s = _setup()
+    try:
+        reg = matview.registry_for(s.catalog)
+        view = reg.views["mv"]
+        m = reg.maintainers["t"]
+        f0 = view.frontier
+        assert f0 > 0
+        # mixed delta: insert + update (retraction) + delete (tombstone)
+        s.execute("INSERT INTO t VALUES (100, 'A', 7.00, 3.00, "
+                  "DATE '1998-02-02')")
+        s.execute("UPDATE t SET qty = 99.75 WHERE k = 2")
+        s.execute("DELETE FROM t WHERE k = 3")
+        m.pump()
+        assert m.pending()
+        faults.arm(1234, {site: FaultSpec(kind="error", p=1.0, max_fires=1)})
+        with pytest.raises(InjectedFault):
+            m.flush()
+        # all-or-nothing: no partial commit
+        assert view.frontier == f0
+        assert m.frontier == f0
+        assert m.pending()  # events stay buffered until the ack
+        # retry (the fault's max_fires is exhausted): exactly-once apply
+        assert m.flush()
+        assert view.frontier > f0
+        reg.materialize(view)
+        _assert_same(_oracle(s),
+                     s.execute("SELECT * FROM mv ORDER BY flag"),
+                     ctx=site)
+    finally:
+        matview.close_all(s.catalog)
+
+
+def test_fault_storm_converges():
+    """Faults across several flush attempts interleaved with more DML:
+    whatever subset of flushes dies, the survivors plus the final clean
+    flush must converge to the rescan oracle (no lost or doubled
+    delta across the whole history)."""
+    s = _setup()
+    try:
+        reg = matview.registry_for(s.catalog)
+        view = reg.views["mv"]
+        m = reg.maintainers["t"]
+        faults.arm(99, {
+            "matview.delta.apply": FaultSpec(kind="error", p=0.5,
+                                             max_fires=3),
+            "matview.frontier.checkpoint": FaultSpec(kind="error", p=0.3,
+                                                     max_fires=2),
+        })
+        for i in range(8):
+            s.execute(f"INSERT INTO t VALUES ({200 + i}, '{'AB'[i % 2]}', "
+                      f"{i}.50, {i}.00, DATE '1998-03-0{1 + i}')")
+            if i % 2 == 1:
+                s.execute(f"DELETE FROM t WHERE k = {i}")
+            m.pump()
+            try:
+                m.flush()
+            except InjectedFault:
+                pass
+        faults.disarm()
+        m.pump()
+        m.flush()
+        reg.materialize(view)
+        _assert_same(_oracle(s),
+                     s.execute("SELECT * FROM mv ORDER BY flag"),
+                     ctx="storm")
+    finally:
+        matview.close_all(s.catalog)
