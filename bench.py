@@ -292,6 +292,12 @@ def _emit(final: bool) -> None:
         "unit": "rows/sec",
         "vs_baseline": round(geomean_ratio, 3),
         "vs_colexec_est": round(geomean_ratio / 8.0, 4),
+        # host class stamps the run so regression checks compare like
+        # with like: a cpu-fallback run regressing against a TPU
+        # baseline (or an 8-vCPU box against a 96-vCPU one) is noise,
+        # not a regression
+        "host_class": (f"{sys.platform}-{os.cpu_count()}cpu-"
+                       f"{_partial['platform']}"),
         "detail": detail,
     }
     # cold/warm split (compile wall vs steady serving): cold is the sum of
@@ -327,10 +333,27 @@ def _worker(job: str) -> None:
         force_cpu_backend()
     import jax  # noqa: F401  (backend chosen by env set in parent)
 
-    from cockroach_tpu.utils.backend import enable_compile_cache
+    if not job.startswith("warmup_"):
+        # the warmup A/B measures the COLD wall: the persistent XLA cache
+        # would let the off phase ride compiles minted by earlier jobs
+        # (or the on phase ride the off phase's), hollowing out both sides
+        from cockroach_tpu.utils.backend import enable_compile_cache
 
-    enable_compile_cache()
+        enable_compile_cache()
     platform = jax.devices()[0].platform
+    if job.startswith("warmup_"):
+        # cold-start kill A/B: each phase is its own worker process, so
+        # the process-global kernel cache starts empty both times
+        from cockroach_tpu.bench.warmup import run_warmup_cold
+
+        w = run_warmup_cold(
+            menu=job.endswith("_on"),
+            sf=float(os.environ.get("BENCH_WARMUP_SF", "0.05")),
+        )
+        print("RESULT " + json.dumps({
+            "job": job, "platform": platform, **w,
+        }), flush=True)
+        return
     if job == "ycsb":
         from cockroach_tpu.bench.ycsb import run_ycsb_e
 
@@ -383,12 +406,12 @@ def _worker(job: str) -> None:
         # mixed-workload serving load (ROADMAP 3(c)): N concurrent sessions
         # x (YCSB point ops + TPC-H analytics) through the full SQL front
         # door, measuring throughput, admission queue-wait, and peak HBM
-        from cockroach_tpu.bench.load import run_mixed_load
-
-        from cockroach_tpu.bench.load import run_tenant_overload
+        from cockroach_tpu.bench.load import (run_coalesce_ab,
+                                              run_mixed_load,
+                                              run_tenant_overload)
 
         r = run_mixed_load(
-            sessions=int(os.environ.get("BENCH_LOAD_SESSIONS", "4")),
+            sessions=int(os.environ.get("BENCH_LOAD_SESSIONS", "8")),
             duration_s=float(os.environ.get("BENCH_LOAD_S", "10")),
             sf=float(os.environ.get("BENCH_LOAD_SF", "0.01")),
         )
@@ -397,6 +420,14 @@ def _worker(job: str) -> None:
         # refusal typed (53300), per-tenant p99 isolation must hold
         ovl = run_tenant_overload(
             duration_s=float(os.environ.get("BENCH_OVERLOAD_S", "8")),
+        )
+        # cross-session coalescing A/B (same worker: it is the other half
+        # of the serving-path story): off vs on over a fsync WAL store,
+        # interleaved rounds, plus the coalesced-vs-solo bit-identity
+        # oracle check_bench_regress.py enforces
+        ab = run_coalesce_ab(
+            sessions=int(os.environ.get("BENCH_COALESCE_SESSIONS", "16")),
+            duration_s=float(os.environ.get("BENCH_COALESCE_S", "2.0")),
         )
         print("RESULT " + json.dumps({
             "job": job, "platform": platform,
@@ -417,6 +448,7 @@ def _worker(job: str) -> None:
             "shed": r["shed"],
             **{f"overload_{k}": v for k, v in ovl.items()
                if k not in ("last_error", "rejections_by_reason")},
+            **ab,
         }), flush=True)
         return
     from cockroach_tpu.bench import tpch
@@ -536,11 +568,15 @@ def main(only_job: str | None = None) -> None:
         jobs.append("fanout")
     if os.environ.get("BENCH_VIEWS", "1") != "0":
         jobs.append("views")
+    if os.environ.get("BENCH_WARMUP", "1") != "0":
+        # two phases, two processes: each side's kernel cache starts cold
+        jobs.extend(["warmup_off", "warmup_on"])
     if only_job is not None:
         # --job <name>: run exactly that ladder item (e.g. `bench.py --job
         # load` for the mixed-workload serving run) with the same worker
         # isolation + RESULT protocol as the full ladder
-        jobs = [only_job]
+        jobs = (["warmup_off", "warmup_on"] if only_job == "warmup"
+                else [only_job])
 
     def record(res) -> None:
         _partial["platform"] = res.pop("platform", platform)
@@ -549,6 +585,21 @@ def main(only_job: str | None = None) -> None:
             _partial["detail"]["ycsb_e_1m"] = res
         elif job_name == "load":
             _partial["detail"]["mixed_load"] = res
+        elif job_name.startswith("warmup_"):
+            # pair the two phases into one A/B block once both land
+            w = _partial["detail"].setdefault("warmup", {})
+            w[job_name[len("warmup_"):]] = res
+            if "off" in w and "on" in w:
+                off_c = w["off"].get("cold_s", 0.0)
+                on_c = w["on"].get("cold_s", 0.0)
+                w["cold_menu_speedup"] = (round(off_c / on_c, 2)
+                                          if on_c > 0 else 0.0)
+                w["serving_compiles_on"] = w["on"].get(
+                    "serving_compiles", -1)
+                # bit-identity: a menu-warmed kernel must return exactly
+                # what a cold-compiled one returns
+                w["menu_oracle_ok"] = (
+                    w["off"].get("checksums") == w["on"].get("checksums"))
         else:
             _partial["detail"][job_name] = res
 

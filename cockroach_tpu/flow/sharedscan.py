@@ -1,0 +1,193 @@
+"""Shared tile streams — concurrent scans of one table ride one cursor.
+
+Reference intent: when eight sessions run reporting queries over the
+same resident table, each session's ScanOp slices the SAME device
+buffer into the SAME tiles — eight identical ``slice_tile`` dispatch
+streams where one would do. This module lets concurrent resident scans
+attach to a per-(table, columns, tile) shared stream: whichever
+subscriber needs a tile first produces it (one dispatch), every other
+subscriber consumes the buffered result for free
+(``sql_shared_scan_dispatches_saved``).
+
+Design — produce-on-demand, never block. A subscriber asking for tile
+``i`` either (a) finds it in the stream's bounded buffer window
+(``sql.distsql.sharedscan.window`` tiles) and takes it, (b) finds the
+window already trimmed past ``i`` — it fell behind — and slices that
+tile solo (catch-up; the stream never waits for laggards and never
+holds tiles for them), or (c) produces it into the window for everyone
+behind it. No subscriber ever parks on another's progress, so the
+stream cannot deadlock and a slow consumer degrades only itself.
+
+Safety is identity, not equality: attach joins an existing stream ONLY
+when the subscriber's batch is the same device arrays (column data,
+valid bitmaps, and liveness mask all ``is``-identical) as the stream's
+— anything else (sharded scans, a table re-devived mid-stream) runs
+solo. Tiles are immutable jax arrays, so sharing is free of aliasing
+hazards. Bit-identity with the solo path follows: the shared tile IS
+the output of the same jitted ``slice_tile`` kernel on the same
+operands a solo scan would dispatch.
+
+Chaos site ``flow.sharedscan.attach``: an injected fault at attach
+degrades that scan to slicing its own tiles — identical results, the
+dispatch saving lost. Buffered tiles are charged to the
+``flow.sharedscan`` staging account; each subscriber carries its
+attach-time mask bytes until detach.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..utils import faults, locks, metric, racesan, settings
+from . import memory as flowmem
+
+__all__ = ["attach", "detach", "reset", "SharedStream"]
+
+
+def _same_batch(a, b) -> bool:
+    """True when two Batch views are the SAME device arrays (catalog
+    device-cache hits), so slicing either yields bit-identical tiles."""
+    if a is b:
+        return True
+    if a.capacity != b.capacity or len(a.cols) != len(b.cols):
+        return False
+    if a.mask is not b.mask:
+        return False
+    return all(ca.data is cb.data and ca.valid is cb.valid
+               for ca, cb in zip(a.cols, b.cols))
+
+
+class SharedStream:
+    """One shared cursor over one resident table's tile sequence."""
+
+    def __init__(self, key, batch, res_tile: int, slice_fn, snap=None):
+        self.key = key
+        self.batch = batch
+        # snapshot token of the decode that produced `batch` (KV-backed
+        # tables re-decode per scan; an equal token means a later
+        # decode is bit-identical, so the subscriber may adopt ours)
+        self.snap = snap
+        self.res_tile = int(res_tile)
+        self.n_tiles = batch.capacity // self.res_tile
+        self.slice_fn = slice_fn
+        self.mu = locks.lock("flow.sharedscan")
+        # bounded tile window: idx -> (tile, producer). Trimmed from the
+        # bottom; an idx below `base` is gone for good (solo catch-up).
+        self._tiles: dict[int, tuple] = {}
+        self.base = 0
+        # attached subscribers (ScanOp identity -> bytes charged at
+        # attach). racesan-annotated: attach/detach from different
+        # sessions meet here.
+        self._subs: dict[int, int] = {}
+        self._staging = flowmem.staging_monitor("flow.sharedscan")
+
+    # caller holds _reg_mu for attach/detach bookkeeping --------------------
+
+    def _attach(self, op) -> None:
+        # a subscriber's standing cost is its view of the liveness mask
+        # (1 byte/row under XLA's dense bool layout)
+        n = int(self.batch.capacity)
+        self._staging.reserve(n, force=True)
+        with self.mu:
+            racesan.note_write(self, "_subs")
+            self._subs[id(op)] = n
+
+    def _detach(self, op) -> bool:
+        """Drop one subscriber; True when the stream is now empty."""
+        with self.mu:
+            racesan.note_write(self, "_subs")
+            n = self._subs.pop(id(op), 0)
+        if n:
+            self._staging.release(n)
+        with self.mu:
+            racesan.note_read(self, "_subs")
+            return not self._subs
+
+    def _close(self) -> None:
+        with self.mu:
+            dropped = [t for t, _ in self._tiles.values()]
+            self._tiles.clear()
+        for t in dropped:
+            self._staging.release(flowmem.batch_bytes(t))
+
+    def next_tile(self, op, idx: int):
+        """('tile', batch) — shared tile for idx; ('solo', None) — the
+        window moved past idx, the caller slices its own catch-up tile."""
+        window = settings.get("sql.distsql.sharedscan.window")
+        with self.mu:
+            if idx < self.base:
+                return "solo", None
+            ent = self._tiles.get(idx)
+            if ent is None:
+                t = self.slice_fn(self.batch, jnp.int32(idx * self.res_tile))
+                self._tiles[idx] = ent = (t, id(op))
+                self._staging.reserve(flowmem.batch_bytes(t), force=True)
+                while len(self._tiles) > window:
+                    m = min(self._tiles)
+                    old, _ = self._tiles.pop(m)
+                    self.base = max(self.base, m + 1)
+                    self._staging.release(flowmem.batch_bytes(old))
+            t, producer = ent
+            if producer != id(op):
+                # this dispatch was someone else's; we ride for free
+                metric.SQL_SHARED_SCAN_DISPATCHES_SAVED.inc()
+            return "tile", t
+
+
+# stream registry: (table id, columns, tile) -> live SharedStream.
+# Guarded by one control-plane lock; streams die with their last
+# subscriber, so the registry only ever holds streams someone is reading.
+_reg_mu = locks.lock("flow.sharedscan.registry")
+_streams: dict[tuple, SharedStream] = {}
+
+
+def reset() -> None:
+    """Drop all streams (test isolation)."""
+    with _reg_mu:
+        for s in _streams.values():
+            s._close()
+        _streams.clear()
+
+
+def attach(op) -> SharedStream | None:
+    """Attach a resident tiled ScanOp to the shared stream for its
+    (table, columns, tile) — or None for solo: sharding, a batch that
+    is not the device-cache arrays, or an injected attach fault."""
+    if not settings.get("sql.distsql.sharedscan.enabled"):
+        return None
+    if op.shard is not None or op.streaming:
+        return None
+    try:
+        # chaos site: attach failure degrades to slicing our own tiles
+        faults.fire("flow.sharedscan.attach")
+    except faults.InjectedFault:
+        return None
+    key = (id(op.table), tuple(op.output_schema.names), op._res_tile)
+    with _reg_mu:
+        s = _streams.get(key)
+        if s is not None:
+            if not _same_batch(s.batch, op._batch):
+                # KV-backed scans decode a fresh batch per init; equal
+                # snapshot tokens prove the decodes are bit-identical,
+                # so adopt the stream's arrays and share its tiles
+                if (s.snap is None or getattr(op, "_snap", None) != s.snap
+                        or s.batch.capacity != op._batch.capacity):
+                    return None  # different snapshot: run solo
+                op._batch = s.batch
+            s._attach(op)
+            metric.SQL_SHARED_SCAN_ATTACHED.inc()
+            return s
+        s = SharedStream(key, op._batch, op._res_tile, op._slice,
+                         snap=getattr(op, "_snap", None))
+        s._attach(op)
+        _streams[key] = s
+        return s
+
+
+def detach(op, stream: SharedStream | None) -> None:
+    if stream is None:
+        return
+    with _reg_mu:
+        if stream._detach(op) and _streams.get(stream.key) is stream:
+            del _streams[stream.key]
+            stream._close()

@@ -444,6 +444,12 @@ def run_cached_ex(rel, text: str | None = None):
             entry.store.set_values(values)
             with tracing.leaf_span("query", cache="hit"):
                 res = runtime.run_operator(entry.root)
+        if entry.fingerprint:
+            # warm-menu hit accounting: a serving-path hit on a statement
+            # the AOT menu compiled means the cold wall was paid at start
+            from . import warmmenu
+
+            warmmenu.note_serving_hit(entry.fingerprint)
     if text is not None:
         if entry.fingerprint:
             cache.note_text(entry.fingerprint, text)
@@ -485,6 +491,13 @@ def run_memoized_ex(catalog, text: str):
     entry = cache.lookup(key)
     if entry is None:
         return None
+    if entry.fingerprint:
+        # the memo path is still a plan-cache hit — warm-menu accounting
+        # must see it, or menu-compiled statements that repeat verbatim
+        # (the common serving shape) would never count as menu hits
+        from . import warmmenu
+
+        warmmenu.note_serving_hit(entry.fingerprint)
     with entry.lock:
         entry.store.set_values(values)
         with tracing.leaf_span("query", cache="memo"):
@@ -548,7 +561,15 @@ def start_warmup(session, statements=None) -> threading.Thread | None:
     Replaying the hottest recorded statement texts warms every level at
     once: the plan cache entry, each kernel at its current canonical
     tile shape (catalog.SHAPE_BUCKETS keeps that menu small), and — when
-    enabled — the on-disk XLA cache."""
+    enabled — the on-disk XLA cache.
+
+    Lifecycle: the thread checks a stop event between statements and the
+    owning session joins it in ``close()`` (via :func:`stop_warmup`), so
+    a warmup racing server shutdown stops at the next statement boundary
+    instead of executing against a torn-down store — the no-leak census
+    asserts no ``plan-warmup`` thread survives teardown. Re-invalidation
+    (back-to-back DDL) stops the previous warmup before starting the
+    next, so at most one warmup thread exists per session."""
     if not settings.get("sql.plan_cache.warmup.enabled"):
         return None
     texts = (list(statements) if statements is not None
@@ -557,22 +578,51 @@ def start_warmup(session, statements=None) -> threading.Thread | None:
         return None
     from .session import Session
 
+    # one warmup per session: a DDL burst must not stack threads
+    stop_warmup(session)
     # a PRIVATE session over the shared catalog/store: the warmup thread
     # must never touch the serving session's transaction state
     bg = Session(catalog=session.catalog, db=session.db, bootstrap=False)
+    stop = threading.Event()
 
     def _run():
-        for t in texts:
-            try:
-                # twice: the first execution compiles; the second settles
-                # adaptive capacities (join emission caps learn from run 1
-                # and re-specialize once), so the SERVING repeat is pure
-                # dispatch — scripts/check_recompiles.py holds it to zero
-                bg.execute(t)
-                bg.execute(t)
-            except Exception:  # noqa: BLE001 — warmup is best-effort
-                continue
+        try:
+            for t in texts:
+                if stop.is_set():
+                    return
+                try:
+                    # twice: the first execution compiles; the second
+                    # settles adaptive capacities (join emission caps learn
+                    # from run 1 and re-specialize once), so the SERVING
+                    # repeat is pure dispatch — scripts/check_recompiles.py
+                    # holds it to zero
+                    bg.execute(t)
+                    if stop.is_set():
+                        return
+                    bg.execute(t)
+                except Exception:  # noqa: BLE001 — warmup is best-effort
+                    continue
+        finally:
+            bg.close()
 
     th = threading.Thread(target=_run, name="plan-warmup", daemon=True)
+    session._warmup_stop = stop
+    session._warmup_thread = th
     th.start()
     return th
+
+
+def stop_warmup(session, timeout: float = 5.0) -> None:
+    """Signal and join the session's warmup thread (idempotent; no-op
+    when none is running). Called from Session.close() and before a new
+    warmup replaces a running one."""
+    th = getattr(session, "_warmup_thread", None)
+    if th is None:
+        return
+    stop = getattr(session, "_warmup_stop", None)
+    if stop is not None:
+        stop.set()
+    if th is not threading.current_thread():
+        th.join(timeout=timeout)
+    session._warmup_thread = None
+    session._warmup_stop = None

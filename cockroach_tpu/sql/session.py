@@ -155,9 +155,13 @@ class Session:
 
     def close(self) -> None:
         """Drop this session from the live registry (idempotent; a session
-        that is never closed falls off the registry's bounded end)."""
-        from . import activity
+        that is never closed falls off the registry's bounded end). Joins
+        the background plan-warmup thread first: a warmup racing teardown
+        must stop at its next statement boundary, not execute against a
+        closed store."""
+        from . import activity, plancache
 
+        plancache.stop_warmup(self)
         activity.deregister_session(self._session_id)
         self._mem_mon.close()
 

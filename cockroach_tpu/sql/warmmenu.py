@@ -1,0 +1,300 @@
+"""Ahead-of-time kernel menu — kill the cold wall before readiness.
+
+Reference: a fresh CockroachDB node serves its first query at full speed
+because the execution engine is interpreted; a TPU-native engine instead
+pays 3-10s of XLA compilation per query SHAPE the first time it is seen.
+PR 6's cache hierarchy made repeats free (process-global kernel cache,
+plan cache, on-disk XLA cache); this module moves the remaining
+first-ever cost off the serving path entirely: at server start, BEFORE
+the node advertises readiness (server/node.py calls :func:`warm_node`
+ahead of its "node started" line), a bounded background pool compiles an
+ahead-of-time *menu* of kernels into the same process-global
+``flow/dispatch.jit`` cache the serving path reads.
+
+The menu has three courses, warmed in value order:
+
+1. **explicit** — statements handed in by the operator/test harness;
+2. **hot** — sqlstats-ranked statement texts from the plan cache's
+   fingerprint->text store (``PlanCache.hot_texts``): what THIS node's
+   workload actually runs, learned across restarts via sqlstats;
+3. **ladder** — synthesized per-table statements covering the canonical
+   shape ladder (``catalog.SHAPE_BUCKETS``) times the fused-pipeline
+   operator templates from ``flow/fuse.py`` (filter/project chain,
+   scalar aggregate, grouped aggregate, top-k): because every table pads
+   to a ladder rung and kernels key on (template, rung), warming one
+   table per shape warms every future query of that shape.
+
+Each item executes twice on a private background session — the first
+run compiles, the second settles adaptive capacities — exactly the
+discipline scripts/check_recompiles.py holds the serving path to, so a
+post-menu first execution of a menu-shaped query compiles 0 new kernels.
+
+Bounded: ``sql.warmup.menu.budget_s`` caps wall time and
+``sql.warmup.menu.max_kernels`` caps minted compilations; items past
+either bound are recorded as ``skipped``. Best-effort: a failed item
+(chaos site ``sql.warmup.compile``) is recorded as ``failed`` and the
+kernel compiles on first use instead — the menu never blocks readiness
+beyond its budget and never fails startup.
+
+Accounting surfaces: ``sql_warmup_kernels_compiled`` /
+``sql_warmup_menu_hits`` metrics and the
+``crdb_internal.node_warmup_menu`` vtable (one row per menu item with
+status, kernels, seconds, and serving-path hits).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from ..coldata.types import Family
+from ..utils import faults, locks, log, metric, settings
+
+__all__ = ["build_menu", "warm_node", "menu_rows", "note_serving_hit",
+           "reset", "MenuRun"]
+
+# bounded background pool: enough to overlap XLA compiles, small enough
+# that startup never starves the machine the node is about to serve on
+_POOL_SIZE = 2
+
+# fused-pipeline operator templates (flow/fuse.py _CHAIN/_CONSUMERS
+# shapes): scan->filter->project, scalar-aggregate spool, grouped
+# aggregate, and the top-k consumer — the chains every ladder-shaped
+# query decomposes into. {t}/{c} bind per table below.
+_TEMPLATES = (
+    ("filter", "select {c} from {t} where {c} >= 0"),
+    ("scalar_agg", "select sum({c}) from {t}"),
+    ("group_agg", "select {c}, sum({c}) from {t} group by {c}"),
+    ("topk", "select {c} from {t} order by {c} limit 16"),
+)
+
+# menu registry (vtable + hit accounting): fingerprint -> row dict.
+# Guarded by a named control-plane lock; the serving path touches it
+# once per plan-cache hit (note_serving_hit).
+_mu = locks.lock("sql.warmmenu")
+_MENU: dict[str, dict] = {}
+
+
+@dataclass
+class _Item:
+    text: str
+    source: str  # 'explicit' | 'hot' | 'ladder'
+
+
+class MenuRun:
+    """Handle on one menu build: join it, or stop it early (node
+    shutdown racing a budget-bound warmup)."""
+
+    def __init__(self):
+        self.stop = threading.Event()
+        self.threads: list[threading.Thread] = []
+
+    def join(self, timeout: float | None = None) -> None:
+        for t in self.threads:
+            if t is not threading.current_thread():
+                t.join(timeout)
+
+    def stop_join(self, timeout: float = 5.0) -> None:
+        self.stop.set()
+        self.join(timeout)
+
+
+def reset() -> None:
+    """Drop menu state (test isolation)."""
+    with _mu:
+        _MENU.clear()
+
+
+def menu_rows() -> list[dict]:
+    """Snapshot of the menu registry for crdb_internal.node_warmup_menu
+    (insertion order = warm order)."""
+    with _mu:
+        return [dict(r) for r in _MENU.values()]
+
+
+def warmed_fingerprints() -> set[str]:
+    with _mu:
+        return {fp for fp, r in _MENU.items() if r["status"] == "compiled"}
+
+
+def note_serving_hit(fingerprint: str) -> None:
+    """Called by the plan cache on a serving-path hit: if the menu
+    compiled this fingerprint, the cold wall was paid at startup — count
+    it. Warmup threads' own executions never count."""
+    if threading.current_thread().name.startswith(
+            ("warm-menu", "plan-warmup")):
+        return
+    with _mu:
+        row = _MENU.get(fingerprint)
+        if row is None or row["status"] != "compiled":
+            return
+        row["hits"] += 1
+    metric.SQL_WARMUP_MENU_HITS.inc()
+
+
+def _record(item: _Item, status: str, kernels: int, seconds: float) -> None:
+    from . import sqlstats
+
+    fp = sqlstats.fingerprint(item.text)
+    with _mu:
+        row = _MENU.get(fp)
+        if row is None:
+            _MENU[fp] = {
+                "fingerprint": fp, "source": item.source, "status": status,
+                "kernels": int(kernels), "seconds": float(seconds),
+                "hits": 0,
+            }
+        elif status == "compiled" and row["status"] != "compiled":
+            # a retry/duplicate that compiled upgrades the row
+            row.update(status=status, kernels=int(kernels),
+                       seconds=float(seconds))
+
+
+def _ladder_statements(catalog) -> list[str]:
+    """One table per ladder rung x every operator template. Kernels key
+    on (template, rung), so warming the first table padded to a rung
+    warms every same-rung table; skipping the rest keeps the menu
+    O(|SHAPE_BUCKETS| x |templates|) no matter how wide the catalog is."""
+    from ..catalog import _bucket_cap
+
+    out: list[str] = []
+    rung_done: set[int] = set()
+    for name in sorted(catalog.tables):
+        if name.startswith("__") or name.startswith("crdb_internal."):
+            continue
+        t = catalog.tables[name]
+        try:
+            rows = t.num_rows
+        except (StopIteration, KeyError, ValueError):
+            continue  # descriptor-only / torn table: nothing to warm
+        rung = _bucket_cap(rows)
+        if rung in rung_done:
+            continue
+        ints = [c for c, ty in zip(t.schema.names, t.schema.types)
+                if ty.family is Family.INT]
+        if not ints:
+            continue
+        rung_done.add(rung)
+        c = ints[0]
+        for _, tmpl in _TEMPLATES:
+            out.append(tmpl.format(t=name, c=c))
+    return out
+
+
+def build_menu(catalog, db, statements=None, block: bool = True
+               ) -> MenuRun | None:
+    """Compile the AOT kernel menu for ``catalog``/``db`` on a bounded
+    background pool. Returns the :class:`MenuRun` handle (already joined
+    when ``block``, the server-start mode) or None when disabled or the
+    menu is empty. Never raises: warmup is best-effort by contract."""
+    if not settings.get("sql.warmup.menu.enabled"):
+        return None
+    from . import plancache
+    from .session import Session
+
+    items: list[_Item] = []
+    seen: set[str] = set()
+
+    def add(text: str, source: str) -> None:
+        if text and text not in seen:
+            seen.add(text)
+            items.append(_Item(text, source))
+
+    for t in (statements or ()):
+        add(t, "explicit")
+    for t in plancache.cache_for(catalog).hot_texts():
+        add(t, "hot")
+    for t in _ladder_statements(catalog):
+        add(t, "ladder")
+    if not items:
+        return None
+
+    budget_s = settings.get("sql.warmup.menu.budget_s")
+    max_kernels = settings.get("sql.warmup.menu.max_kernels")
+    deadline = (time.monotonic() + budget_s) if budget_s > 0 else None
+    run = MenuRun()
+    pending = list(items)
+    plock = locks.lock("sql.warmmenu.pending")
+    from ..flow import dispatch
+
+    k0 = dispatch.compiles()
+    t_start = time.monotonic()
+
+    def _worker(sess) -> None:
+        try:
+            while not run.stop.is_set():
+                with plock:
+                    if not pending:
+                        return
+                    item = pending.pop(0)
+                over_budget = (
+                    (deadline is not None and time.monotonic() >= deadline)
+                    or dispatch.compiles() - k0 >= max_kernels)
+                if over_budget:
+                    _record(item, "skipped", 0, 0.0)
+                    continue
+                c0 = dispatch.compiles()
+                t0 = time.perf_counter()
+                try:
+                    # chaos site: an AOT compile failing at startup must
+                    # degrade to compile-on-first-use, never block
+                    # readiness (see utils/faults.py SITES)
+                    faults.fire("sql.warmup.compile")
+                    # twice, like plancache.start_warmup: run 1 compiles,
+                    # run 2 settles adaptive capacities so the serving
+                    # repeat is pure dispatch
+                    sess.execute(item.text)
+                    if run.stop.is_set():
+                        _record(item, "skipped", dispatch.compiles() - c0,
+                                time.perf_counter() - t0)
+                        return
+                    sess.execute(item.text)
+                except Exception:  # noqa: BLE001  # crlint: allow-broad-except(warmup is best-effort: a failed menu item is recorded and served cold on first use)
+                    _record(item, "failed", dispatch.compiles() - c0,
+                            time.perf_counter() - t0)
+                    continue
+                kn = dispatch.compiles() - c0
+                if kn > 0:
+                    metric.SQL_WARMUP_KERNELS_COMPILED.inc(kn)
+                _record(item, "compiled", kn, time.perf_counter() - t0)
+        finally:
+            sess.close()
+
+    n = min(_POOL_SIZE, len(items))
+    for i in range(n):
+        # PRIVATE per-worker sessions over the shared catalog/store,
+        # constructed HERE (not in the thread): session bootstrap touches
+        # engine state that only the spawning thread may initialize
+        sess = Session(catalog=catalog, db=db, bootstrap=False)
+        th = threading.Thread(target=_worker, args=(sess,),
+                              name=f"warm-menu-{i}", daemon=True)
+        run.threads.append(th)
+        th.start()
+    if block:
+        # readiness gate: wait out the budget (plus a statement-boundary
+        # grace), then tell stragglers to stop at their next boundary
+        remain = (None if deadline is None
+                  else max(0.0, deadline - time.monotonic()) + 5.0)
+        run.join(remain)
+        run.stop.set()
+        rows = menu_rows()
+        compiled = sum(1 for r in rows if r["status"] == "compiled")
+        log.info(log.SQL_EXEC, "warm menu built",
+                 items=len(rows), compiled=compiled,
+                 kernels=dispatch.compiles() - k0,
+                 seconds=round(time.monotonic() - t_start, 3))
+    return run
+
+
+def warm_node(node) -> MenuRun | None:
+    """Server-start entry (server/node.py): warm the node's SQL catalog
+    over its store before the node advertises readiness. The returned
+    handle is stashed on the node so shutdown can stop a budget-bound
+    straggler at its next statement boundary."""
+    catalog = getattr(node, "_sql_catalog", None)
+    if catalog is None:
+        return None
+    run = build_menu(catalog, node.db, block=True)
+    node._warmmenu_run = run
+    return run

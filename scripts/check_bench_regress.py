@@ -40,18 +40,28 @@ import sys
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def latest_baseline(repo_root: str = _REPO_ROOT) -> tuple[str, dict] | None:
+def latest_baseline(repo_root: str = _REPO_ROOT,
+                    host_class: str | None = None
+                    ) -> tuple[str, dict] | None:
     """Newest BENCH_r*.json's parsed bench dict (path, parsed); None when
-    no baseline has been recorded yet (first run is a free pass)."""
+    no baseline has been recorded yet (first run is a free pass).
+
+    With ``host_class``, only baselines of the SAME host class compare —
+    a laptop run diffed against a TPU-pod baseline would flag every
+    series. Baselines recorded before host_class stamping act as
+    wildcards (they match any fresh host) rather than being skipped,
+    so the gate keeps teeth across the transition."""
     paths = sorted(glob.glob(os.path.join(repo_root, "BENCH_r*.json")))
-    if not paths:
-        return None
-    path = paths[-1]
-    with open(path, encoding="utf-8") as f:
-        doc = json.load(f)
-    # recorded files wrap the bench line under "parsed"; accept a bare
-    # bench dict too so old/raw captures also work as baselines
-    return path, doc.get("parsed", doc)
+    for path in reversed(paths):
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        # recorded files wrap the bench line under "parsed"; accept a bare
+        # bench dict too so old/raw captures also work as baselines
+        parsed = doc.get("parsed", doc)
+        bhost = parsed.get("host_class")
+        if host_class is None or bhost is None or bhost == host_class:
+            return path, parsed
+    return None
 
 
 def flatten_throughput(bench: dict) -> dict[str, float]:
@@ -98,6 +108,8 @@ def compare(fresh: dict, baseline: dict, threshold: float = 0.2
     flags.extend(overload_oracle_flags(fresh))
     flags.extend(fanout_oracle_flags(fresh))
     flags.extend(views_oracle_flags(fresh))
+    flags.extend(coalesce_oracle_flags(fresh))
+    flags.extend(warmup_oracle_flags(fresh))
     return flags
 
 
@@ -166,6 +178,50 @@ def views_oracle_flags(fresh: dict) -> list[str]:
     return flags
 
 
+def coalesce_oracle_flags(fresh: dict) -> list[str]:
+    """The batch-coalescing oracle is pass/fail, not a trend: when the
+    fresh run carries ``mixed_load.coalesce_*`` figures, a false oracle
+    bool flags regardless of any throughput threshold (a coalesced op
+    returning different bytes than its solo execution, or typed per-key
+    errors leaking across sessions in a merged train, are correctness
+    failures)."""
+    ml = (fresh.get("detail") or {}).get("mixed_load")
+    if not isinstance(ml, dict) or "coalesce_oracle_ok" not in ml:
+        return []
+    flags = []
+    if not ml["coalesce_oracle_ok"]:
+        flags.append("coalesce oracle: coalesced execution was not "
+                     "bit-identical to per-session solo batches "
+                     "(detail.mixed_load.coalesce_oracle_ok = false)")
+    if ml.get("coalesce_errors", 0):
+        flags.append(f"coalesce oracle: {ml['coalesce_errors']} op(s) "
+                     "errored during the coalesce A/B "
+                     "(detail.mixed_load.coalesce_errors != 0)")
+    return flags
+
+
+def warmup_oracle_flags(fresh: dict) -> list[str]:
+    """The warm-menu oracle is pass/fail, not a trend: when the fresh run
+    carries ``warmup.*`` figures, a warmed kernel returning different
+    bytes than a cold-compiled one, or the menu failing to pre-mint the
+    ladder (serving-path compiles > 0 with the menu on), flags regardless
+    of any throughput threshold."""
+    wu = (fresh.get("detail") or {}).get("warmup")
+    if not isinstance(wu, dict):
+        return []
+    flags = []
+    if not wu.get("menu_oracle_ok", True):
+        flags.append("warmup oracle: menu-warmed results were not "
+                     "bit-identical to cold-compiled results "
+                     "(detail.warmup.menu_oracle_ok = false)")
+    if wu.get("serving_compiles_on", 0):
+        flags.append(f"warmup oracle: {wu['serving_compiles_on']} "
+                     "serving-path compile(s) with the menu on — the AOT "
+                     "ladder missed shapes it promises to cover "
+                     "(detail.warmup.serving_compiles_on != 0)")
+    return flags
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="flag >threshold throughput regressions vs the newest "
@@ -197,9 +253,11 @@ def main(argv: list[str] | None = None) -> int:
             doc = json.load(f)
         bpath, baseline = args.baseline, doc.get("parsed", doc)
     else:
-        found = latest_baseline()
+        found = latest_baseline(host_class=fresh.get("host_class"))
         if found is None:
-            print("no BENCH_r*.json baseline recorded; nothing to compare")
+            print("no comparable BENCH_r*.json baseline recorded "
+                  f"(host_class {fresh.get('host_class')!r}); nothing to "
+                  "compare")
             return 0
         bpath, baseline = found
 

@@ -1,0 +1,185 @@
+"""Shared tile stream tests (flow/sharedscan.py).
+
+Concurrent resident scans of one table must ride one slice-dispatch
+stream bit-identically: a subscriber attaching mid-stream produces or
+consumes exactly the tiles a solo scan would slice (mask included), a
+detach mid-stream leaves the other subscriber's results untouched, and
+the stream dies with its last subscriber (no registry or staging
+leak)."""
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.catalog import Catalog, Table
+from cockroach_tpu.coldata.types import FLOAT64, INT64, Schema
+from cockroach_tpu.flow import sharedscan
+from cockroach_tpu.flow.operators import ScanOp
+from cockroach_tpu.utils import metric, settings
+
+
+@pytest.fixture(autouse=True)
+def _gate():
+    """Shared streams on for the test body; registry always drained."""
+    settings.set("sql.distsql.sharedscan.enabled", True)
+    yield
+    settings.reset("sql.distsql.sharedscan.enabled")
+    settings.reset("sql.distsql.sharedscan.window")
+    sharedscan.reset()
+
+
+def _cat(n=512, seed=3) -> Catalog:
+    rng = np.random.default_rng(seed)
+    cat = Catalog()
+    cat.add(Table(
+        name="fact",
+        schema=Schema(("f_key", "f_val"), (INT64, FLOAT64)),
+        columns={
+            "f_key": np.arange(n, dtype=np.int64),
+            "f_val": rng.uniform(0.0, 10.0, n),
+        },
+    ))
+    return cat
+
+
+def _rows(tiles) -> list[tuple]:
+    """Live rows of a tile sequence (mask applied) — the bit-identity
+    surface: a wrong shared mask shows up here as phantom/lost rows."""
+    out = []
+    for t in tiles:
+        mask = np.asarray(t.mask)
+        cols = [np.asarray(c.data) for c in t.cols]
+        for i in np.nonzero(mask)[0]:
+            out.append(tuple(c[i] for c in cols))
+    return out
+
+
+def _drain(op) -> list:
+    tiles = []
+    while True:
+        t = op._next()
+        if t is None:
+            return tiles
+        tiles.append(t)
+
+
+def test_two_scans_share_one_stream_bit_identical():
+    cat = _cat()
+    table = cat.get("fact")
+    tile = 128
+
+    # solo oracle: gate off, one scan slices its own tiles
+    settings.set("sql.distsql.sharedscan.enabled", False)
+    solo = ScanOp(table, tile=tile)
+    solo.init()
+    want = _rows(_drain(solo))
+    solo.close()
+    settings.set("sql.distsql.sharedscan.enabled", True)
+
+    a = ScanOp(table, tile=tile)
+    b = ScanOp(table, tile=tile)
+    attached0 = metric.SQL_SHARED_SCAN_ATTACHED.value
+    saved0 = metric.SQL_SHARED_SCAN_DISPATCHES_SAVED.value
+    a.init()
+    b.init()
+    assert a._shared is not None and a._shared is b._shared
+    # the second attach to a live stream counts
+    assert metric.SQL_SHARED_SCAN_ATTACHED.value == attached0 + 1
+
+    # interleave: a produces each tile, b consumes it for free
+    rows_a, rows_b = [], []
+    while True:
+        ta = a._next()
+        tb = b._next()
+        assert (ta is None) == (tb is None)
+        if ta is None:
+            break
+        rows_a.extend(_rows([ta]))
+        rows_b.extend(_rows([tb]))
+    assert rows_a == want
+    assert rows_b == want
+    assert metric.SQL_SHARED_SCAN_DISPATCHES_SAVED.value > saved0
+
+    a.close()
+    b.close()
+    # stream died with its last subscriber
+    assert not sharedscan._streams
+
+
+def test_attach_mid_stream_and_detach_mid_stream():
+    """b attaches after a consumed half the table and a detaches before
+    the end — both must still see every row exactly once."""
+    cat = _cat()
+    table = cat.get("fact")
+    tile = 64
+
+    settings.set("sql.distsql.sharedscan.enabled", False)
+    solo = ScanOp(table, tile=tile)
+    solo.init()
+    want = _rows(_drain(solo))
+    solo.close()
+    settings.set("sql.distsql.sharedscan.enabled", True)
+
+    a = ScanOp(table, tile=tile)
+    a.init()
+    n_tiles = a._batch.capacity // tile
+    tiles_a = [a._next() for _ in range(n_tiles // 2)]
+
+    b = ScanOp(table, tile=tile)
+    b.init()  # mid-stream attach: same stream, own cursor from tile 0
+    assert b._shared is a._shared
+
+    # a finishes and detaches while b is mid-stream
+    tiles_a.extend(_drain(a))
+    a.close()
+    assert sharedscan._streams  # b still holds the stream open
+
+    tiles_b = _drain(b)
+    b.close()
+    assert not sharedscan._streams
+
+    assert _rows(tiles_a) == want
+    # b started from tile 0 after the window may have trimmed early
+    # tiles: those slice solo (catch-up) and must still be identical
+    assert _rows(tiles_b) == want
+
+
+def test_lagging_subscriber_catches_up_solo():
+    """A subscriber further behind than the window slices its own tiles
+    and still sees every row (the stream never waits for laggards)."""
+    cat = _cat(n=512)
+    table = cat.get("fact")
+    tile = 64
+    settings.set("sql.distsql.sharedscan.window", 1)
+
+    settings.set("sql.distsql.sharedscan.enabled", False)
+    solo = ScanOp(table, tile=tile)
+    solo.init()
+    want = _rows(_drain(solo))
+    solo.close()
+    settings.set("sql.distsql.sharedscan.enabled", True)
+
+    a = ScanOp(table, tile=tile)
+    b = ScanOp(table, tile=tile)
+    a.init()
+    b.init()
+    tiles_a = _drain(a)  # sprints ahead; window keeps only the last tile
+    tiles_b = _drain(b)  # every earlier tile is gone: solo catch-up
+    a.close()
+    b.close()
+    assert _rows(tiles_a) == want
+    assert _rows(tiles_b) == want
+
+
+def test_sharding_and_gate_off_run_solo():
+    cat = _cat()
+    table = cat.get("fact")
+    sharded = ScanOp(table, tile=128, shard=(0, 2))
+    sharded.init()
+    assert sharded._shared is None  # sharded scans never share
+    sharded.close()
+
+    settings.set("sql.distsql.sharedscan.enabled", False)
+    plain = ScanOp(table, tile=128)
+    plain.init()
+    assert plain._shared is None
+    plain.close()

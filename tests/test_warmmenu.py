@@ -1,0 +1,157 @@
+"""AOT kernel menu tests (sql/warmmenu.py).
+
+The PR-19 cold-wall acceptance sweep, sized to stay tier-1-fast: over a
+one-rung catalog the menu is 4 ladder statements, so the whole module
+compiles a handful of kernels once. Covers: a post-menu first execution
+of a ladder-shaped query compiles 0 new kernels and counts as a menu
+hit (including on the exact-text memo fast path), results are
+bit-identical to cold-compiled ones, the vtable surfaces the rows, and
+no warmup thread survives the build (the census/leak discipline)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from cockroach_tpu.catalog import Catalog, Table
+from cockroach_tpu.coldata.types import FLOAT64, INT64, Schema
+from cockroach_tpu.flow import dispatch
+from cockroach_tpu.sql import warmmenu
+from cockroach_tpu.sql.session import Session
+from cockroach_tpu.utils import metric, settings
+
+
+def _cat(n=96, seed=11) -> Catalog:
+    rng = np.random.default_rng(seed)
+    cat = Catalog()
+    cat.add(Table(
+        name="menu_t",
+        schema=Schema(("m_key", "m_val"), (INT64, FLOAT64)),
+        columns={
+            "m_key": np.arange(n, dtype=np.int64),
+            "m_val": rng.uniform(0.0, 5.0, n),
+        },
+    ))
+    return cat
+
+
+@pytest.fixture(scope="module")
+def warmed():
+    """One menu build shared by the module (compiles are the cost)."""
+    warmmenu.reset()
+    cat = _cat()
+    boot = Session(catalog=cat)
+    settings.set("sql.warmup.menu.enabled", True)
+    try:
+        run = warmmenu.build_menu(cat, boot.db, block=True)
+        yield cat, boot, run
+    finally:
+        settings.reset("sql.warmup.menu.enabled")
+        boot.close()
+        warmmenu.reset()
+
+
+def _menu_threads() -> list[str]:
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith(("warm-menu", "plan-warmup"))]
+
+
+def test_menu_compiles_ladder_and_joins_threads(warmed):
+    cat, _boot, run = warmed
+    rows = warmmenu.menu_rows()
+    stmts = warmmenu._ladder_statements(cat)
+    assert len(stmts) == 4  # one rung x four operator templates
+    assert len(rows) == len(stmts)
+    assert all(r["status"] == "compiled" for r in rows)
+    assert sum(r["kernels"] for r in rows) > 0
+    # block=True joined the pool: the census must be clean (the
+    # stop-event + join-in-close discipline; a leaked warmup thread
+    # would keep compiling into a node that already started serving)
+    run.join(10)
+    assert _menu_threads() == []
+
+
+def test_post_menu_first_execution_compiles_nothing(warmed):
+    """The acceptance criterion: menu-on first execution of every
+    ladder-shaped statement is pure dispatch — 0 new kernels — and each
+    counts as a serving-path menu hit."""
+    cat, boot, _run = warmed
+    serve = Session(catalog=cat, db=boot.db, bootstrap=False)
+    try:
+        stmts = warmmenu._ladder_statements(cat)
+        hits0 = metric.SQL_WARMUP_MENU_HITS.value
+        c0 = dispatch.compiles()
+        for s in stmts:
+            serve.execute(s)
+        assert dispatch.compiles() - c0 == 0
+        assert metric.SQL_WARMUP_MENU_HITS.value - hits0 == len(stmts)
+        assert sum(r["hits"] for r in warmmenu.menu_rows()) >= len(stmts)
+    finally:
+        serve.close()
+
+
+def test_memo_fast_path_counts_menu_hits(warmed):
+    """Verbatim repeats take plancache's exact-text memo path; that is
+    still a plan-cache hit and must count (the common serving shape —
+    without it a warmed node reports zero menu value)."""
+    cat, boot, _run = warmed
+    serve = Session(catalog=cat, db=boot.db, bootstrap=False)
+    try:
+        stmt = warmmenu._ladder_statements(cat)[0]
+        hits0 = metric.SQL_WARMUP_MENU_HITS.value
+        serve.execute(stmt)
+        serve.execute(stmt)
+        assert metric.SQL_WARMUP_MENU_HITS.value - hits0 == 2
+    finally:
+        serve.close()
+
+
+def test_menu_results_bit_identical_to_cold(warmed):
+    """A warmed kernel must return byte-identical results to a
+    cold-compiled one: rebuild the same catalog data fresh (no menu) and
+    compare every ladder statement's columns."""
+    cat, boot, _run = warmed
+    serve = Session(catalog=cat, db=boot.db, bootstrap=False)
+    cold_cat = _cat()
+    cold = Session(catalog=cold_cat)
+    try:
+        for s in warmmenu._ladder_statements(cat):
+            warm_out = serve.execute(s)
+            cold_out = cold.execute(s)
+            assert set(warm_out) == set(cold_out)
+            for name in warm_out:
+                np.testing.assert_array_equal(
+                    np.asarray(warm_out[name]), np.asarray(cold_out[name]),
+                    err_msg=f"{s}: {name}")
+    finally:
+        cold.close()
+        serve.close()
+
+
+def test_vtable_surfaces_menu_rows(warmed):
+    cat, boot, _run = warmed
+    serve = Session(catalog=cat, db=boot.db, bootstrap=False)
+    try:
+        out = serve.execute(
+            "select fingerprint, status, kernels, hits "
+            "from crdb_internal.node_warmup_menu")
+        statuses = [str(s) for s in np.asarray(out["status"])]
+        assert len(statuses) == 4
+        assert all(s == "compiled" for s in statuses)
+    finally:
+        serve.close()
+
+
+def test_disabled_menu_is_a_noop():
+    cat = _cat(seed=12)
+    boot = Session(catalog=cat)
+    prev = settings.get("sql.warmup.menu.enabled")
+    settings.set("sql.warmup.menu.enabled", False)
+    try:
+        rows0 = warmmenu.menu_rows()
+        assert warmmenu.build_menu(cat, boot.db, block=True) is None
+        assert warmmenu.menu_rows() == rows0
+        assert _menu_threads() == []
+    finally:
+        settings.set("sql.warmup.menu.enabled", prev)
+        boot.close()
