@@ -37,7 +37,7 @@ import pyarrow as pa
 from ..coldata import arrow as arrow_mod
 from ..coldata.batch import Batch, Dictionary
 from ..coldata.types import Schema
-from ..utils import tracing
+from ..utils import settings, tracing
 from .operator import Operator, SourceOperator
 
 _LEN = struct.Struct("<I")
@@ -47,7 +47,7 @@ def _send_msg(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int) -> bytes:  # crlint: allow-untimed-wait(deadline is owner-set: every socket reaching here is already armed — dials pass timeout= to create_connection, which persists as the stream timeout, and FlowServer settimeouts accepted conns before the handshake read)
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
@@ -178,6 +178,11 @@ class FlowServer:
                 conn, _ = self._srv.accept()
             except socket.timeout:
                 continue
+            # one serve thread handles connections serially: a client
+            # that dials and then goes silent before the handshake (or
+            # stops draining mid-stream) must not wedge every other
+            # flow behind it — bound all I/O on this connection
+            conn.settimeout(settings.get("flow.dcn.io_timeout_s"))
             try:
                 # a bad client (empty handshake, unknown flow, mid-stream
                 # reset) must not kill the accept loop — per-connection
@@ -231,7 +236,12 @@ class FlowServer:
 def setup_remote_flow(addr, name: str, schema: Schema) -> FlowInbox:
     """Dial a FlowServer and return the Inbox for the named flow — the
     DistSQLPlanner.setupFlows remote half (distsql_running.go:391)."""
-    sock = socket.create_connection(tuple(addr))
+    # the timeout bounds the TCP connect AND persists as the socket
+    # timeout, so every subsequent FlowInbox stream read inherits the
+    # same deadline — a wedged remote surfaces as socket.timeout
+    # instead of hanging the puller thread forever
+    sock = socket.create_connection(
+        tuple(addr), timeout=settings.get("flow.dcn.io_timeout_s"))
     tctx = tracing.context()
     if tctx is None:
         _send_msg(sock, name.encode("utf-8"))

@@ -148,6 +148,7 @@ def jit(fn=None, key=None, **jit_kwargs):
         # traced call: split wall time into compile (trace happened under
         # this call) vs execute, folded into the enclosing span's tags so
         # EXPLAIN ANALYZE (DEBUG) shows where dispatch time went
+        # crlint: allow-race-coverage(_compiles is a monotonic counter: every write holds _lock; these lockless GIL-atomic snapshot reads only split telemetry into compile-vs-dispatch buckets — taking _lock per dispatch on the serving hot path buys nothing a stale-by-one read can break)
         c0 = _compiles
         t0 = time.perf_counter()
         out = jitted(*args, **kwargs)

@@ -16,7 +16,7 @@ import socket
 import threading
 import time
 
-from ..utils import locks, racesan
+from ..utils import locks, racesan, settings
 from .dcn import _recv_msg, _send_msg
 
 
@@ -152,6 +152,10 @@ class Gossip:
                     # close() raced the accept (fd already closed): the
                     # server is shutting down, not failing
                     return
+                # the single serve thread reads the peer's delta before
+                # answering: a peer that dials and stalls mid-exchange
+                # must time out, not wedge gossip for the whole cluster
+                conn.settimeout(settings.get("flow.dcn.io_timeout_s"))
                 try:
                     # malformed or truncated exchanges must not kill the
                     # server loop — drop the connection and keep accepting
@@ -178,7 +182,12 @@ class Gossip:
         # chaos site: a dropped broadcast round models a partitioned
         # gossip link (node-scoped so tests can isolate one node)
         faults.fire_scoped("gossip.broadcast", self.node_id)
-        sock = socket.create_connection(tuple(addr))
+        # bounds the connect AND persists as the per-read deadline: a
+        # peer that accepts and then goes silent fails this round with
+        # socket.timeout (caught by run_background's retry loop) instead
+        # of freezing the node's only gossip thread forever
+        sock = socket.create_connection(
+            tuple(addr), timeout=settings.get("flow.dcn.io_timeout_s"))
         try:
             _send_msg(sock, json.dumps(self._snapshot()).encode("utf-8"))
             theirs = json.loads(_recv_msg(sock).decode("utf-8"))

@@ -347,12 +347,18 @@ def subscribe_rangefeed(addr, start=None, end=None, since: int = 0,
     import socket
 
     from ..flow.dcn import _recv_msg, _send_msg
-    from ..utils import faults
+    from ..utils import faults, settings
 
     # chaos site: a failed (re)subscription — the rangefeed restart path
     # consumers must retry through (kvclient/rangefeed restart-on-error)
     faults.fire("kv.rangefeed.subscribe")
-    sock = socket.create_connection(tuple(addr))
+    # bounds the connect and persists as the per-frame read deadline. A
+    # healthy feed ticks checkpoints well inside it; a server that goes
+    # silent past the deadline reads as end-of-feed below, and the
+    # consumer re-subscribes from its last checkpoint — the same
+    # reconnect-from-frontier path a slow-consumer eviction takes
+    sock = socket.create_connection(
+        tuple(addr), timeout=settings.get("flow.dcn.io_timeout_s"))
     _send_msg(sock, json.dumps({
         "start": start.decode() if isinstance(start, bytes) else start,
         "end": end.decode() if isinstance(end, bytes) else end,

@@ -43,6 +43,7 @@ class ReplicationStream:
         self.start = start
         self.end = end
         self.dst = dst_db
+        # crlint: allow-race-coverage(frontier is single-writer: only the stream thread RMWs it — see the allow-shared-state note at the apply site; wait_for_frontier/cutover poll a GIL-atomic int snapshot. racesan's Eraser lockset model has no single-writer exemption, so instrumenting this field would raise false DataRaceError under chaos)
         self.frontier = int(since)
         self.applied = 0
         self.reconnects = 0

@@ -20,6 +20,7 @@ import ast
 import json
 import pathlib
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 
@@ -134,6 +135,49 @@ def load_files(paths: list[str | pathlib.Path]) -> list[SourceFile]:
     return sorted(seen.values(), key=lambda f: f.rel)
 
 
+class TreeCache:
+    """Single-parse whole-program cache shared by the tree passes.
+
+    The tree is parsed exactly once per ``run_lint`` (``load_files``);
+    this cache extends that sharing to the DERIVED structures the graph
+    passes each need: per-module symbol indexes (lock tables, function
+    tables — ``lockorder._ModuleIndex``) and the whole-program
+    thread-entry/call-graph analysis (``sharedstate.program``). Before
+    it existed, lock-order and shared-state each rebuilt every module
+    index, and the three graph passes (shared-state, untimed-wait,
+    race-coverage) would each have re-run the ~same multi-second escape
+    analysis — the cache is what keeps the 13-pass suite inside the
+    10-pass wall-time budget.
+
+    Keys are arbitrary hashables; ``memo`` runs ``build`` once and
+    returns the cached value thereafter. A cache instance is only valid
+    for the one file list it was built with (``run_lint`` constructs a
+    fresh one per invocation).
+    """
+
+    def __init__(self, files: list[SourceFile]):
+        self.files = files
+        self.by_rel = {f.rel: f for f in files}
+        self._memo: dict = {}
+
+    def memo(self, key, build):
+        if key not in self._memo:
+            self._memo[key] = build()
+        return self._memo[key]
+
+    def index(self, f: SourceFile):
+        """Memoized ``lockorder._ModuleIndex`` for one file, with
+        ``mod_globals`` populated (the shared-state extension)."""
+        def build():
+            from .lockorder import _ModuleIndex
+            from .sharedstate import _mod_globals
+
+            idx = _ModuleIndex(f)
+            idx.mod_globals = _mod_globals(f, idx)
+            return idx
+        return self.memo(("idx", f.rel), build)
+
+
 def attr_chain(node: ast.AST) -> tuple[str, ...] | None:
     """('jax','jit') for ``jax.jit``; None when the base isn't a Name."""
     parts: list[str] = []
@@ -148,9 +192,9 @@ def attr_chain(node: ast.AST) -> tuple[str, ...] | None:
 
 def _rules():
     # late import: the rule modules import core for helpers
-    from . import (errdiscipline, faultcoverage, hostsync, lockorder,
-                   memaccounting, rawjit, sharedstate, tracingapi,
-                   unusedimport)
+    from . import (blocking, errdiscipline, faultcoverage, hostsync,
+                   lockorder, memaccounting, racecoverage, rawjit,
+                   sharedstate, tracepurity, tracingapi, unusedimport)
     per_file = {
         "host-sync": hostsync.check,
         "raw-jit": rawjit.check,
@@ -158,18 +202,22 @@ def _rules():
         "unused-import": unusedimport.check,
         "tracing-api": tracingapi.check,
         "mem-accounting": memaccounting.check,
+        "recompile-hazard": tracepurity.check,
     }
     tree = {
         "lock-order": lockorder.check,
         "shared-state": sharedstate.check,
         "fault-coverage": faultcoverage.check,
+        "untimed-wait": blocking.check,
+        "race-coverage": racecoverage.check,
     }
     return per_file, tree
 
 
 ALL_RULES = ("host-sync", "raw-jit", "broad-except", "unused-import",
              "lock-order", "tracing-api", "shared-state", "mem-accounting",
-             "fault-coverage", "unknown-pragma")
+             "fault-coverage", "untimed-wait", "recompile-hazard",
+             "race-coverage", "unknown-pragma")
 
 
 def _unknown_pragmas(files: list[SourceFile]) -> list[Finding]:
@@ -193,24 +241,41 @@ def _unknown_pragmas(files: list[SourceFile]) -> list[Finding]:
 
 
 def run_lint(paths: list[str | pathlib.Path],
-             rules: tuple[str, ...] | None = None) -> list[Finding]:
+             rules: tuple[str, ...] | None = None,
+             timings: dict[str, float] | None = None) -> list[Finding]:
     """Run the selected passes; returns unsuppressed findings sorted by
-    location."""
+    location. When ``timings`` is a dict it is filled with per-pass wall
+    seconds (plus the one-time ``load/parse`` cost) so regressions in
+    any single pass are attributable."""
+    t0 = time.perf_counter()
     files = load_files(paths)
+    cache = TreeCache(files)
+    if timings is not None:
+        timings["load/parse"] = time.perf_counter() - t0
     per_file, tree = _rules()
     wanted = set(rules or ALL_RULES)
     findings: list[Finding] = []
-    by_rel = {f.rel: f for f in files}
+    by_rel = cache.by_rel
+
+    def timed(name, run):
+        t = time.perf_counter()
+        out = run()
+        if timings is not None:
+            timings[name] = time.perf_counter() - t
+        return out
+
     for name, check in per_file.items():
         if name not in wanted:
             continue
-        for f in files:
-            findings.extend(check(f))
+        findings.extend(timed(
+            name, lambda c=check: [fd for f in files for fd in c(f)]))
     for name, check in tree.items():
         if name in wanted:
-            findings.extend(check(files))
+            findings.extend(timed(
+                name, lambda c=check: c(files, cache=cache)))
     if "unknown-pragma" in wanted:
-        findings.extend(_unknown_pragmas(files))
+        findings.extend(timed(
+            "unknown-pragma", lambda: _unknown_pragmas(files)))
     live = []
     for fd in findings:
         src = by_rel.get(fd.path)

@@ -169,7 +169,7 @@ def site_matrix(files: list[SourceFile]) -> dict[str, list[str]]:
     return {s: sorted(ts) for s, ts in matrix.items()}
 
 
-def check(files: list[SourceFile]) -> list[Finding]:
+def check(files: list[SourceFile], cache=None) -> list[Finding]:
     sites, entry_lines, faults_rel = _registry(files)
     if sites is None:
         return []  # fixture trees without the registry: nothing to close

@@ -209,11 +209,14 @@ class _FuncWalker(ast.NodeVisitor):
     visit_Lambda = visit_FunctionDef
 
 
-def build_lock_graph(files: list[SourceFile]):
+def build_lock_graph(files: list[SourceFile], cache=None):
     """Returns (lock ids, edges) where edges maps (held, acquired) ->
-    (file rel, line) of the first site implying that ordering."""
+    (file rel, line) of the first site implying that ordering. ``cache``
+    (a ``core.TreeCache``) shares the per-module indexes with the other
+    graph passes instead of rebuilding them."""
     known = {f.rel for f in files}
-    indexes = {f.rel: _ModuleIndex(f) for f in files}
+    indexes = {f.rel: (cache.index(f) if cache is not None
+                       else _ModuleIndex(f)) for f in files}
     funcs: dict[FuncKey, FuncInfo] = {}
     for f in files:
         idx = indexes[f.rel]
@@ -328,8 +331,8 @@ def find_cycles(edges: dict[tuple[str, str], tuple[str, int]]):
     return sccs
 
 
-def check(files: list[SourceFile]) -> list[Finding]:
-    _, edges = build_lock_graph(files)
+def check(files: list[SourceFile], cache=None) -> list[Finding]:
+    _, edges = build_lock_graph(files, cache=cache)
     out: list[Finding] = []
     for scc in find_cycles(edges):
         members = set(scc)

@@ -100,6 +100,9 @@ class _FnRec:
     spawns: list = field(default_factory=list)  # resolved FuncKey targets
     # Thread target was one of our own parameters: (param index, name)
     broker_params: list = field(default_factory=list)
+    # the function's AST (consumed by passes that re-walk reachable
+    # bodies — lint/blocking.py scans these for blocking primitives)
+    node: ast.AST | None = None
 
 
 def _threadsafe_attr(value: ast.AST) -> bool:
@@ -462,14 +465,17 @@ def _attr_types(cls_node_methods: dict[str, ast.FunctionDef],
     return out
 
 
-def _analyze(files: list[SourceFile]):
-    """Whole-program collection: returns (funcs, entries, files_by_rel)."""
+def _analyze(files: list[SourceFile], cache=None):
+    """Whole-program collection: returns (funcs, entries)."""
     known = {f.rel for f in files}
     files_by_rel = {f.rel: f for f in files}
     indexes: dict[str, _ModuleIndex] = {}
     for f in files:
-        idx = _ModuleIndex(f)
-        idx.mod_globals = _mod_globals(f, idx)
+        if cache is not None:
+            idx = cache.index(f)
+        else:
+            idx = _ModuleIndex(f)
+            idx.mod_globals = _mod_globals(f, idx)
         indexes[f.rel] = idx
 
     funcs: dict[FuncKey, _FnRec] = {}
@@ -477,7 +483,7 @@ def _analyze(files: list[SourceFile]):
     def walk_fn(idx: _ModuleIndex, cls: str | None, name: str,
                 node: ast.FunctionDef, imports, class_imports, attr_types,
                 safe):
-        rec = _FnRec((idx.src.rel, cls, name))
+        rec = _FnRec((idx.src.rel, cls, name), node=node)
         params = [a.arg for a in node.args.args
                   if a.arg not in ("self", "cls")]
         nested_defs: list = []
@@ -582,34 +588,82 @@ def _always_held(funcs: dict[FuncKey, _FnRec],
     return ah
 
 
-def analyze_shared_state(files: list[SourceFile]):
+@dataclass
+class Program:
+    """The whole-program thread analysis, computed once per lint run and
+    shared (via ``core.TreeCache``) by every graph pass: shared-state,
+    untimed-wait (lint/blocking.py) and race-coverage
+    (lint/racecoverage.py)."""
+
+    funcs: dict          # FuncKey -> _FnRec
+    entries: set         # thread entry points (spawn targets + brokers)
+    reach: dict          # entry -> transitively reachable FuncKeys
+    ah: dict             # FuncKey -> locks held at every call site
+    main_reach: set      # reachable from uncalled non-entry roots
+    _ent_memo: dict = field(default_factory=dict)
+
+    def entries_of(self, func: FuncKey) -> frozenset:
+        """Entry points (thread roots + ``<main>``) this function runs
+        under."""
+        hit = self._ent_memo.get(func)
+        if hit is None:
+            e = {root for root in self.entries if func in self.reach[root]}
+            if func in self.main_reach:
+                e.add(_MAIN)
+            hit = self._ent_memo[func] = frozenset(e)
+        return hit
+
+    def thread_funcs(self) -> set:
+        """Every function reachable from some thread entry point."""
+        out: set = set()
+        for seen in self.reach.values():
+            out |= seen
+        return out
+
+    def lockset(self, a: Access) -> frozenset:
+        return frozenset(a.lockset) | self.ah.get(a.func, frozenset())
+
+
+def program(files: list[SourceFile], cache=None) -> Program | None:
+    """Whole-program analysis over the ``cockroach_tpu/`` subset of
+    ``files`` (None when it is empty). Memoized on ``cache`` so the
+    three graph passes pay for one analysis, not three."""
+    def build():
+        scoped = [f for f in files if f.rel.startswith("cockroach_tpu/")]
+        if not scoped:
+            return None
+        funcs, entries = _analyze(scoped, cache)
+        reach = _reach(funcs, entries)
+        ah = _always_held(funcs, entries)
+        # main-reachable: functions nobody in-package calls (public API /
+        # test surface) that are not thread targets, plus all they reach
+        called: set[FuncKey] = set()
+        for rec in funcs.values():
+            for callee, _h, _l, _a in rec.calls:
+                called.add(callee)
+        main_roots = {k for k in funcs
+                      if k not in called and k not in entries}
+        main_reach: set[FuncKey] = set()
+        for _root, seen in _reach(funcs, main_roots).items():
+            main_reach |= seen
+        return Program(funcs, entries, reach, ah, main_reach)
+    if cache is not None:
+        return cache.memo("sharedstate.program", build)
+    return build()
+
+
+def analyze_shared_state(files: list[SourceFile], cache=None):
     """Returns (conflicts, entries) where conflicts maps a state id to the
     offending (write_access, other_access, entry_a, entry_b) tuple plus
     all access sites — consumed by check() and by tooling that wants the
     objects the pass names (utils/racesan.py's instrumentation list)."""
-    files = [f for f in files if f.rel.startswith("cockroach_tpu/")]
-    if not files:
+    prog = program(files, cache)
+    if prog is None:
         return {}, set()
-    funcs, entries = _analyze(files)
-    reach = _reach(funcs, entries)
-    ah = _always_held(funcs, entries)
-
-    # main-reachable: functions nobody in-package calls (public API / test
-    # surface) that are not thread targets, plus everything they reach
-    called: set[FuncKey] = set()
-    for rec in funcs.values():
-        for callee, _h, _l, _a in rec.calls:
-            called.add(callee)
-    main_roots = {k for k in funcs if k not in called and k not in entries}
-    main_reach: set[FuncKey] = set()
-    for root, seen in _reach(funcs, main_roots).items():
-        main_reach |= seen
+    funcs, entries, ah = prog.funcs, prog.entries, prog.ah
 
     def entries_of(func: FuncKey) -> frozenset:
-        e = {root for root in entries if func in reach[root]}
-        if func in main_reach:
-            e.add(_MAIN)
-        return frozenset(e)
+        return prog.entries_of(func)
 
     # group accesses by state
     by_state: dict[str, list[Access]] = {}
@@ -673,8 +727,8 @@ def _fmt_entry(e) -> str:
            f"{name}"
 
 
-def check(files: list[SourceFile]) -> list[Finding]:
-    conflicts, _entries = analyze_shared_state(files)
+def check(files: list[SourceFile], cache=None) -> list[Finding]:
+    conflicts, _entries = analyze_shared_state(files, cache)
     by_rel = {f.rel: f for f in files}
     out: list[Finding] = []
     for state, info in sorted(conflicts.items()):

@@ -292,6 +292,14 @@ class AdminServer:
         admin = self
 
         class Handler(BaseHTTPRequestHandler):
+            # StreamRequestHandler.setup() applies this as the socket
+            # timeout for every request read: a client that connects
+            # and never sends a request line (or stalls mid-headers)
+            # releases its handler thread instead of parking it in
+            # recv forever. BaseHTTPRequestHandler maps the timeout to
+            # close_connection, so the slot is reclaimed cleanly.
+            timeout = 30.0
+
             # quiet: requests land in the structured log, not stderr
             def log_message(self, fmt, *args):  # noqa: N802
                 log.debug(log.OPS, "http " + fmt % args)

@@ -39,7 +39,7 @@ _REGISTRY: dict[str, Setting] = {}
 def _register(s: Setting) -> Setting:
     if s.name in _REGISTRY:
         raise ValueError(f"duplicate setting {s.name}")
-    # crlint: allow-shared-state(registration happens at import time, before any worker thread exists; runtime mutation goes through Setting.value)
+    # crlint: allow-shared-state(registration happens at import time, before any worker thread exists; runtime mutation goes through Setting.value) # crlint: allow-race-coverage(dict inserts happen only at import time, before any worker thread exists; runtime SET rebinds Setting.value — a GIL-atomic rebind read via Setting.get — and never touches the dict, so there is no post-startup write for a lock or racesan to witness)
     _REGISTRY[s.name] = s
     return s
 
@@ -396,6 +396,16 @@ DENSE_AGG_ACCEL_STATES = register_int(
     "big-G dense aggregation loses to the sort+segmented-scan path there "
     "while staying the right choice on CPU (cheap serial scatters)",
     lo=64, hi=1 << 28,
+)
+DCN_IO_TIMEOUT = register_float(
+    "flow.dcn.io_timeout_s", 30.0,
+    "deadline on cross-host control-plane socket I/O: flow/gossip/"
+    "rangefeed dials, stream handshakes, and per-read waits on "
+    "established DCN streams. Generous by design — it is a liveness "
+    "backstop against silent peers and half-open TCP, not a latency "
+    "SLO; chaos-injected stalls shorter than this must not become "
+    "typed failures",
+    lo=0.1, hi=600.0,
 )
 COLLECT_STATS = register_bool(
     "sql.stats.collect_execution_stats", False,

@@ -2,7 +2,8 @@
 
 Runs every crlint pass (cockroach_tpu/lint/: host-sync, raw-jit,
 broad-except, unused-import, tracing-api, lock-order, shared-state,
-mem-accounting, fault-coverage, unknown-pragma) over the package, the
+mem-accounting, fault-coverage, untimed-wait, recompile-hazard,
+race-coverage, unknown-pragma) over the package, the
 scripts/ directory, the tests/ tree, and the repo-root entry points
 (bench.py, __graft_entry__.py) and fails on any unsuppressed
 finding. This is the
@@ -30,7 +31,8 @@ import pathlib
 import sys
 
 
-def check(repo_root: str | pathlib.Path | None = None) -> list[str]:
+def check(repo_root: str | pathlib.Path | None = None,
+          timings: dict | None = None) -> list[str]:
     """Returns a list of human-readable violations (empty = clean)."""
     from cockroach_tpu.lint import run_lint
 
@@ -43,13 +45,21 @@ def check(repo_root: str | pathlib.Path | None = None) -> list[str]:
     for entry in ("bench.py", "__graft_entry__.py"):
         if (root / entry).is_file():
             paths.append(root / entry)
-    return [f.render() for f in run_lint(paths)]
+    return [f.render() for f in run_lint(paths, timings=timings)]
 
 
 def main() -> int:
-    problems = check()
+    timings: dict = {}
+    problems = check(timings=timings)
     for p in problems:
         print(f"FAIL: {p}", file=sys.stderr)
+    # per-pass wall time: the budget the shared TreeCache defends —
+    # a regression in any single pass is attributable at a glance
+    width = max((len(k) for k in timings), default=0)
+    for name, secs in sorted(timings.items(), key=lambda kv: -kv[1]):
+        print(f"  {name:<{width}}  {secs:7.3f}s", file=sys.stderr)
+    print(f"  {'total':<{width}}  {sum(timings.values()):7.3f}s",
+          file=sys.stderr)
     if not problems:
         print("crlint clean: all passes over cockroach_tpu/, scripts/, "
               "tests/ and the repo-root entry points")

@@ -377,3 +377,116 @@ def test_join_fragment_wire_roundtrip():
     )
     back = wire.dec_plan(wire.enc_plan(frag))
     assert back == frag
+
+
+# ---------------------------------------------------------------------------
+# deadline discipline: no DCN wait is allowed to block forever
+
+
+@pytest.fixture
+def _tight_io_deadline():
+    """Shrink flow.dcn.io_timeout_s so wedge scenarios fail in test time."""
+    from cockroach_tpu.utils import settings
+
+    prev = settings.get("flow.dcn.io_timeout_s")
+    settings.set("flow.dcn.io_timeout_s", 0.3)
+    yield 0.3
+    settings.set("flow.dcn.io_timeout_s", prev)
+
+
+def test_flow_dial_arms_stream_deadline(remote):
+    """setup_remote_flow's connect timeout persists as the socket timeout,
+    so every subsequent inbox stream read carries the same deadline — the
+    untimed-wait regression (a wedged remote used to hang the puller
+    thread forever)."""
+    from cockroach_tpu.utils import settings
+
+    cat = _half_catalog(1)
+    inbox = dcn.setup_remote_flow(remote, "orders_half",
+                                  cat.get("orders").schema)
+    try:
+        assert inbox.sock.gettimeout() == settings.get(
+            "flow.dcn.io_timeout_s")
+    finally:
+        inbox.sock.close()
+
+
+def test_inbox_read_times_out_on_silent_remote(_tight_io_deadline):
+    """A server that accepts the flow handshake and then goes silent must
+    surface as a timeout on the inbox read, not an eternal hang."""
+    import socket
+    import threading
+    import time
+
+    from cockroach_tpu.coldata.types import INT64, Schema
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    conns = []
+
+    def accept_and_stall():
+        conn, _ = srv.accept()
+        conns.append(conn)  # hold it open, never answer
+
+    t = threading.Thread(target=accept_and_stall, daemon=True)
+    t.start()
+    inbox = dcn.setup_remote_flow(srv.getsockname(), "never",
+                                  Schema(("k",), (INT64,)))
+    t0 = time.monotonic()
+    with pytest.raises(socket.timeout):
+        inbox._next()
+    assert time.monotonic() - t0 < 5.0
+    inbox.sock.close()
+    for c in conns:
+        c.close()
+    srv.close()
+
+
+def test_flow_server_sheds_silent_handshake(_tight_io_deadline):
+    """A client that dials and never sends its handshake must not wedge
+    the single serve thread: after the io deadline the connection is
+    dropped and the next well-formed request still gets its stream."""
+    import socket
+    import time
+
+    cat = _half_catalog(0)
+
+    def make_op():
+        return ScanOp(cat.get("orders"))
+
+    srv = dcn.FlowServer({"orders_half": make_op}).serve_background()
+    try:
+        silent = socket.create_connection(tuple(srv.addr))
+        try:
+            # let the server's handshake deadline fire and shed the
+            # silent conn before dialing for real, so the real stream's
+            # own (equally tight) read deadline starts from a free server
+            time.sleep(_tight_io_deadline * 3)
+            inbox = dcn.setup_remote_flow(srv.addr, "orders_half",
+                                          cat.get("orders").schema)
+            got = run_operator(inbox)
+            assert len(got["o_orderkey"]) == cat.get("orders").num_rows
+        finally:
+            silent.close()
+    finally:
+        srv.close()
+
+
+def test_gossip_exchange_times_out_on_silent_peer(_tight_io_deadline):
+    """The push-pull dial carries the io deadline: a peer that accepts
+    and never answers fails this round with a timeout (run_background's
+    retry loop absorbs it) instead of freezing the gossip thread — the
+    untimed-wait regression at gossip.exchange."""
+    import socket
+    import time
+
+    from cockroach_tpu.flow.gossip import Gossip
+
+    srv = socket.create_server(("127.0.0.1", 0))  # accepts, never reads
+    g = Gossip(node_id=7)
+    g.add_info("node:7:addr", "hostZ:26257")
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        g.exchange(srv.getsockname())
+    assert time.monotonic() - t0 < 5.0
+    g.close()
+    srv.close()

@@ -401,3 +401,40 @@ def test_hub_close_idempotent_and_registry_drops():
     assert hub not in fanout.hubs()
     assert fanout.subscriber_rows() == [] or all(
         r["hub"] != hub.name for r in fanout.subscriber_rows())
+
+
+# -- deadline discipline ------------------------------------------------------
+
+
+def test_subscribe_dial_arms_read_deadline():
+    """subscribe_rangefeed's connect timeout persists as the per-frame
+    read deadline (the untimed-wait regression: a silent server used to
+    park the consumer in recv forever — now it reads as end-of-feed and
+    the consumer re-subscribes from its last checkpoint)."""
+    db = _db()
+    srv = RangefeedServer(db, poll_interval_s=0.02)
+    try:
+        sock, frames = subscribe_rangefeed(srv.addr)
+        assert sock.gettimeout() == settings.get("flow.dcn.io_timeout_s")
+        sock.close()
+    finally:
+        srv.close()
+
+
+def test_silent_subscription_ends_feed_not_hangs():
+    """Against a peer that accepts and never answers, the frame iterator
+    terminates within the io deadline instead of blocking forever."""
+    import socket
+
+    prev = settings.get("flow.dcn.io_timeout_s")
+    settings.set("flow.dcn.io_timeout_s", 0.3)
+    lsn = socket.create_server(("127.0.0.1", 0))  # accepts, never serves
+    try:
+        sock, frames = subscribe_rangefeed(lsn.getsockname())
+        t0 = time.time()
+        assert list(frames) == []  # timeout reads as end-of-feed
+        assert time.time() - t0 < 5.0
+        sock.close()
+    finally:
+        settings.set("flow.dcn.io_timeout_s", prev)
+        lsn.close()

@@ -639,3 +639,301 @@ def test_cli_json_is_stable_and_location_sorted(tmp_path):
     locs = [(r["path"], r["line"]) for r in recs]
     assert locs == sorted(locs)
     assert locs[0][0].endswith("a.py")
+
+
+# ----------------------------------------------------------- untimed-wait
+
+_UNTIMED = (
+    "import queue\n"
+    "import threading\n"
+    "class W:\n"
+    "    def __init__(self):\n"
+    "        self.ev = threading.Event()\n"
+    "        self.q = queue.Queue()\n"
+    "        self._t = threading.Thread(target=self._loop, daemon=True)\n"
+    "        self._t.start()\n"
+    "    def _loop(self):\n"
+    "        self.ev.wait()\n"
+    "        return self.q.get()\n"
+)
+
+
+def test_untimed_wait_flags_thread_reachable_waits(tmp_path):
+    """Live trip: an Event.wait() and a Queue.get() with no timeout on a
+    spawned thread's path are exactly the wedge the pass hunts."""
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": _UNTIMED})
+    found = run_lint([root], rules=("untimed-wait",))
+    assert len(found) == 2, [f.render() for f in found]
+    assert all(f.rule == "untimed-wait" for f in found)
+    msgs = " ".join(f.message for f in found)
+    assert ".wait()" in msgs and ".get()" in msgs
+
+
+def test_untimed_wait_bounded_is_quiet(tmp_path):
+    """The fix the finding demands, verified quiet: explicit timeouts."""
+    src = _UNTIMED.replace("self.ev.wait()", "self.ev.wait(1.0)") \
+                  .replace("self.q.get()", "self.q.get(timeout=1.0)")
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": src})
+    assert not run_lint([root], rules=("untimed-wait",))
+
+
+def test_untimed_wait_unreachable_helper_is_quiet(tmp_path):
+    """The pass walks the thread-entry graph: a wait in a helper no
+    thread entry reaches is not control-plane blocking."""
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": (
+        "import threading\n"
+        "def helper(ev):\n"
+        "    ev.wait()\n")})
+    assert not run_lint([root], rules=("untimed-wait",))
+
+
+def test_untimed_wait_inline_pragma_suppresses(tmp_path):
+    src = _UNTIMED.replace(
+        "        self.ev.wait()\n",
+        "        # crlint: allow-untimed-wait(shutdown path, reaped by "
+        "close)\n"
+        "        self.ev.wait()\n")
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": src})
+    found = run_lint([root], rules=("untimed-wait",))
+    assert len(found) == 1  # the queue.get() is still a finding
+    assert ".get()" in found[0].message
+
+
+def test_untimed_wait_def_line_waiver_covers_body(tmp_path):
+    src = _UNTIMED.replace(
+        "    def _loop(self):\n",
+        "    # crlint: allow-untimed-wait(owner arms deadlines before "
+        "start)\n"
+        "    def _loop(self):\n")
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": src})
+    assert not run_lint([root], rules=("untimed-wait",))
+
+
+def test_untimed_wait_empty_reason_does_not_suppress(tmp_path):
+    src = _UNTIMED.replace(
+        "        self.ev.wait()\n",
+        "        self.ev.wait()  # crlint: allow-untimed-wait()\n")
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": src})
+    assert len(run_lint([root], rules=("untimed-wait",))) == 2
+
+
+# ------------------------------------------------------- recompile-hazard
+
+_SHAPE_HOT_FIXTURE = "cockroach_tpu/flow/operators.py"
+
+
+def test_recompile_hazard_flags_unbucketed_cap(tmp_path):
+    """Live trip: a cap derived straight from len() in a shape-hot
+    module mints one executable per cardinality."""
+    root = _tree(tmp_path, {_SHAPE_HOT_FIXTURE: (
+        "def plan(rows):\n"
+        "    cap = len(rows)\n"
+        "    return cap\n")})
+    found = run_lint([root], rules=("recompile-hazard",))
+    assert len(found) == 1, [f.render() for f in found]
+    assert "canonical-bucketing" in found[0].message
+
+
+def test_recompile_hazard_bucketed_cap_is_quiet(tmp_path):
+    root = _tree(tmp_path, {_SHAPE_HOT_FIXTURE: (
+        "from .fuse import _canonical_cap\n"
+        "def plan(rows):\n"
+        "    cap = _canonical_cap(len(rows))\n"
+        "    return cap\n")})
+    assert not run_lint([root], rules=("recompile-hazard",))
+
+
+def test_recompile_hazard_flags_impure_kernel_key(tmp_path):
+    """f-strings and repr() in a kernel key make two equal kernels key
+    differently — a guaranteed cache miss and retrace."""
+    root = _tree(tmp_path, {"cockroach_tpu/ops/thing.py": (
+        "from ..flow import dispatch\n"
+        "def f(schema, n):\n"
+        "    return dispatch.kernel_key('agg', f'{schema}', repr(n))\n")})
+    found = run_lint([root], rules=("recompile-hazard",))
+    assert len(found) == 2, [f.render() for f in found]
+    msgs = " ".join(f.message for f in found)
+    assert "f-string" in msgs and "repr()" in msgs
+
+
+def test_recompile_hazard_flags_keyless_closure_jit(tmp_path):
+    """dispatch.jit on a fresh closure outside construction re-traces on
+    every call; key= or construction-time hoisting is the fix."""
+    root = _tree(tmp_path, {"cockroach_tpu/ops/thing.py": (
+        "from ..flow import dispatch\n"
+        "def f(x):\n"
+        "    g = dispatch.jit(lambda v: v + 1)\n"
+        "    return g(x)\n")})
+    found = run_lint([root], rules=("recompile-hazard",))
+    assert len(found) == 1
+    assert "fresh wrapper" in found[0].message
+
+
+def test_recompile_hazard_construction_and_keyed_are_quiet(tmp_path):
+    """init() runs once per operator instance (instances are reused
+    across queries), and key= rides the process-global kernel cache —
+    neither is a per-call retrace."""
+    root = _tree(tmp_path, {"cockroach_tpu/ops/thing.py": (
+        "from ..flow import dispatch\n"
+        "class Op:\n"
+        "    def init(self):\n"
+        "        self.g = dispatch.jit(lambda v: v + 1)\n"
+        "def f(x):\n"
+        "    h = dispatch.jit(lambda v: v - 1, key=('dec', 'i64'))\n"
+        "    return h(x)\n")})
+    assert not run_lint([root], rules=("recompile-hazard",))
+
+
+def test_recompile_hazard_def_line_waiver_covers_body(tmp_path):
+    root = _tree(tmp_path, {"cockroach_tpu/ops/thing.py": (
+        "from ..flow import dispatch\n"
+        "# crlint: allow-recompile-hazard(cold path, traced once by "
+        "contract)\n"
+        "def f(x):\n"
+        "    g = dispatch.jit(lambda v: v + 1)\n"
+        "    return g(x)\n")})
+    assert not run_lint([root], rules=("recompile-hazard",))
+
+
+# --------------------------------------------------------- race-coverage
+
+def test_race_coverage_flags_uninstrumented_shared_field(tmp_path):
+    """Live trip: multi-entry unlocked writes the sanitizer never sees —
+    the gap between the escape analysis and racesan's hand-placed
+    instrumentation."""
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": _RACY})
+    found = run_lint([root], rules=("race-coverage",))
+    assert len(found) == 1, [f.render() for f in found]
+    assert found[0].rule == "race-coverage"
+    assert "note_read/note_write" in found[0].message
+
+
+def test_race_coverage_instrumented_is_quiet(tmp_path):
+    """racesan note_* calls naming the field in its module count as
+    coverage: the runtime detector now sees every access."""
+    src = _RACY.replace(
+        "import threading\n",
+        "import threading\n"
+        "from ..utils import racesan\n"
+    ).replace(
+        "            self.counter += 1\n",
+        "            racesan.note_write(self, 'counter')\n"
+        "            self.counter += 1\n")
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": src})
+    assert not run_lint([root], rules=("race-coverage",))
+
+
+def test_race_coverage_lock_guarded_is_quiet(tmp_path):
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": (
+        "import threading\n"
+        "from ..utils import locks\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._mu = locks.lock('kv.widget')\n"
+        "        self.counter = 0\n"
+        "        self._t = threading.Thread(target=self._loop)\n"
+        "        self._t.start()\n"
+        "    def _loop(self):\n"
+        "        with self._mu:\n"
+        "            self.counter += 1\n"
+        "    def bump(self):\n"
+        "        with self._mu:\n"
+        "            self.counter += 1\n")})
+    assert not run_lint([root], rules=("race-coverage",))
+
+
+def test_race_coverage_init_site_pragma_waives_state_wide(tmp_path):
+    """A reasoned pragma on the __init__ assignment (the ergonomic spot)
+    waives the whole state, like shared-state's state-wide waiver."""
+    src = _RACY.replace(
+        "        self.counter = 0\n",
+        "        # crlint: allow-race-coverage(single-writer by "
+        "protocol; instrumenting would false-positive under racesan)\n"
+        "        self.counter = 0\n")
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": src})
+    assert not run_lint([root], rules=("race-coverage",))
+
+
+def test_race_coverage_map_statuses(tmp_path):
+    """coverage_map labels every analyzed state; the waived row keeps
+    its sites visible (the CLI's --race-map contract)."""
+    from cockroach_tpu.lint.core import TreeCache
+    from cockroach_tpu.lint.racecoverage import coverage_map, render_map
+
+    src = _RACY.replace(
+        "        self.counter = 0\n",
+        "        # crlint: allow-race-coverage(documented lock-free "
+        "single-writer)\n"
+        "        self.counter = 0\n")
+    _tree(tmp_path, {"cockroach_tpu/kv/widget.py": src})
+    files = load_files([tmp_path / "cockroach_tpu"])
+    rows = coverage_map(files, TreeCache(files))
+    by_state = {r["state"].rsplit(".", 1)[-1]: r for r in rows}
+    assert by_state["counter"]["status"] == "waived"
+    assert by_state["counter"]["sites"]
+    text = render_map(rows)
+    assert "counter: waived" in text
+
+
+def test_unknown_pragma_covers_new_rules(tmp_path):
+    """Typo'd waivers of the three new passes are themselves findings."""
+    root = _tree(tmp_path, {"cockroach_tpu/kv/widget.py": (
+        "def f():\n"
+        "    # crlint: allow-untimed-waits(typo)\n"
+        "    # crlint: allow-recompile-hazzard(typo)\n"
+        "    # crlint: allow-race-coverge(typo)\n"
+        "    return 1\n")})
+    found = run_lint([root])
+    assert sorted(f.rule for f in found) == ["unknown-pragma"] * 3
+
+
+# ------------------------------------------------- real tree: new passes
+
+def test_real_tree_new_passes_are_clean_individually():
+    """Each PR-20 pass holds zero findings at HEAD on its own (the tree
+    gate runs them all; this pins the per-rule contract)."""
+    found = run_lint(
+        ["cockroach_tpu", "scripts", "tests", "bench.py",
+         "__graft_entry__.py"],
+        rules=("untimed-wait", "recompile-hazard", "race-coverage"))
+    assert not found, [f.render() for f in found]
+
+
+def test_run_lint_fills_per_pass_timings():
+    """run_lint exposes per-pass wall seconds plus the shared load/parse
+    cost — the budget the TreeCache defends."""
+    from cockroach_tpu.lint.core import ALL_RULES
+
+    timings = {}
+    found = run_lint(["cockroach_tpu/lint"], timings=timings)
+    assert "load/parse" in timings
+    for rule in ALL_RULES:
+        assert rule in timings, rule
+        assert timings[rule] >= 0.0
+    assert not found
+
+
+def test_cli_changed_only_git_mode(tmp_path, monkeypatch):
+    """--changed-only --git takes the changed set straight from git:
+    untracked/modified files are reported, committed-clean ones are
+    filtered out."""
+    import subprocess
+
+    from cockroach_tpu.lint.__main__ import main
+
+    root = _tree(tmp_path, {
+        "cockroach_tpu/kv/a.py": "import jax\nf = jax.jit(lambda x: x)\n",
+    })
+    monkeypatch.chdir(tmp_path)
+    env = {"GIT_CONFIG_GLOBAL": "/dev/null", "GIT_CONFIG_SYSTEM": "/dev/null"}
+    subprocess.run(["git", "init", "-q"], check=True, env={**__import__("os").environ, **env})
+    # untracked: the dirty file is in the changed set
+    assert main([str(root), "--changed-only", "--git"]) == 1
+    subprocess.run(["git", "add", "-A"], check=True,
+                   env={**__import__("os").environ, **env})
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "x"], check=True,
+        env={**__import__("os").environ, **env})
+    # committed and unmodified: filtered out of the report
+    assert main([str(root), "--changed-only", "--git"]) == 0
